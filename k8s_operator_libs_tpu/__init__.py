@@ -1,0 +1,32 @@
+"""k8s_operator_libs_tpu — TPU-native rebuild of NVIDIA's k8s-operator-libs.
+
+A collection of Python packages to ease the development of Kubernetes operators
+for TPU fleet management on GKE (reference: github.com/NVIDIA/k8s-operator-libs,
+README.md:3-4 — "a collection of go packages to ease the development of NVIDIA
+Operators for GPU/NIC management").
+
+Functional pillars (mirroring the reference, re-targeted at TPU):
+
+1. ``upgrade`` — a cluster-wide, label-driven driver-upgrade state machine
+   (reference pkg/upgrade/upgrade_state.go) generalized so the scheduling unit
+   is an *UpgradeGroup*: one node for classic GPU/NIC drivers, or all hosts of
+   a multi-host TPU slice (v5e-16 / v5p-64), which share one ICI failure domain
+   and must cordon → drain → upgrade → uncordon atomically.
+2. ``crdutil`` — CRD apply/reconcile from YAML directories, working around
+   Helm's CRD-handling limitations (reference pkg/crdutil/crdutil.go:70-90).
+3. ``tpu`` — TPU-specific topology intelligence: slice membership from GKE node
+   labels, ICI-aware drain grouping, libtpu / device-plugin DaemonSet
+   recognition, and a thin scheduler that places JAX workloads on slices.
+4. ``models`` / ``parallel`` / ``ops`` / ``train`` — the JAX/XLA workload side:
+   a Llama-style flagship model, mesh/sharding strategies (DP/FSDP/TP/SP),
+   Pallas kernels, and an upgrade-aware checkpoint/resume training harness so
+   a rolling libtpu upgrade costs checkpoint-restore time, not job-kill time
+   (BASELINE.json north star).
+
+The control plane is pure Python against an abstract Kubernetes client; tests
+run against :mod:`k8s_operator_libs_tpu.core.fakecluster`, an in-process
+envtest equivalent (real apiserver semantics — resource versions, cache lag,
+eviction API — without kubelet or containers).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""API types (CRD-embeddable policy specs)."""
+
+from .v1alpha1 import (  # noqa: F401
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+    scaled_int_or_percent,
+)

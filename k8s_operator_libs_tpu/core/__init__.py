@@ -1,0 +1,24 @@
+"""Core cluster I/O layer: object model, client interfaces, fake apiserver.
+
+The reference talks to Kubernetes through two clients — a cached
+controller-runtime ``client.Client`` and an uncached client-go
+``kubernetes.Interface`` (reference pkg/upgrade/upgrade_state.go:106-107,
+127-135). This package provides the same split as abstract Python interfaces
+(:mod:`.client`), a minimal typed object model (:mod:`.objects`), a
+kubectl-drain-equivalent helper (:mod:`.drain`), and an in-process fake
+apiserver with envtest semantics (:mod:`.fakecluster`).
+"""
+
+from .objects import (  # noqa: F401
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    Event,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+)
+from .client import Client, EventRecorder, NullRecorder  # noqa: F401
+from .fakecluster import FakeCluster, FakeRecorder  # noqa: F401

@@ -1,0 +1,109 @@
+"""Abstract Kubernetes client interfaces.
+
+The reference deliberately holds *two* clients (pkg/upgrade/upgrade_state.go:
+106-107, 123-151): a cached controller-runtime ``client.Client`` used for
+List/Get/Patch, and an uncached client-go ``kubernetes.Interface`` handed to
+the kubectl drain helper. The cache can serve stale reads immediately after a
+write; the NodeUpgradeStateProvider compensates with a poll-until-synced
+barrier (node_upgrade_state_provider.go:92-117). We keep the same split:
+``Client`` here is the *cached* view; implementations expose ``direct()`` for
+the uncached view. Production would back these with the real apiserver; tests
+use :class:`~k8s_operator_libs_tpu.core.fakecluster.FakeCluster`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional
+
+from .objects import ControllerRevision, DaemonSet, Event, Job, Node, Pod
+
+
+class NotFoundError(KeyError):
+    """Object does not exist (apierrors.IsNotFound analog)."""
+
+
+class ConflictError(RuntimeError):
+    """resourceVersion conflict on update (apierrors.IsConflict analog)."""
+
+
+class Client(abc.ABC):
+    """Cached read / write client (controller-runtime client.Client analog)."""
+
+    # -- reads (may be stale on a cached implementation) --------------------
+
+    @abc.abstractmethod
+    def get_node(self, name: str) -> Node: ...
+
+    @abc.abstractmethod
+    def list_nodes(self, label_selector: Optional[Dict[str, str]] = None) -> List[Node]: ...
+
+    @abc.abstractmethod
+    def get_pod(self, namespace: str, name: str) -> Pod: ...
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: Optional[Dict[str, str]] = None,
+                  field_node_name: Optional[str] = None) -> List[Pod]: ...
+
+    @abc.abstractmethod
+    def list_daemonsets(self, namespace: Optional[str] = None,
+                        label_selector: Optional[Dict[str, str]] = None) -> List[DaemonSet]: ...
+
+    @abc.abstractmethod
+    def list_controller_revisions(self, namespace: Optional[str] = None,
+                                  label_selector: Optional[Dict[str, str]] = None
+                                  ) -> List[ControllerRevision]: ...
+
+    @abc.abstractmethod
+    def get_job(self, namespace: str, name: str) -> Job: ...
+
+    # -- writes (always go to the apiserver; cache lags behind) -------------
+
+    @abc.abstractmethod
+    def patch_node_metadata(self, name: str,
+                            labels: Optional[Dict[str, Optional[str]]] = None,
+                            annotations: Optional[Dict[str, Optional[str]]] = None) -> Node:
+        """Strategic-merge-patch labels/annotations; ``None`` value deletes
+        the key (the reference deletes annotations by patching a null value,
+        node_upgrade_state_provider.go:170-186)."""
+
+    @abc.abstractmethod
+    def patch_node_unschedulable(self, name: str, unschedulable: bool) -> Node: ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str,
+                   grace_period_seconds: Optional[int] = None) -> None: ...
+
+    @abc.abstractmethod
+    def evict_pod(self, namespace: str, name: str,
+                  grace_period_seconds: Optional[int] = None) -> None:
+        """Eviction-API delete (respects PDBs on a real cluster; the drain
+        helper prefers eviction when the server supports it)."""
+
+    # -- cache control ------------------------------------------------------
+
+    @abc.abstractmethod
+    def direct(self) -> "Client":
+        """The uncached view of the same cluster (kubernetes.Interface
+        analog) — reads are never stale."""
+
+
+class EventRecorder(abc.ABC):
+    """record.EventRecorder analog (reference util.go:141-153 wraps it with
+    nil-safe helpers; we use a NullRecorder instead of nil)."""
+
+    @abc.abstractmethod
+    def event(self, obj, event_type: str, reason: str, message: str) -> None: ...
+
+
+class NullRecorder(EventRecorder):
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        pass
+
+
+def make_event(obj, event_type: str, reason: str, message: str) -> Event:
+    kind = getattr(obj, "kind", type(obj).__name__)
+    name = getattr(getattr(obj, "metadata", None), "name", "")
+    return Event(object_kind=kind, object_name=name, event_type=event_type,
+                 reason=reason, message=message)

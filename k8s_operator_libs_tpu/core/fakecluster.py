@@ -1,0 +1,421 @@
+"""In-process fake Kubernetes apiserver — our envtest.
+
+The reference's central test fixture is envtest: a real kube-apiserver + etcd
+with **no kubelet and no scheduler**, so Node/Pod/DaemonSet objects are plain
+API objects whose status tests hand-set (reference upgrade_suit_test.go:73-97,
+293-296). We reproduce exactly that contract in-process:
+
+- objects live in a thread-safe store keyed by (kind, namespace, name), with
+  resourceVersion bumped on every write and deep-copy on every round-trip;
+- the **cached** client view lags writes by a configurable ``cache_lag``
+  (modelling the controller-runtime informer cache whose staleness the
+  reference works around with a poll-until-synced barrier,
+  node_upgrade_state_provider.go:92-117);
+- pod delete / eviction removes the pod (no kubelet: nothing restarts it —
+  DaemonSet recreation is simulated explicitly by
+  :meth:`FakeCluster.reconcile_daemonsets`, playing the role of the
+  kube-controller-manager that envtest also lacks);
+- a :class:`FakeRecorder` captures Events like record.NewFakeRecorder(100)
+  (reference upgrade_suit_test.go:63).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.clock import Clock, RealClock
+from .client import Client, ConflictError, EventRecorder, NotFoundError, make_event
+from .objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    Event,
+    Job,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    deep_copy,
+)
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def _key(obj) -> Key:
+    return (obj.kind, getattr(obj.metadata, "namespace", ""), obj.metadata.name)
+
+
+def _match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class FakeRecorder(EventRecorder):
+    """Captures events for assertion; drained between tests like the
+    reference's FakeRecorder channel (upgrade_suit_test.go:176-199)."""
+
+    def __init__(self):
+        self.events: List[Event] = []
+        self._lock = threading.Lock()
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.events.append(make_event(obj, event_type, reason, message))
+
+    def drain(self) -> List[Event]:
+        with self._lock:
+            out, self.events = self.events, []
+            return out
+
+
+class FakeCluster:
+    """The store + both client views. ``cluster.client`` is the cached view
+    (controller-runtime analog); ``cluster.client.direct()`` is the uncached
+    view (client-go analog)."""
+
+    def __init__(self, clock: Optional[Clock] = None, cache_lag: float = 0.0):
+        self.clock = clock or RealClock()
+        self.cache_lag = cache_lag
+        self._store: Dict[Key, object] = {}
+        self._lock = threading.RLock()
+        self._version = itertools.count(1)
+        # pending cache deliveries: (due_time, seq, key, obj-or-None)
+        self._pending: List[Tuple[float, int, Key, Optional[object]]] = []
+        self._pending_seq = itertools.count()
+        self._cache: Dict[Key, object] = {}
+        self.recorder = FakeRecorder()
+        self.client: Client = _FakeClient(self, cached=True)
+
+    # ------------------------------------------------------------------ store
+
+    def _bump(self, obj) -> None:
+        obj.metadata.resource_version = str(next(self._version))
+
+    def _publish(self, key: Key, obj: Optional[object]) -> None:
+        """Queue the new state for the cached view after cache_lag."""
+        due = self.clock.now() + self.cache_lag
+        heapq.heappush(self._pending, (due, next(self._pending_seq), key,
+                                       deep_copy(obj) if obj is not None else None))
+
+    def _sync_cache(self) -> None:
+        now = self.clock.now()
+        while self._pending and self._pending[0][0] <= now:
+            _, _, key, obj = heapq.heappop(self._pending)
+            if obj is None:
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = obj
+
+    def flush_cache(self) -> None:
+        """Force the cached view current (tests use this to skip lag)."""
+        with self._lock:
+            while self._pending:
+                _, _, key, obj = heapq.heappop(self._pending)
+                if obj is None:
+                    self._cache.pop(key, None)
+                else:
+                    self._cache[key] = obj
+
+    def create(self, obj):
+        with self._lock:
+            key = _key(obj)
+            if key in self._store:
+                raise ConflictError(f"{key} already exists")
+            stored = deep_copy(obj)
+            self._bump(stored)
+            self._store[key] = stored
+            self._publish(key, stored)
+            return deep_copy(stored)
+
+    def update(self, obj):
+        """Full-object update with resourceVersion conflict detection."""
+        with self._lock:
+            key = _key(obj)
+            cur = self._store.get(key)
+            if cur is None:
+                raise NotFoundError(key)
+            if (obj.metadata.resource_version not in ("", "0")
+                    and obj.metadata.resource_version != cur.metadata.resource_version):
+                raise ConflictError(f"{key}: stale resourceVersion")
+            stored = deep_copy(obj)
+            stored.metadata.resource_version = cur.metadata.resource_version
+            self._bump(stored)
+            self._store[key] = stored
+            self._publish(key, stored)
+            return deep_copy(stored)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self._store:
+                raise NotFoundError(key)
+            del self._store[key]
+            self._publish(key, None)
+
+    def get(self, kind: str, namespace: str, name: str, cached: bool = False):
+        with self._lock:
+            if cached:
+                self._sync_cache()
+                obj = self._cache.get((kind, namespace, name))
+            else:
+                obj = self._store.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError((kind, namespace, name))
+            return deep_copy(obj)
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             cached: bool = False) -> List[object]:
+        with self._lock:
+            if cached:
+                self._sync_cache()
+                src = self._cache
+            else:
+                src = self._store
+            out = []
+            for (k, ns, _), obj in sorted(src.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if not _match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(deep_copy(obj))
+            return out
+
+    # ----------------------------------------------------- object conveniences
+    #
+    # These setup helpers flush the cache before returning, mirroring test
+    # setup against envtest where fixtures wait for the informer cache to sync
+    # before the code under test runs. Writes through the *client* (patches,
+    # deletes) still lag by cache_lag — that is what the barrier code must
+    # handle.
+
+    def add_node(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 annotations: Optional[Dict[str, str]] = None,
+                 unschedulable: bool = False, ready: bool = True) -> Node:
+        node = Node(metadata=ObjectMeta(name=name, namespace="",
+                                        labels=dict(labels or {}),
+                                        annotations=dict(annotations or {})))
+        node.spec.unschedulable = unschedulable
+        node.status.conditions[0].status = "True" if ready else "False"
+        created = self.create(node)
+        self.flush_cache()
+        return created
+
+    def add_daemonset(self, name: str, namespace: str = "default",
+                      labels: Optional[Dict[str, str]] = None,
+                      selector: Optional[Dict[str, str]] = None,
+                      revision_hash: str = "rev-1") -> DaemonSet:
+        """Create a DS plus its current ControllerRevision (the reference
+        resolves 'latest template' via owned ControllerRevisions with max
+        revision — pod_manager.go:95-121)."""
+        labels = dict(labels or {})
+        ds = DaemonSet(metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
+                       selector=dict(selector or labels))
+        ds = self.create(ds)
+        self.add_controller_revision(ds, revision_hash, revision=1)
+        return ds
+
+    def add_controller_revision(self, ds: DaemonSet, revision_hash: str,
+                                revision: int) -> ControllerRevision:
+        cr = ControllerRevision(
+            metadata=ObjectMeta(
+                name=f"{ds.metadata.name}-{revision_hash}",
+                namespace=ds.metadata.namespace,
+                labels={"controller-revision-hash": revision_hash},
+                owner_references=[OwnerReference(kind="DaemonSet",
+                                                 name=ds.metadata.name,
+                                                 uid=ds.metadata.uid)]),
+            revision=revision)
+        created = self.create(cr)
+        self.flush_cache()
+        return created
+
+    def bump_daemonset_revision(self, ds_name: str, namespace: str,
+                                revision_hash: str) -> None:
+        """Simulate a driver-image update: a new ControllerRevision with a
+        higher revision number. Existing pods keep the old hash label and so
+        become 'outdated' (podInSyncWithDS false — upgrade_state.go:558-578)."""
+        ds = self.get("DaemonSet", namespace, ds_name)
+        revs = [r for r in self.list("ControllerRevision", namespace)
+                if any(o.uid == ds.metadata.uid for o in r.metadata.owner_references)]
+        next_rev = max((r.revision for r in revs), default=0) + 1
+        self.add_controller_revision(ds, revision_hash, next_rev)
+
+    def add_pod(self, name: str, node_name: str, namespace: str = "default",
+                labels: Optional[Dict[str, str]] = None,
+                annotations: Optional[Dict[str, str]] = None,
+                owner_ds: Optional[DaemonSet] = None,
+                revision_hash: Optional[str] = None,
+                phase: str = "Running", ready: bool = True,
+                restart_count: int = 0) -> Pod:
+        labels = dict(labels or {})
+        owners = []
+        if owner_ds is not None:
+            owners.append(OwnerReference(kind="DaemonSet", name=owner_ds.metadata.name,
+                                         uid=owner_ds.metadata.uid))
+            labels.setdefault("controller-revision-hash", revision_hash or "rev-1")
+            for k, v in owner_ds.selector.items():
+                labels.setdefault(k, v)
+        elif revision_hash is not None:
+            labels.setdefault("controller-revision-hash", revision_hash)
+        pod = Pod(metadata=ObjectMeta(name=name, namespace=namespace, labels=labels,
+                                      annotations=dict(annotations or {}),
+                                      owner_references=owners))
+        pod.spec.node_name = node_name
+        pod.status.phase = phase
+        pod.status.container_statuses = [ContainerStatus(ready=ready,
+                                                         restart_count=restart_count)]
+        pod.status.conditions = [PodCondition(type="Ready",
+                                              status="True" if ready else "False")]
+        created = self.create(pod)
+        if owner_ds is not None:
+            ds = self.get("DaemonSet", owner_ds.metadata.namespace, owner_ds.metadata.name)
+            ds.status.desired_number_scheduled += 1
+            self.update(ds)
+        self.flush_cache()
+        return created
+
+    def set_pod_status(self, namespace: str, name: str, phase: Optional[str] = None,
+                       ready: Optional[bool] = None,
+                       restart_count: Optional[int] = None) -> Pod:
+        pod = self.get("Pod", namespace, name)
+        if phase is not None:
+            pod.status.phase = phase
+        if ready is not None:
+            for cs in pod.status.container_statuses:
+                cs.ready = ready
+            for c in pod.status.conditions:
+                if c.type == "Ready":
+                    c.status = "True" if ready else "False"
+        if restart_count is not None:
+            for cs in pod.status.container_statuses:
+                cs.restart_count = restart_count
+        updated = self.update(pod)
+        self.flush_cache()
+        return updated
+
+    def reconcile_daemonsets(self) -> List[Pod]:
+        """Play the DaemonSet controller for one step: for every DS, recreate
+        a pod (at the *latest* revision hash) on any node matching the DS that
+        lost its pod. envtest has no controller-manager either; reference
+        tests hand-create the replacement pod (upgrade_state_test.go pod
+        restart specs). Returns pods created."""
+        created = []
+        with self._lock:
+            for ds in self.list("DaemonSet"):
+                revs = [r for r in self.list("ControllerRevision", ds.metadata.namespace)
+                        if any(o.uid == ds.metadata.uid
+                               for o in r.metadata.owner_references)]
+                if not revs:
+                    continue
+                latest = max(revs, key=lambda r: r.revision)
+                latest_hash = latest.metadata.labels["controller-revision-hash"]
+                pods = [p for p in self.list("Pod", ds.metadata.namespace)
+                        if any(o.uid == ds.metadata.uid
+                               for o in p.metadata.owner_references)]
+                covered = {p.spec.node_name for p in pods}
+                want = int(ds.metadata.annotations.get("fake/want-nodes-count",
+                                                       ds.status.desired_number_scheduled))
+                candidates = [n for n in self.list("Node", namespace=None)
+                              if n.metadata.name not in covered]
+                for node in candidates[:max(0, want - len(pods))]:
+                    pod = Pod(metadata=ObjectMeta(
+                        name=f"{ds.metadata.name}-{node.metadata.name}",
+                        namespace=ds.metadata.namespace,
+                        labels={**ds.selector,
+                                "controller-revision-hash": latest_hash},
+                        owner_references=[OwnerReference(
+                            kind="DaemonSet", name=ds.metadata.name,
+                            uid=ds.metadata.uid)]))
+                    pod.spec.node_name = node.metadata.name
+                    pod.status.phase = "Running"
+                    pod.status.container_statuses = [ContainerStatus(ready=True)]
+                    pod.status.conditions = [PodCondition(type="Ready",
+                                                          status="True")]
+                    created.append(self.create(pod))
+        self.flush_cache()
+        return created
+
+
+class _FakeClient(Client):
+    def __init__(self, cluster: FakeCluster, cached: bool):
+        self._c = cluster
+        self._cached = cached
+        self._direct: Optional[Client] = None
+
+    def direct(self) -> Client:
+        if self._cached:
+            if self._direct is None:
+                self._direct = _FakeClient(self._c, cached=False)
+            return self._direct
+        return self
+
+    # -- reads --------------------------------------------------------------
+
+    def get_node(self, name: str) -> Node:
+        return self._c.get("Node", "", name, cached=self._cached)
+
+    def list_nodes(self, label_selector=None) -> List[Node]:
+        return self._c.list("Node", namespace=None, label_selector=label_selector,
+                            cached=self._cached)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return self._c.get("Pod", namespace, name, cached=self._cached)
+
+    def list_pods(self, namespace=None, label_selector=None,
+                  field_node_name=None) -> List[Pod]:
+        pods = self._c.list("Pod", namespace=namespace, label_selector=label_selector,
+                            cached=self._cached)
+        if field_node_name is not None:
+            pods = [p for p in pods if p.spec.node_name == field_node_name]
+        return pods
+
+    def list_daemonsets(self, namespace=None, label_selector=None) -> List[DaemonSet]:
+        return self._c.list("DaemonSet", namespace=namespace,
+                            label_selector=label_selector, cached=self._cached)
+
+    def list_controller_revisions(self, namespace=None,
+                                  label_selector=None) -> List[ControllerRevision]:
+        return self._c.list("ControllerRevision", namespace=namespace,
+                            label_selector=label_selector, cached=self._cached)
+
+    def get_job(self, namespace: str, name: str) -> Job:
+        return self._c.get("Job", namespace, name, cached=self._cached)
+
+    # -- writes -------------------------------------------------------------
+
+    def patch_node_metadata(self, name, labels=None, annotations=None) -> Node:
+        with self._c._lock:
+            node = self._c.get("Node", "", name)  # always patch against live state
+            for k, v in (labels or {}).items():
+                if v is None:
+                    node.metadata.labels.pop(k, None)
+                else:
+                    node.metadata.labels[k] = v
+            for k, v in (annotations or {}).items():
+                if v is None:
+                    node.metadata.annotations.pop(k, None)
+                else:
+                    node.metadata.annotations[k] = v
+            return self._c.update(node)
+
+    def patch_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        with self._c._lock:
+            node = self._c.get("Node", "", name)
+            node.spec.unschedulable = unschedulable
+            return self._c.update(node)
+
+    def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        self._c.delete("Pod", namespace, name)
+
+    def evict_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        # No PDBs in the fake; eviction degrades to delete, like the drain
+        # helper's fallback path.
+        self._c.delete("Pod", namespace, name)

@@ -1,0 +1,237 @@
+"""Minimal typed Kubernetes object model.
+
+Only the kinds and fields the upgrade/crdutil libraries actually touch are
+modelled: Node, Pod, DaemonSet, ControllerRevision, Job, Event, and CRDs
+(as raw dicts — see :mod:`k8s_operator_libs_tpu.crdutil`). The reference uses
+the full client-go typed API; we keep the shapes close enough that field names
+map one-to-one (``node.spec.unschedulable``, ``pod.status.phase``, ...).
+
+Objects are plain mutable dataclasses. The fake apiserver deep-copies on every
+read/write so aliasing bugs behave like they would against a real apiserver.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class OwnerReference:
+    """metav1.OwnerReference — only what getPodsOwnedbyDs / getOrphanedPods
+    need (reference pkg/upgrade/upgrade_state.go:320-355)."""
+
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = "0"
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    generation: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeCondition:
+    type: str = "Ready"
+    status: str = "True"  # "True" | "False" | "Unknown"
+
+
+@dataclass
+class NodeStatus:
+    conditions: List[NodeCondition] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=lambda: NodeStatus(
+        conditions=[NodeCondition(type="Ready", status="True")]))
+
+    kind: str = "Node"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def is_ready(self) -> bool:
+        """Mirrors isNodeUnschedulable/isNodeConditionReady used by
+        GetCurrentUnavailableNodes (reference pkg/upgrade/upgrade_state.go:192-211)."""
+        for c in self.status.conditions:
+            if c.type == "Ready":
+                return c.status == "True"
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerStatus:
+    name: str = "main"
+    ready: bool = False
+    restart_count: int = 0
+
+
+@dataclass
+class PodCondition:
+    type: str = "Ready"
+    status: str = "False"
+
+
+@dataclass
+class Volume:
+    name: str = "v"
+    empty_dir: bool = False
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    volumes: List[Volume] = field(default_factory=list)
+    termination_grace_period_seconds: Optional[int] = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Running"  # Pending | Running | Succeeded | Failed | Unknown
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    init_container_statuses: List[ContainerStatus] = field(default_factory=list)
+    conditions: List[PodCondition] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind: str = "Pod"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def controller_owner(self) -> Optional[OwnerReference]:
+        for ref in self.metadata.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+    def is_ready(self) -> bool:
+        """Pod readiness as the reference checks it: the Ready pod condition
+        (reference pkg/upgrade/validation_manager.go:118-136)."""
+        for c in self.status.conditions:
+            if c.type == "Ready":
+                return c.status == "True"
+        return False
+
+
+# ---------------------------------------------------------------------------
+# DaemonSet + ControllerRevision
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    kind: str = "DaemonSet"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class ControllerRevision:
+    """apps/v1 ControllerRevision. The reference finds a DaemonSet's current
+    template hash by listing revisions owned by the DS and taking the highest
+    ``revision`` (reference pkg/upgrade/pod_manager.go:95-121)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    revision: int = 1
+
+    kind: str = "ControllerRevision"
+
+
+# ---------------------------------------------------------------------------
+# Job (wait-for-completion checks target arbitrary workload pods; Jobs appear
+# in reference tests — upgrade_suit_test.go:419-453)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    kind: str = "Job"
+
+
+# ---------------------------------------------------------------------------
+# Event
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Event:
+    """A recorded k8s Event (reference util.go:141-153 emits warning/normal
+    events with reason ``<DRIVER>DriverUpgrade``)."""
+
+    object_kind: str = ""
+    object_name: str = ""
+    event_type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+
+
+def deep_copy(obj):
+    """DeepCopy, k8s-style. Every API round-trip copies."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,24 @@
+"""Driver-upgrade state machine for Kubernetes-managed accelerator fleets.
+
+TPU-native rebuild of reference pkg/upgrade. The public surface mirrors the
+reference's (upgrade_state.go:67-100, 123-176) with one structural change made
+early per SURVEY §7.2: the scheduling unit is an :class:`~.groups.UpgradeGroup`
+— a single node by default (exactly reproducing reference behavior) or all
+hosts of a multi-host TPU slice, which share one ICI failure domain and must
+be cordoned, drained, upgraded and uncordoned atomically.
+"""
+
+from .consts import UpgradeState  # noqa: F401
+from .util import KeyFactory, KeyedMutex, StringSet  # noqa: F401
+from .node_state_provider import NodeUpgradeStateProvider  # noqa: F401
+from .cordon_manager import CordonManager  # noqa: F401
+from .drain_manager import DrainManager, DrainConfiguration  # noqa: F401
+from .pod_manager import PodManager, PodManagerConfig  # noqa: F401
+from .validation_manager import ValidationManager  # noqa: F401
+from .safe_driver_load_manager import SafeDriverLoadManager  # noqa: F401
+from .groups import GroupPolicy, GroupView, NodeGrouper, SingleNodeGrouper  # noqa: F401
+from .upgrade_state import (  # noqa: F401
+    ClusterUpgradeState,
+    ClusterUpgradeStateManager,
+    NodeUpgradeState,
+)

@@ -1,0 +1,117 @@
+"""NodeUpgradeStateProvider — synchronized node state access.
+
+Reference pkg/upgrade/node_upgrade_state_provider.go. This component is
+load-bearing for the whole library's idempotency contract: ApplyState is
+stateless, so every state transition it writes must be visible to the *next*
+reconcile's cached reads. The provider therefore (a) serializes writes per
+node with a KeyedMutex (:43, :60, :78, :145) and (b) after every label or
+annotation patch, polls the cached client until the write is visible —
+the cache-sync barrier (:92-117, :163-197; ≤10 s at 1 s intervals).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..core.client import Client, EventRecorder
+from ..core.objects import Node
+from ..utils.clock import Clock, RealClock
+from . import consts
+from .util import KeyFactory, KeyedMutex, log_event
+
+logger = logging.getLogger(__name__)
+
+# The reference deletes an annotation by passing the literal string "null"
+# (node_upgrade_state_provider.go:170-186). We keep the same sentinel so
+# call sites read identically.
+NULL = "null"
+
+
+class CacheSyncTimeoutError(TimeoutError):
+    """The cached client never showed the write within the barrier timeout."""
+
+
+class NodeUpgradeStateProvider:
+    def __init__(self, client: Client, keys: KeyFactory,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 sync_timeout: float = consts.CACHE_SYNC_TIMEOUT_SECONDS,
+                 sync_poll: float = consts.CACHE_SYNC_POLL_SECONDS):
+        self._client = client
+        self._keys = keys
+        self._recorder = recorder
+        self._clock = clock or RealClock()
+        self._sync_timeout = sync_timeout
+        self._sync_poll = sync_poll
+        self._mutex = KeyedMutex()
+
+    # ----------------------------------------------------------------- reads
+
+    def get_node(self, name: str) -> Node:
+        """GetNode (:59-68): cached read under the per-node mutex."""
+        with self._mutex.lock(name):
+            return self._client.get_node(name)
+
+    # ---------------------------------------------------------------- writes
+
+    def change_node_upgrade_state(self, node: Node, new_state: str) -> None:
+        """ChangeNodeUpgradeState (:72-134): patch the state label, then block
+        until the cached client reflects it. Setting UNKNOWN ("") removes the
+        label. Emits a Normal event on success."""
+        with self._mutex.lock(node.metadata.name):
+            value = new_state if new_state != consts.UpgradeState.UNKNOWN else None
+            self._client.patch_node_metadata(
+                node.metadata.name, labels={self._keys.state_label: value})
+            self._wait_label_synced(node.metadata.name, self._keys.state_label, value)
+            node.metadata.labels = dict(node.metadata.labels)
+            if value is None:
+                node.metadata.labels.pop(self._keys.state_label, None)
+            else:
+                node.metadata.labels[self._keys.state_label] = value
+            log_event(self._recorder, node, "Normal", self._keys.event_reason,
+                      f"Node upgrade state updated to {new_state or 'unknown'}")
+            logger.info("node %s upgrade state -> %r", node.metadata.name, new_state)
+
+    def change_node_upgrade_annotation(self, node: Node, key: str, value: str) -> None:
+        """ChangeNodeUpgradeAnnotation (:138-216): set (or, for value "null",
+        delete) an annotation with the same cache-sync barrier + event."""
+        with self._mutex.lock(node.metadata.name):
+            patched = None if value == NULL else value
+            self._client.patch_node_metadata(
+                node.metadata.name, annotations={key: patched})
+            self._wait_annotation_synced(node.metadata.name, key, patched)
+            node.metadata.annotations = dict(node.metadata.annotations)
+            if patched is None:
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = patched
+            verb = "deleted" if patched is None else f"set to {value}"
+            log_event(self._recorder, node, "Normal", self._keys.event_reason,
+                      f"Node annotation {key} {verb}")
+
+    # --------------------------------------------------------------- barrier
+
+    def _wait_label_synced(self, name: str, key: str, value: Optional[str]) -> None:
+        self._wait_synced(name, lambda n: n.metadata.labels.get(key) == value)
+
+    def _wait_annotation_synced(self, name: str, key: str,
+                                value: Optional[str]) -> None:
+        self._wait_synced(name, lambda n: n.metadata.annotations.get(key) == value)
+
+    def _wait_synced(self, name: str, pred) -> None:
+        """Poll-until-visible (:92-117). Raises CacheSyncTimeoutError after
+        sync_timeout — the reference returns an error, failing the current
+        ApplyState pass; the next reconcile retries idempotently."""
+        deadline = self._clock.now() + self._sync_timeout
+        while True:
+            try:
+                if pred(self._client.get_node(name)):
+                    return
+            except KeyError:
+                pass  # node not in cache yet
+            if self._clock.now() >= deadline:
+                raise CacheSyncTimeoutError(
+                    f"cached client did not reflect write to node {name} "
+                    f"within {self._sync_timeout}s")
+            self._clock.sleep(self._sync_poll)

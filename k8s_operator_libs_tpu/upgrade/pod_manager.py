@@ -1,0 +1,251 @@
+"""PodManager (reference pkg/upgrade/pod_manager.go).
+
+Three jobs:
+(a) wait-for-job-completion checks with a timeout tracked in a node
+    annotation (ScheduleCheckOnPodCompletion / HandleTimeoutOnPodCompletions,
+    pod_manager.go:259-371);
+(b) filtered workload-pod eviction via the drain helper's AdditionalFilters
+    (SchedulePodEviction, :125-232);
+(c) driver-pod delete so the DaemonSet restarts it at the new template
+    (SchedulePodsRestart, :236-254).
+Plus the revision-hash getters used to decide "is the driver up to date"
+(:87-121).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from ..api.v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
+from ..core.client import Client, EventRecorder
+from ..core.drain import Helper
+from ..core.objects import DaemonSet, Node, Pod
+from ..utils.clock import Clock, RealClock
+from .consts import UpgradeState
+from .node_state_provider import NULL, NodeUpgradeStateProvider
+from .util import KeyFactory, StringSet, log_event, parse_selector
+
+logger = logging.getLogger(__name__)
+
+# PodDeletionFilter (pod_manager.go:76): consumer-supplied predicate choosing
+# which workload pods must be deleted before the driver upgrade (e.g. "all
+# pods that mount a TPU device resource").
+PodDeletionFilter = Callable[[Pod], bool]
+
+REVISION_HASH_LABEL = "controller-revision-hash"
+
+
+@dataclasses.dataclass
+class PodManagerConfig:
+    """PodManagerConfig (pod_manager.go:63-68)."""
+
+    nodes: List[Node]
+    deletion_spec: Optional[PodDeletionSpec] = None
+    wait_for_completion_spec: Optional[WaitForCompletionSpec] = None
+    drain_enabled: bool = False
+
+
+class PodManager:
+    def __init__(self, client: Client, state_provider: NodeUpgradeStateProvider,
+                 keys: KeyFactory,
+                 pod_deletion_filter: Optional[PodDeletionFilter] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None, synchronous: bool = False):
+        self._client = client
+        self._provider = state_provider
+        self._keys = keys
+        self._filter = pod_deletion_filter
+        self._recorder = recorder
+        self._clock = clock or RealClock()
+        self._in_progress = StringSet()
+        self._synchronous = synchronous
+        self._threads: List[threading.Thread] = []
+
+    # ----------------------------------------------------- revision hashes
+
+    def get_pod_controller_revision_hash(self, pod: Pod) -> str:
+        """Pod's template hash from its controller-revision-hash label
+        (pod_manager.go:87-93)."""
+        try:
+            return pod.metadata.labels[REVISION_HASH_LABEL]
+        except KeyError:
+            raise ValueError(
+                f"pod {pod.metadata.name} has no {REVISION_HASH_LABEL} label")
+
+    def get_daemonset_controller_revision_hash(self, ds: DaemonSet) -> str:
+        """Latest template hash = hash label of the owned ControllerRevision
+        with the highest revision (pod_manager.go:95-121)."""
+        revs = [r for r in self._client.direct().list_controller_revisions(
+                    namespace=ds.metadata.namespace)
+                if any(o.uid == ds.metadata.uid for o in r.metadata.owner_references)]
+        if not revs:
+            raise ValueError(f"no ControllerRevisions for DaemonSet {ds.metadata.name}")
+        latest = max(revs, key=lambda r: r.revision)
+        return latest.metadata.labels[REVISION_HASH_LABEL]
+
+    # ------------------------------------------------------------ eviction
+
+    def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
+        """SchedulePodEviction (:125-232): per node, delete pods matching the
+        PodDeletionFilter through the drain helper; nothing to delete →
+        straight to pod-restart-required (:187-191); partial/failed deletion →
+        drain-required if drain enabled else upgrade-failed (:396-406)."""
+        if not config.nodes:
+            return
+        if config.deletion_spec is None:
+            raise ValueError("pod deletion spec should not be empty")
+        spec = config.deletion_spec
+
+        def custom_filter(pod: Pod):
+            if self._filter is not None and not self._filter(pod):
+                return (False, None)  # skip silently, like MakePodDeleteStatusSkip
+            return (True, None)
+
+        helper = Helper(
+            client=self._client,
+            force=spec.force,
+            ignore_all_daemon_sets=True,
+            delete_empty_dir_data=spec.delete_empty_dir,
+            timeout_seconds=float(spec.timeout_second),
+            additional_filters=[custom_filter],
+            clock=self._clock,
+        )
+
+        for node in config.nodes:
+            if not self._in_progress.add_if_absent(node.metadata.name):
+                logger.info("node %s already getting pods deleted, skipping",
+                            node.metadata.name)
+                continue
+            if self._synchronous:
+                self._evict_one(helper, node, config.drain_enabled)
+            else:
+                t = threading.Thread(target=self._evict_one,
+                                     args=(helper, node, config.drain_enabled),
+                                     daemon=True)
+                self._threads.append(t)
+                t.start()
+
+    def _evict_one(self, helper: Helper, node: Node, drain_enabled: bool) -> None:
+        name = node.metadata.name
+        try:
+            pods = self._client.direct().list_pods(field_node_name=name)
+            # completed pods are not deletable (the drain helper skips
+            # Succeeded/Failed), so they must not count as "required" either
+            # or the counts below can never match
+            to_delete = [p for p in pods
+                         if p.status.phase not in ("Succeeded", "Failed")
+                         and self._filter is not None and self._filter(p)]
+            if not to_delete:
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED)
+                return
+            deletable, errs = helper.get_pods_for_deletion(name)
+            if len(deletable) != len(to_delete) or errs:
+                logger.error("cannot delete all required pods on %s: %s", name, errs)
+                self._update_node_to_drain_or_failed(node, drain_enabled)
+                return
+            try:
+                helper.delete_or_evict_pods(deletable)
+            except Exception as exc:
+                logger.error("failed to delete pods on node %s: %s", name, exc)
+                log_event(self._recorder, node, "Warning", self._keys.event_reason,
+                          f"Failed to delete workload pods on the node for the "
+                          f"driver upgrade, {exc}")
+                self._update_node_to_drain_or_failed(node, drain_enabled)
+                return
+            self._provider.change_node_upgrade_state(
+                node, UpgradeState.POD_RESTART_REQUIRED)
+            log_event(self._recorder, node, "Normal", self._keys.event_reason,
+                      "Deleted workload pods on the node for the driver upgrade")
+        finally:
+            self._in_progress.remove(name)
+
+    def _update_node_to_drain_or_failed(self, node: Node, drain_enabled: bool) -> None:
+        next_state = UpgradeState.FAILED
+        if drain_enabled:
+            log_event(self._recorder, node, "Warning", self._keys.event_reason,
+                      "Pod deletion failed but drain is enabled in spec. "
+                      "Will attempt a node drain")
+            next_state = UpgradeState.DRAIN_REQUIRED
+        self._provider.change_node_upgrade_state(node, next_state)
+
+    # ------------------------------------------------------------- restart
+
+    def schedule_pods_restart(self, pods: List[Pod]) -> None:
+        """SchedulePodsRestart (:236-254): plain delete of each outdated
+        driver pod; the DaemonSet controller recreates it at the new
+        template."""
+        client = self._client.direct()
+        for pod in pods:
+            logger.info("deleting driver pod %s", pod.metadata.name)
+            try:
+                client.delete_pod(pod.metadata.namespace, pod.metadata.name)
+            except Exception as exc:
+                log_event(self._recorder, pod, "Warning", self._keys.event_reason,
+                          f"Failed to restart driver pod {exc}")
+                raise
+
+    # ------------------------------------------------- completion checking
+
+    def schedule_check_on_pod_completion(self, config: PodManagerConfig) -> None:
+        """ScheduleCheckOnPodCompletion (:259-321): per node, if no selected
+        workload pod is Running/Pending, clear the start-time annotation and
+        advance to pod-deletion-required; otherwise apply the timeout logic.
+        Blocks until all nodes are checked (WaitGroup in the reference)."""
+        spec = config.wait_for_completion_spec
+        assert spec is not None
+        selector = parse_selector(spec.pod_selector)
+        threads = []
+        for node in config.nodes:
+            pods = self._client.direct().list_pods(
+                label_selector=selector, field_node_name=node.metadata.name)
+            if self._synchronous:
+                self._check_one(node, pods, spec)
+            else:
+                worker = threading.Thread(
+                    target=self._check_one, args=(node, pods, spec), daemon=True)
+                threads.append(worker)
+                worker.start()
+        for t in threads:
+            t.join()
+
+    def _check_one(self, node: Node, pods: List[Pod],
+                   spec: WaitForCompletionSpec) -> None:
+        running = any(self.is_pod_running_or_pending(p) for p in pods)
+        key = self._keys.wait_for_completion_start_annotation
+        if running:
+            if spec.timeout_second != 0:
+                self.handle_timeout_on_pod_completions(node, spec.timeout_second)
+            return
+        self._provider.change_node_upgrade_annotation(node, key, NULL)
+        self._provider.change_node_upgrade_state(
+            node, UpgradeState.POD_DELETION_REQUIRED)
+
+    def handle_timeout_on_pod_completions(self, node: Node,
+                                          timeout_seconds: int) -> None:
+        """HandleTimeoutOnPodCompletions (:334-371). Uses Unix wall time in
+        the annotation like the reference (portable across operator
+        restarts); the injected clock offsets it for simulation."""
+        key = self._keys.wait_for_completion_start_annotation
+        now = int(self._clock.wall())
+        if key not in node.metadata.annotations:
+            self._provider.change_node_upgrade_annotation(node, key, str(now))
+            return
+        start = int(node.metadata.annotations[key])
+        if now > start + timeout_seconds:
+            self._provider.change_node_upgrade_state(
+                node, UpgradeState.POD_DELETION_REQUIRED)
+            self._provider.change_node_upgrade_annotation(node, key, NULL)
+
+    @staticmethod
+    def is_pod_running_or_pending(pod: Pod) -> bool:
+        """IsPodRunningOrPending (:374-394)."""
+        return pod.status.phase in ("Running", "Pending")
+
+    def wait_idle(self, timeout: float = 30.0) -> None:
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]

@@ -1,0 +1,570 @@
+"""ClusterUpgradeStateManager — the cluster-wide upgrade state machine.
+
+Rebuild of reference pkg/upgrade/upgrade_state.go. The consumer (an operator's
+reconcile loop) calls :meth:`ClusterUpgradeStateManager.build_state` +
+:meth:`~ClusterUpgradeStateManager.apply_state` every reconcile tick. State
+lives in the cluster — each node's upgrade state is a node label, auxiliary
+handshakes are annotations — so ApplyState is stateless and idempotent
+(upgrade_state.go:68-72, 357-361): if a pass errors midway, the next reconcile
+completes the work from cluster state.
+
+Pipeline (fixed processing order, upgrade_state.go:418-481):
+
+    unknown/done → upgrade-required → cordon-required → wait-for-jobs-required
+    → pod-deletion-required → drain-required → pod-restart-required
+    → validation-required → uncordon-required → upgrade-done
+    (any failure → upgrade-failed, with automatic re-entry)
+
+TPU generalization: the scheduling unit is an UpgradeGroup (one node by
+default; all hosts of a multi-host slice with a TPU grouper) — see
+:mod:`.groups` for the three group-awareness points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from ..api.v1alpha1 import (
+    DriverUpgradePolicySpec,
+    scaled_int_or_percent,
+)
+from ..core.client import Client, EventRecorder
+from ..core.objects import DaemonSet, Node, Pod
+from ..utils.clock import Clock, RealClock
+from . import consts
+from .consts import UpgradeState
+from .cordon_manager import CordonManager
+from .drain_manager import DrainConfiguration, DrainManager
+from .groups import (
+    AT_OR_PAST_POD_RESTART,
+    AT_OR_PAST_UNCORDON,
+    GroupPolicy,
+    GroupView,
+    NodeGrouper,
+    SingleNodeGrouper,
+    build_group_views,
+)
+from .node_state_provider import NULL, NodeUpgradeStateProvider
+from .pod_manager import PodDeletionFilter, PodManager, PodManagerConfig
+from .safe_driver_load_manager import SafeDriverLoadManager
+from .util import KeyFactory
+from .validation_manager import ValidationManager
+
+logger = logging.getLogger(__name__)
+
+TRUE_STRING = "true"
+
+
+@dataclasses.dataclass
+class NodeUpgradeState:
+    """A node joined with the driver pod running on it and the DaemonSet
+    controlling that pod (reference upgrade_state.go:43-53). DaemonSet is
+    None for orphaned pods."""
+
+    node: Node
+    driver_pod: Pod
+    driver_daemonset: Optional[DaemonSet]
+
+    def is_orphaned_pod(self) -> bool:
+        return self.driver_daemonset is None
+
+
+@dataclasses.dataclass
+class ClusterUpgradeState:
+    """map[state-label][]NodeUpgradeState (reference upgrade_state.go:55-62)."""
+
+    node_states: Dict[str, List[NodeUpgradeState]] = dataclasses.field(
+        default_factory=dict)
+
+    def bucket(self, state: str) -> List[NodeUpgradeState]:
+        return self.node_states.get(state, [])
+
+
+class BuildStateError(RuntimeError):
+    """BuildState refuses to act on incomplete information — e.g. a driver
+    DaemonSet with unscheduled pods (reference upgrade_state.go:241-248)."""
+
+
+class ClusterUpgradeStateManager:
+    """Reference ClusterUpgradeStateManagerImpl (:104-151) with its five
+    injected action managers, builder options WithPodDeletionEnabled /
+    WithValidationEnabled (:155-176), and a pluggable NodeGrouper."""
+
+    def __init__(self, client: Client, keys: KeyFactory,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 grouper: Optional[NodeGrouper] = None,
+                 group_policy: Optional[GroupPolicy] = None,
+                 synchronous: bool = False,
+                 state_provider: Optional[NodeUpgradeStateProvider] = None,
+                 cordon_manager: Optional[CordonManager] = None,
+                 drain_manager: Optional[DrainManager] = None,
+                 pod_manager: Optional[PodManager] = None,
+                 validation_manager: Optional[ValidationManager] = None,
+                 safe_load_manager: Optional[SafeDriverLoadManager] = None):
+        self.client = client
+        self.keys = keys
+        self.recorder = recorder
+        self.clock = clock or RealClock()
+        self.grouper = grouper or SingleNodeGrouper()
+        self.group_policy = group_policy or GroupPolicy()
+        self.node_upgrade_state_provider = state_provider or NodeUpgradeStateProvider(
+            client, keys, recorder, self.clock)
+        self.cordon_manager = cordon_manager or CordonManager(client)
+        self.drain_manager = drain_manager or DrainManager(
+            client, self.node_upgrade_state_provider, keys, recorder, self.clock,
+            synchronous=synchronous)
+        self.pod_manager = pod_manager or PodManager(
+            client, self.node_upgrade_state_provider, keys, None, recorder,
+            self.clock, synchronous=synchronous)
+        self.validation_manager = validation_manager or ValidationManager(
+            client, self.node_upgrade_state_provider, keys, "", recorder, self.clock)
+        self.safe_driver_load_manager = safe_load_manager or SafeDriverLoadManager(
+            self.node_upgrade_state_provider, keys)
+        self._pod_deletion_enabled = False
+        self._validation_enabled = False
+
+    # ------------------------------------------------------ builder options
+
+    def with_pod_deletion_enabled(self, filter: PodDeletionFilter
+                                  ) -> "ClusterUpgradeStateManager":
+        """WithPodDeletionEnabled (:155-165): turn on the optional
+        pod-deletion state with the consumer-supplied filter."""
+        self.pod_manager._filter = filter
+        self._pod_deletion_enabled = True
+        return self
+
+    def with_validation_enabled(self, pod_selector: str
+                                ) -> "ClusterUpgradeStateManager":
+        """WithValidationEnabled (:167-176): turn on the optional validation
+        state; pods matching ``pod_selector`` must become Ready."""
+        self.validation_manager._selector = pod_selector
+        self._validation_enabled = True
+        return self
+
+    def is_pod_deletion_enabled(self) -> bool:
+        return self._pod_deletion_enabled
+
+    def is_validation_enabled(self) -> bool:
+        return self._validation_enabled
+
+    # ----------------------------------------------------------- BuildState
+
+    def build_state(self, namespace: str,
+                    driver_labels: Dict[str, str]) -> ClusterUpgradeState:
+        """BuildState (:214-279): point-in-time snapshot. Finds driver
+        DaemonSets + pods by label, joins each pod with its node, buckets by
+        the node's current state label. Orphaned pods (no owner DaemonSet)
+        are collected too (:250-251). Errors out if a DaemonSet has
+        unscheduled pods (:241-248)."""
+        state = ClusterUpgradeState()
+        daemonsets = {ds.metadata.uid: ds for ds in self.client.list_daemonsets(
+            namespace=namespace, label_selector=driver_labels)}
+        pods = self.client.list_pods(namespace=namespace,
+                                     label_selector=driver_labels)
+
+        filtered: List[Pod] = []
+        for ds in daemonsets.values():
+            ds_pods = [p for p in pods
+                       if p.metadata.owner_references
+                       and p.metadata.owner_references[0].uid == ds.metadata.uid]
+            if ds.status.desired_number_scheduled != len(ds_pods):
+                raise BuildStateError(
+                    f"driver DaemonSet {ds.metadata.name} should not have "
+                    f"Unscheduled pods (desired "
+                    f"{ds.status.desired_number_scheduled}, got {len(ds_pods)})")
+            filtered.extend(ds_pods)
+        # orphaned driver pods are first-class (:341-355)
+        filtered.extend(p for p in pods if not p.metadata.owner_references)
+
+        for pod in filtered:
+            owner = (daemonsets.get(pod.metadata.owner_references[0].uid)
+                     if pod.metadata.owner_references else None)
+            if pod.spec.node_name == "" and pod.status.phase == "Pending":
+                logger.info("driver pod %s has no NodeName, skipping",
+                            pod.metadata.name)
+                continue
+            node = self.node_upgrade_state_provider.get_node(pod.spec.node_name)
+            ns = NodeUpgradeState(node=node, driver_pod=pod, driver_daemonset=owner)
+            label = node.metadata.labels.get(self.keys.state_label,
+                                             UpgradeState.UNKNOWN)
+            state.node_states.setdefault(label, []).append(ns)
+        return state
+
+    # ------------------------------------------------------------ ApplyState
+
+    def apply_state(self, current_state: ClusterUpgradeState,
+                    upgrade_policy: Optional[DriverUpgradePolicySpec]) -> None:
+        """ApplyState (:364-484): one stateless, idempotent pass of the
+        fixed-order pipeline."""
+        if current_state is None:
+            raise ValueError("currentState should not be empty")
+        if upgrade_policy is None or not upgrade_policy.auto_upgrade:
+            logger.info("driver auto upgrade is disabled, skipping")
+            return
+
+        total_nodes = self.get_total_managed_nodes(current_state)
+        max_unavailable = total_nodes
+        if upgrade_policy.max_unavailable is not None:
+            max_unavailable = scaled_int_or_percent(
+                upgrade_policy.max_unavailable, total_nodes, round_up=True)
+
+        upgrades_available = self.get_upgrades_available(
+            current_state, upgrade_policy.max_parallel_upgrades, max_unavailable)
+
+        logger.info(
+            "upgrades in progress=%d available=%d unavailable=%d total=%d "
+            "maxUnavailable=%d",
+            self.get_upgrades_in_progress(current_state), upgrades_available,
+            self.get_current_unavailable_nodes(current_state), total_nodes,
+            max_unavailable)
+
+        groups = build_group_views(current_state, self.grouper)
+
+        self.process_done_or_unknown_nodes(current_state, UpgradeState.UNKNOWN)
+        self.process_done_or_unknown_nodes(current_state, UpgradeState.DONE)
+        self.process_upgrade_required_nodes(current_state, upgrades_available,
+                                            groups, max_unavailable)
+        self.process_cordon_required_nodes(current_state)
+        self.process_wait_for_jobs_required_nodes(
+            current_state, upgrade_policy.wait_for_completion)
+        drain_enabled = (upgrade_policy.drain is not None
+                         and upgrade_policy.drain.enable)
+        self.process_pod_deletion_required_nodes(
+            current_state, upgrade_policy.pod_deletion, drain_enabled)
+        self.process_drain_nodes(current_state, upgrade_policy.drain, groups)
+        self.process_pod_restart_nodes(current_state, groups)
+        self.process_upgrade_failed_nodes(current_state)
+        self.process_validation_required_nodes(current_state)
+        self.process_uncordon_required_nodes(current_state, groups)
+
+    # ----------------------------------------------------------- handlers
+
+    def process_done_or_unknown_nodes(self, state: ClusterUpgradeState,
+                                      bucket_name: str) -> None:
+        """ProcessDoneOrUnknownNodes (:488-550): decide upgrade-required vs
+        done per node, from pod-vs-DS revision hash, the upgrade-requested
+        annotation, or the safe-load handshake."""
+        for ns in state.bucket(bucket_name):
+            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+            is_requested = self._is_upgrade_requested(ns.node)
+            waiting_safe_load = (
+                self.safe_driver_load_manager.is_waiting_for_safe_driver_load(ns.node))
+            if (not is_synced and not is_orphaned) or waiting_safe_load or is_requested:
+                # Remember pre-upgrade unschedulable state so uncordon can be
+                # skipped at the end (:512-523).
+                if ns.node.spec.unschedulable:
+                    self.node_upgrade_state_provider.change_node_upgrade_annotation(
+                        ns.node, self.keys.initial_state_annotation, TRUE_STRING)
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.UPGRADE_REQUIRED)
+                continue
+            if bucket_name == UpgradeState.UNKNOWN:
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DONE)
+
+    def process_upgrade_required_nodes(self, state: ClusterUpgradeState,
+                                       upgrades_available: int,
+                                       groups: Dict[str, GroupView],
+                                       max_unavailable: int) -> None:
+        """ProcessUpgradeRequiredNodes (:587-631), group-aware.
+
+        Admission is per *group*: a group is admitted only when every member
+        is in upgrade-required (slice atomicity), and consumes one throttle
+        slot per member node. Already-cordoned nodes bypass the throttle
+        (:606-616); `upgrade.skip`-labeled nodes are skipped (:601-604);
+        the upgrade-requested annotation is cleared on processing (:594-600).
+        Oversized-group deadlock is broken per GroupPolicy (SURVEY §7.4)."""
+        bucket = state.bucket(UpgradeState.UPGRADE_REQUIRED)
+        in_progress = self.get_upgrades_in_progress(state)
+        unavailable = self.get_current_unavailable_nodes(state)
+        admitted_this_pass = False
+        processed: set = set()
+        for ns in bucket:
+            if self._is_upgrade_requested(ns.node):
+                self.node_upgrade_state_provider.change_node_upgrade_annotation(
+                    ns.node, self.keys.upgrade_requested_annotation, NULL)
+            if self._skip_node_upgrade(ns.node):
+                logger.info("node %s is marked for skipping upgrades",
+                            ns.node.metadata.name)
+                continue
+            key = self.grouper.group_key(ns.node)
+            if key in processed:
+                continue
+            processed.add(key)
+            group = groups[key]
+            # Slice atomicity: a group may start only when every member's
+            # intent is known — members are upgrade-required themselves,
+            # already current (done: they'll wait at the group barriers), or
+            # already in progress (group already started; let stragglers
+            # join so it converges). Any member still unknown blocks the
+            # group for this pass.
+            if group.any_in((UpgradeState.UNKNOWN,)):
+                continue
+            members = [m for m, s in zip(group.members, group.member_states)
+                       if s == UpgradeState.UPGRADE_REQUIRED]
+            if not members:
+                continue
+            all_cordoned = all(m.node.spec.unschedulable for m in members)
+            # Budget is charged per node admitted, cordoned or not (the
+            # reference decrements upgradesAvailable for every node it moves
+            # to cordon-required, :621-624).
+            admit = len(members) <= upgrades_available
+            if not admit and all_cordoned:
+                # already-cordoned nodes progress even with no slots
+                # (reference :606-616); for an atomic group this bypass
+                # applies only when *all* pending members are cordoned.
+                admit = True
+            if (not admit and len(members) > 1
+                    and self.group_policy.allow_oversized_group):
+                # Deadlock breaker (SURVEY §7.4): a multi-node group that can
+                # never fit the budget (e.g. a v5e-16 slice vs maxParallel=1,
+                # or vs maxUnavailable=25% of a small pool) may start when the
+                # cluster is otherwise quiet — nothing in progress, nothing
+                # unavailable beyond this group's own pre-cordoned members,
+                # and nothing else admitted this pass.
+                cordoned = sum(1 for m in members if m.node.spec.unschedulable)
+                admit = (not admitted_this_pass and in_progress == 0
+                         and unavailable - cordoned == 0)
+            if admit:
+                for m in members:
+                    self.node_upgrade_state_provider.change_node_upgrade_state(
+                        m.node, UpgradeState.CORDON_REQUIRED)
+                upgrades_available -= len(members)
+                admitted_this_pass = True
+
+    def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """ProcessCordonRequiredNodes (:635-654)."""
+        for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
+            self.cordon_manager.cordon(ns.node)
+            self.node_upgrade_state_provider.change_node_upgrade_state(
+                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+
+    def process_wait_for_jobs_required_nodes(
+            self, state: ClusterUpgradeState,
+            wait_spec) -> None:
+        """ProcessWaitForJobsRequiredNodes (:658-693)."""
+        bucket = state.bucket(UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        if wait_spec is None or not wait_spec.pod_selector:
+            next_state = (UpgradeState.POD_DELETION_REQUIRED
+                          if self._pod_deletion_enabled
+                          else UpgradeState.DRAIN_REQUIRED)
+            for ns in bucket:
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, next_state)
+            return
+        if not bucket:
+            return
+        self.pod_manager.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[ns.node for ns in bucket], wait_for_completion_spec=wait_spec))
+
+    def process_pod_deletion_required_nodes(self, state: ClusterUpgradeState,
+                                            deletion_spec,
+                                            drain_enabled: bool) -> None:
+        """ProcessPodDeletionRequiredNodes (:698-727)."""
+        bucket = state.bucket(UpgradeState.POD_DELETION_REQUIRED)
+        if not self._pod_deletion_enabled:
+            for ns in bucket:
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DRAIN_REQUIRED)
+            return
+        if not bucket:
+            return
+        self.pod_manager.schedule_pod_eviction(PodManagerConfig(
+            nodes=[ns.node for ns in bucket], deletion_spec=deletion_spec,
+            drain_enabled=drain_enabled))
+
+    def process_drain_nodes(self, state: ClusterUpgradeState, drain_spec,
+                            groups: Dict[str, GroupView]) -> None:
+        """ProcessDrainNodes (:731-760). Drain itself is per-node and may
+        proceed concurrently across a group — the *barrier* is before pod
+        restart, not before drain (all members are already cordoned)."""
+        bucket = state.bucket(UpgradeState.DRAIN_REQUIRED)
+        if drain_spec is None or not drain_spec.enable:
+            for ns in bucket:
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.POD_RESTART_REQUIRED)
+            return
+        if not bucket:
+            return
+        self.drain_manager.schedule_nodes_drain(DrainConfiguration(
+            spec=drain_spec, nodes=[ns.node for ns in bucket]))
+
+    def process_pod_restart_nodes(self, state: ClusterUpgradeState,
+                                  groups: Dict[str, GroupView]) -> None:
+        """ProcessPodRestartNodes (:764-831) with the group restart barrier:
+        in an atomic group, no driver pod restarts until every member host is
+        drained (at or past pod-restart-required) — the new libtpu must come
+        up against a quiesced ICI domain."""
+        pods_to_restart: List[Pod] = []
+        for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
+            if self.group_policy.atomic:
+                group = groups[self.grouper.group_key(ns.node)]
+                if not group.all_in(AT_OR_PAST_POD_RESTART):
+                    logger.info(
+                        "node %s waiting at group restart barrier (group %s)",
+                        ns.node.metadata.name, group.key)
+                    continue
+            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+            if not is_synced or is_orphaned:
+                # restart only if not already terminating (:773-781)
+                if ns.driver_pod.metadata.deletion_timestamp is None:
+                    pods_to_restart.append(ns.driver_pod)
+                continue
+            # pod is in sync: unblock safe driver load (:783-788)
+            self.safe_driver_load_manager.unblock_loading(ns.node)
+            if self._is_driver_pod_in_sync(ns):
+                if not self._validation_enabled:
+                    self._update_node_to_uncordon_or_done_state(ns.node)
+                    continue
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED)
+            else:
+                if not self._is_driver_pod_failing(ns.driver_pod):
+                    continue  # still coming up; check next reconcile
+                logger.info("driver pod failing on node %s with repeated restarts",
+                            ns.node.metadata.name)
+                self.node_upgrade_state_provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.FAILED)
+        self.pod_manager.schedule_pods_restart(pods_to_restart)
+
+    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
+        """ProcessUpgradeFailedNodes (:835-877): auto-recovery — once the
+        driver pod is back in sync and Ready (after manual intervention per
+        docs/automatic-ofed-upgrade.md:89-98), promote to uncordon/done."""
+        for ns in state.bucket(UpgradeState.FAILED):
+            if self._is_driver_pod_in_sync(ns):
+                self._update_node_to_uncordon_or_done_state(ns.node)
+
+    def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """ProcessValidationRequiredNodes (:880-911)."""
+        for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
+            # defensively re-unblock safe load: the driver may have restarted
+            # after reaching this state (:886-893)
+            self.safe_driver_load_manager.unblock_loading(ns.node)
+            if not self.validation_manager.validate(ns.node):
+                continue
+            self._update_node_to_uncordon_or_done_state(ns.node)
+
+    def process_uncordon_required_nodes(self, state: ClusterUpgradeState,
+                                        groups: Dict[str, GroupView]) -> None:
+        """ProcessUncordonRequiredNodes (:915-934) with the group uncordon
+        barrier: an atomic group returns to service as a unit."""
+        for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
+            if self.group_policy.atomic:
+                group = groups[self.grouper.group_key(ns.node)]
+                if not group.all_in(AT_OR_PAST_UNCORDON):
+                    logger.info(
+                        "node %s waiting at group uncordon barrier (group %s)",
+                        ns.node.metadata.name, group.key)
+                    continue
+            self.cordon_manager.uncordon(ns.node)
+            self.node_upgrade_state_provider.change_node_upgrade_state(
+                ns.node, UpgradeState.DONE)
+
+    # ------------------------------------------------------------- helpers
+
+    def _pod_in_sync_with_ds(self, ns: NodeUpgradeState):
+        """podInSyncWithDS (:558-578) → (is_synced, is_orphaned)."""
+        if ns.is_orphaned_pod():
+            return False, True
+        pod_hash = self.pod_manager.get_pod_controller_revision_hash(ns.driver_pod)
+        ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
+            ns.driver_daemonset)
+        return pod_hash == ds_hash, False
+
+    def _is_upgrade_requested(self, node: Node) -> bool:
+        return (node.metadata.annotations.get(
+            self.keys.upgrade_requested_annotation) == TRUE_STRING)
+
+    def _skip_node_upgrade(self, node: Node) -> bool:
+        return node.metadata.labels.get(self.keys.skip_node_label) == TRUE_STRING
+
+    def _is_driver_pod_in_sync(self, ns: NodeUpgradeState) -> bool:
+        """isDriverPodInSync (:936-964): synced hash + Running + all
+        containers ready."""
+        is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+        if is_orphaned:
+            return False
+        pod = ns.driver_pod
+        return (is_synced and pod.status.phase == "Running"
+                and len(pod.status.container_statuses) > 0
+                and all(cs.ready for cs in pod.status.container_statuses))
+
+    @staticmethod
+    def _is_driver_pod_failing(pod: Pod) -> bool:
+        """isDriverPodFailing (:966-978): any not-ready container with more
+        than POD_FAILURE_RESTART_THRESHOLD restarts."""
+        for cs in (list(pod.status.init_container_statuses)
+                   + list(pod.status.container_statuses)):
+            if not cs.ready and cs.restart_count > consts.POD_FAILURE_RESTART_THRESHOLD:
+                return True
+        return False
+
+    def _update_node_to_uncordon_or_done_state(self, node: Node) -> None:
+        """updateNodeToUncordonOrDoneState (:1000-1028): skip uncordon when
+        the node was already unschedulable pre-upgrade."""
+        new_state = UpgradeState.UNCORDON_REQUIRED
+        key = self.keys.initial_state_annotation
+        if key in node.metadata.annotations:
+            new_state = UpgradeState.DONE
+        self.node_upgrade_state_provider.change_node_upgrade_state(node, new_state)
+        if new_state == UpgradeState.DONE:
+            self.node_upgrade_state_provider.change_node_upgrade_annotation(
+                node, key, NULL)
+
+    # ------------------------------------------------------------- counters
+
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        """GetTotalManagedNodes (:1034-1052)."""
+        return sum(len(v) for v in state.node_states.values())
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        """GetUpgradesInProgress (:1056-1062)."""
+        return self.get_total_managed_nodes(state) - (
+            len(state.bucket(UpgradeState.UNKNOWN))
+            + len(state.bucket(UpgradeState.DONE))
+            + len(state.bucket(UpgradeState.UPGRADE_REQUIRED)))
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.DONE))
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.FAILED))
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.UPGRADE_REQUIRED))
+
+    def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
+        """GetCurrentUnavailableNodes (:192-211): cordoned or not-Ready."""
+        unavailable = 0
+        for node_states in state.node_states.values():
+            for ns in node_states:
+                if ns.node.spec.unschedulable or not ns.node.is_ready():
+                    unavailable += 1
+        return unavailable
+
+    def get_upgrades_available(self, state: ClusterUpgradeState,
+                               max_parallel_upgrades: int,
+                               max_unavailable: int) -> int:
+        """GetUpgradesAvailable (:1074-1102): maxParallelUpgrades==0 means
+        unlimited; clamp by maxUnavailable counting current unavailable plus
+        nodes about to cordon."""
+        in_progress = self.get_upgrades_in_progress(state)
+        total = self.get_total_managed_nodes(state)
+        if max_parallel_upgrades == 0:
+            available = len(state.bucket(UpgradeState.UPGRADE_REQUIRED))
+        else:
+            available = max_parallel_upgrades - in_progress
+        current_unavailable = (self.get_current_unavailable_nodes(state)
+                               + len(state.bucket(UpgradeState.CORDON_REQUIRED)))
+        if available > max_unavailable:
+            available = max_unavailable
+        if current_unavailable >= max_unavailable:
+            available = 0
+        elif (max_unavailable < total
+              and current_unavailable + available > max_unavailable):
+            available = max_unavailable - current_unavailable
+        return available

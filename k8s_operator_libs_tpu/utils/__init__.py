@@ -1,0 +1,3 @@
+"""Shared utilities: clock abstraction, logging helpers."""
+
+from .clock import Clock, FakeClock, RealClock  # noqa: F401

@@ -1,0 +1,66 @@
+"""Injectable clock.
+
+The reference uses wall-clock time directly for drain timeouts, validation
+timeouts, and cache-sync polling (e.g. validation_manager.go:32's 600 s
+timeout, node_upgrade_state_provider.go:100-103's 10 s/1 s poll). We inject a
+clock instead so (a) the full state machine can be driven through multi-minute
+timeout scenarios in milliseconds of test time, and (b) ``bench.py`` can
+simulate a v5p-64 fleet upgrade at faster-than-real time while still measuring
+modelled wall-clock.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+
+
+class Clock(abc.ABC):
+    @abc.abstractmethod
+    def now(self) -> float:
+        """Monotonic seconds."""
+
+    @abc.abstractmethod
+    def sleep(self, seconds: float) -> None: ...
+
+    def wall(self) -> float:
+        """Unix wall-clock seconds — used for timeout-tracking annotations,
+        which must survive operator restarts (the reference stores Unix
+        timestamps, pod_manager.go:340)."""
+        return self.now()
+
+
+class RealClock(Clock):
+    def now(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Simulated time. ``sleep`` advances the shared clock, so polling loops
+    (cache-sync barriers, drain waits) terminate immediately in tests while
+    the *modelled* elapsed time stays realistic. Thread-safe: concurrent
+    sleepers each advance time under a lock (simulation time moves at the
+    pace of the fastest sleeper, which is fine for our deterministic tests).
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self._now += max(0.0, seconds)
+
+    def advance(self, seconds: float) -> None:
+        self.sleep(seconds)

@@ -1,0 +1,38 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip shardings are
+validated without TPU hardware, per the driver's dryrun contract). These env
+vars must be set before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster  # noqa: E402
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory  # noqa: E402
+from k8s_operator_libs_tpu.utils.clock import FakeClock  # noqa: E402
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def cluster(clock):
+    """An envtest-equivalent cluster with a small but nonzero cache lag, so
+    the cache-sync barrier is actually exercised (reference
+    node_upgrade_state_provider.go:92-117)."""
+    return FakeCluster(clock=clock, cache_lag=0.5)
+
+
+@pytest.fixture
+def keys():
+    return KeyFactory("gpu")

@@ -1,0 +1,104 @@
+"""Fake apiserver semantics: versioning, cache lag, selectors, DS helpers."""
+
+import pytest
+
+from k8s_operator_libs_tpu.core.client import ConflictError, NotFoundError
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+def test_create_get_roundtrip(cluster):
+    cluster.add_node("node1", labels={"a": "b"})
+    node = cluster.client.direct().get_node("node1")
+    assert node.metadata.name == "node1"
+    assert node.metadata.labels == {"a": "b"}
+
+
+def test_cached_read_lags_writes():
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock, cache_lag=5.0)
+    cluster.add_node("node1")
+    clock.advance(10)
+    cluster.client.patch_node_metadata("node1", labels={"x": "1"})
+    # direct view sees it immediately; cached view does not
+    assert cluster.client.direct().get_node("node1").metadata.labels.get("x") == "1"
+    assert cluster.client.get_node("node1").metadata.labels.get("x") is None
+    clock.advance(5.0)
+    assert cluster.client.get_node("node1").metadata.labels.get("x") == "1"
+
+
+def test_update_conflict_on_stale_resource_version(cluster):
+    cluster.add_node("node1")
+    direct = cluster.client.direct()
+    a = direct.get_node("node1")
+    b = direct.get_node("node1")
+    a.spec.unschedulable = True
+    cluster.update(a)
+    b.spec.unschedulable = False
+    with pytest.raises(ConflictError):
+        cluster.update(b)
+
+
+def test_deep_copy_isolation(cluster):
+    cluster.add_node("node1", labels={"k": "v"})
+    node = cluster.client.direct().get_node("node1")
+    node.metadata.labels["k"] = "mutated"
+    assert cluster.client.direct().get_node("node1").metadata.labels["k"] == "v"
+
+
+def test_label_selector_and_field_selector(cluster):
+    ds = cluster.add_daemonset("driver", labels={"app": "driver"})
+    cluster.add_pod("p1", "node1", owner_ds=ds)
+    cluster.add_pod("p2", "node2", owner_ds=ds)
+    cluster.add_pod("other", "node1", labels={"app": "workload"})
+    direct = cluster.client.direct()
+    assert len(direct.list_pods(label_selector={"app": "driver"})) == 2
+    assert len(direct.list_pods(field_node_name="node1")) == 2
+    assert len(direct.list_pods(label_selector={"app": "driver"},
+                                field_node_name="node1")) == 1
+
+
+def test_delete_pod_and_not_found(cluster):
+    ds = cluster.add_daemonset("driver", labels={"app": "driver"})
+    cluster.add_pod("p1", "node1", owner_ds=ds)
+    cluster.client.direct().delete_pod("default", "p1")
+    with pytest.raises(NotFoundError):
+        cluster.client.direct().get_pod("default", "p1")
+
+
+def test_daemonset_revision_bump_marks_pods_outdated(cluster):
+    ds = cluster.add_daemonset("driver", labels={"app": "driver"},
+                               revision_hash="rev-1")
+    cluster.add_pod("p1", "node1", owner_ds=ds, revision_hash="rev-1")
+    cluster.bump_daemonset_revision("driver", "default", "rev-2")
+    revs = cluster.client.direct().list_controller_revisions(namespace="default")
+    assert {r.metadata.labels["controller-revision-hash"] for r in revs} == \
+        {"rev-1", "rev-2"}
+    latest = max(revs, key=lambda r: r.revision)
+    assert latest.metadata.labels["controller-revision-hash"] == "rev-2"
+
+
+def test_reconcile_daemonsets_recreates_pod_at_latest_revision(cluster):
+    cluster.add_node("node1")
+    ds = cluster.add_daemonset("driver", labels={"app": "driver"},
+                               revision_hash="rev-1")
+    cluster.add_pod("driver-node1", "node1", owner_ds=ds, revision_hash="rev-1")
+    cluster.bump_daemonset_revision("driver", "default", "rev-2")
+    cluster.client.direct().delete_pod("default", "driver-node1")
+    created = cluster.reconcile_daemonsets()
+    assert len(created) == 1
+    pod = cluster.client.direct().get_pod("default", "driver-node1")
+    assert pod.metadata.labels["controller-revision-hash"] == "rev-2"
+    # desired count unchanged
+    ds_cur = cluster.client.direct().list_daemonsets(namespace="default")[0]
+    assert ds_cur.status.desired_number_scheduled == 1
+
+
+def test_events_recorded(cluster):
+    cluster.add_node("node1")
+    node = cluster.client.direct().get_node("node1")
+    cluster.recorder.event(node, "Normal", "TestReason", "hello")
+    events = cluster.recorder.drain()
+    assert len(events) == 1
+    assert events[0].reason == "TestReason"
+    assert cluster.recorder.drain() == []

@@ -1,0 +1,71 @@
+"""NodeUpgradeStateProvider: label/annotation writes + cache-sync barrier
+(mirrors reference node_upgrade_state_provider_test.go:40-72)."""
+
+import pytest
+
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NULL,
+    CacheSyncTimeoutError,
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def provider(cluster, keys, clock):
+    return NodeUpgradeStateProvider(cluster.client, keys, cluster.recorder, clock)
+
+
+def test_change_state_visible_in_cache_after_barrier(cluster, keys, provider):
+    cluster.add_node("node1")
+    node = provider.get_node("node1")
+    provider.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+    # barrier guarantees the *cached* client already sees it
+    cached = cluster.client.get_node("node1")
+    assert cached.metadata.labels[keys.state_label] == UpgradeState.UPGRADE_REQUIRED
+    # in-memory object updated too (reference mutates the passed node)
+    assert node.metadata.labels[keys.state_label] == UpgradeState.UPGRADE_REQUIRED
+
+
+def test_change_state_to_unknown_removes_label(cluster, keys, provider):
+    cluster.add_node("node1", labels={keys.state_label: UpgradeState.DONE})
+    node = provider.get_node("node1")
+    provider.change_node_upgrade_state(node, UpgradeState.UNKNOWN)
+    cached = cluster.client.get_node("node1")
+    assert keys.state_label not in cached.metadata.labels
+
+
+def test_annotation_set_and_delete(cluster, keys, provider):
+    cluster.add_node("node1")
+    node = provider.get_node("node1")
+    key = keys.safe_load_annotation
+    provider.change_node_upgrade_annotation(node, key, "true")
+    assert cluster.client.get_node("node1").metadata.annotations[key] == "true"
+    provider.change_node_upgrade_annotation(node, key, NULL)
+    assert key not in cluster.client.get_node("node1").metadata.annotations
+    assert key not in node.metadata.annotations
+
+
+def test_barrier_times_out_when_cache_never_syncs(keys):
+    clock = FakeClock()
+    # lag longer than the barrier timeout → the write can never be observed
+    cluster = FakeCluster(clock=clock, cache_lag=60.0)
+    cluster.add_node("node1")
+    cluster.flush_cache()
+    provider = NodeUpgradeStateProvider(cluster.client, keys, clock=clock,
+                                        sync_timeout=10.0, sync_poll=1.0)
+    node = cluster.client.direct().get_node("node1")
+    with pytest.raises(CacheSyncTimeoutError):
+        provider.change_node_upgrade_state(node, UpgradeState.DONE)
+
+
+def test_state_change_emits_event(cluster, keys, provider):
+    cluster.add_node("node1")
+    node = provider.get_node("node1")
+    provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    events = cluster.recorder.drain()
+    assert any(e.reason == keys.event_reason and "cordon-required" in e.message
+               for e in events)
