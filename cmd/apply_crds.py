@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""apply-crds CLI (reference cmd/apply-crds/main.go:21-23).
+
+Thin main() over crdutil.ensure_crds. Shipped as a Helm pre-install /
+pre-upgrade hook Job so CRDs are installed *and upgraded* despite Helm's
+install-once CRD handling (reference pkg/crdutil/README.md:31-57).
+
+Usage:
+    apply_crds.py --crds-dir ./crds [--crds-dir ./more-crds] [--dry-run]
+
+Against a real cluster this would build an apiextensions client from
+kubeconfig; in this repo the in-cluster client is injectable and --dry-run
+prints what would be applied (useful in CI and for chart linting).
+"""
+
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from k8s_operator_libs_tpu.crdutil import crdutil  # noqa: E402
+
+
+class _DryRunClient:
+    def __init__(self):
+        self.applied = []
+
+    def get_crd(self, name):
+        raise KeyError(name)
+
+    def create_crd(self, crd):
+        self.applied.append(crd["metadata"]["name"])
+        print(f"would create CRD {crd['metadata']['name']}")
+        return crd
+
+    def update_crd(self, crd):  # pragma: no cover - get always raises
+        return crd
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--crds-dir", action="append", default=[],
+                        help="directory containing CRD YAMLs (repeatable)")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print what would be applied, touch nothing")
+    args = parser.parse_args(argv)
+    if not args.crds_dir:
+        parser.error("at least one --crds-dir is required")
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    if args.dry_run:
+        client = _DryRunClient()
+    else:  # pragma: no cover - needs a live cluster
+        print("error: no in-cluster client available in this environment; "
+              "use --dry-run or inject a client via crdutil.ensure_crds",
+              file=sys.stderr)
+        return 2
+    try:
+        n = crdutil.ensure_crds(client, args.crds_dir)
+    except crdutil.EnsureCRDsError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    print(f"applied {n} CRDs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

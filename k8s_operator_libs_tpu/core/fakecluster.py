@@ -88,6 +88,7 @@ class FakeCluster:
         self._pending: List[Tuple[float, int, Key, Optional[object]]] = []
         self._pending_seq = itertools.count()
         self._cache: Dict[Key, object] = {}
+        self._crds: Dict[str, dict] = {}
         self.recorder = FakeRecorder()
         self.client: Client = _FakeClient(self, cached=True)
 
@@ -301,6 +302,44 @@ class FakeCluster:
         self.flush_cache()
         return updated
 
+    # ------------------------------------------------------------------ CRDs
+    # Raw-dict CRD storage (the apiextensions surface crdutil needs).
+
+    def get_crd(self, name: str) -> dict:
+        with self._lock:
+            crd = self._crds.get(name)
+            if crd is None:
+                raise NotFoundError(("CustomResourceDefinition", "", name))
+            return deep_copy(crd)
+
+    def create_crd(self, crd: dict) -> dict:
+        with self._lock:
+            name = crd["metadata"]["name"]
+            if name in self._crds:
+                raise ConflictError(f"CRD {name} already exists")
+            stored = deep_copy(crd)
+            stored["metadata"]["resourceVersion"] = str(next(self._version))
+            self._crds[name] = stored
+            return deep_copy(stored)
+
+    def update_crd(self, crd: dict) -> dict:
+        with self._lock:
+            name = crd["metadata"]["name"]
+            cur = self._crds.get(name)
+            if cur is None:
+                raise NotFoundError(("CustomResourceDefinition", "", name))
+            rv = crd.get("metadata", {}).get("resourceVersion", "")
+            if rv and rv != cur["metadata"]["resourceVersion"]:
+                raise ConflictError(f"CRD {name}: stale resourceVersion")
+            stored = deep_copy(crd)
+            stored["metadata"]["resourceVersion"] = str(next(self._version))
+            self._crds[name] = stored
+            return deep_copy(stored)
+
+    def list_crds(self) -> List[dict]:
+        with self._lock:
+            return [deep_copy(c) for c in self._crds.values()]
+
     def reconcile_daemonsets(self) -> List[Pod]:
         """Play the DaemonSet controller for one step: for every DS, recreate
         a pod (at the *latest* revision hash) on any node matching the DS that
@@ -411,6 +450,11 @@ class _FakeClient(Client):
             node = self._c.get("Node", "", name)
             node.spec.unschedulable = unschedulable
             return self._c.update(node)
+
+    def create_pod(self, pod: Pod) -> Pod:
+        created = self._c.create(pod)
+        self._c.flush_cache()
+        return created
 
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         self._c.delete("Pod", namespace, name)

@@ -122,6 +122,9 @@ class PodSpec:
     node_name: str = ""
     volumes: List[Volume] = field(default_factory=list)
     termination_grace_period_seconds: Optional[int] = None
+    # summed container resource requests, e.g. {"google.com/tpu": 4}
+    resource_requests: Dict[str, int] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

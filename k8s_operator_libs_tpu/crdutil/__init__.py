@@ -1,0 +1,3 @@
+"""CRD apply/reconcile utilities (reference pkg/crdutil)."""
+
+from .crdutil import CRDClient, EnsureCRDsError, ensure_crds, walk_crds_dir  # noqa: F401

@@ -1,0 +1,24 @@
+"""TPU-specific layer: topology intelligence, slice-atomic grouping, libtpu /
+device-plugin DaemonSet recognition, and a thin slice scheduler.
+
+This is the net-new TPU surface the reference has no analog for (SURVEY §5.7,
+§5.8, §7.2 step 8): the reference's scheduling unit is a single node; a
+multi-host TPU slice shares one ICI failure domain and must be upgraded
+atomically, with slice membership derived from GKE TPU node labels.
+"""
+
+from .topology import (  # noqa: F401
+    GKE_ACCELERATOR_LABEL,
+    GKE_NODEPOOL_LABEL,
+    GKE_TOPOLOGY_LABEL,
+    SliceInfo,
+    TPUSliceGrouper,
+    TPUTopology,
+    slice_info_for_node,
+)
+from .device_plugin import (  # noqa: F401
+    TPU_RESOURCE,
+    pod_requests_tpu,
+    tpu_workload_deletion_filter,
+)
+from .scheduler import SliceScheduler, TPUWorkload  # noqa: F401

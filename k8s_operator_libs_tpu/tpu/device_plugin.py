@@ -1,0 +1,30 @@
+"""libtpu / TPU device-plugin DaemonSet recognition and workload filters.
+
+The reference manages GPU/OFED driver DaemonSets identified by consumer-
+supplied labels; the TPU equivalents are the TPU device-plugin DaemonSet (and
+any libtpu-updater DaemonSet) on TPU VM node pools. Workload pods that must be
+evicted before a driver upgrade are the ones actually holding TPU devices —
+i.e. requesting the ``google.com/tpu`` extended resource (the analog of the
+reference tests' GPU-resource PodDeletionFilter, pod_manager_test.go:230-456).
+"""
+
+from __future__ import annotations
+
+from ..core.objects import Pod
+
+TPU_RESOURCE = "google.com/tpu"
+
+# Conventional labels for the managed DaemonSets; consumers may use their own
+# (the upgrade library takes driver_labels as input, like the reference).
+DEVICE_PLUGIN_LABELS = {"app": "tpu-device-plugin"}
+LIBTPU_LABELS = {"app": "libtpu"}
+
+
+def pod_requests_tpu(pod: Pod) -> bool:
+    return pod.spec.resource_requests.get(TPU_RESOURCE, 0) > 0
+
+
+def tpu_workload_deletion_filter(pod: Pod) -> bool:
+    """PodDeletionFilter for ClusterUpgradeStateManager.with_pod_deletion_
+    enabled: delete exactly the pods holding TPU chips on the node."""
+    return pod_requests_tpu(pod)

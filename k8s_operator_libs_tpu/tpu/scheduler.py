@@ -1,0 +1,146 @@
+"""Thin tpu-operator: place JAX/XLA workloads onto TPU slices.
+
+The BASELINE north star asks for "a thin tpu-operator built on these libs
+[that] schedules JAX/XLA workloads onto v5e/v5p slices". This scheduler is
+deliberately small — real scheduling belongs to kube-scheduler + GKE; what an
+operator adds is *slice-level* placement: a multi-host JAX job needs all hosts
+of one slice, with the JAX distributed-init environment (worker ids, the
+coordinator address) wired consistently across its pods.
+
+Placement contract:
+- a workload names an accelerator type + chip topology;
+- a slice is eligible when its SliceInfo matches, every member node is Ready
+  and schedulable (so slices mid-upgrade — cordoned by the state machine —
+  are naturally excluded), and no other workload's pods hold its TPUs;
+- one pod per host is created, with ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``
+  and the ``google.com/tpu`` resource request filled in, so the upgrade
+  library's tpu_workload_deletion_filter and wait-for-completion selector see
+  exactly these pods.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from ..core.client import Client
+from ..core.objects import ObjectMeta, Pod
+from .device_plugin import TPU_RESOURCE, pod_requests_tpu
+from .topology import SliceInfo, chips_per_host, slice_info_for_node
+
+logger = logging.getLogger(__name__)
+
+WORKLOAD_LABEL = "tpu.dev/workload"
+
+
+@dataclasses.dataclass
+class TPUWorkload:
+    """A JAX job wanting one whole slice."""
+
+    name: str
+    accelerator: str            # e.g. "tpu-v5p-slice"
+    topology: str               # e.g. "4x4x4"
+    namespace: str = "default"
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Placement:
+    workload: str
+    slice_id: str
+    node_names: List[str]
+    pods: List[str]
+
+
+class SliceScheduler:
+    def __init__(self, client: Client):
+        self._client = client
+
+    # -- inventory ----------------------------------------------------------
+
+    def eligible_slices(self, accelerator: str, topology: str
+                        ) -> Dict[str, List]:
+        """All fully-Ready, schedulable slices matching (accelerator,
+        topology), as {slice_id: [nodes]}."""
+        nodes = self._client.list_nodes()
+        by_slice: Dict[str, List] = {}
+        info_by_slice: Dict[str, SliceInfo] = {}
+        for node in nodes:
+            info = slice_info_for_node(node)
+            if info is None:
+                continue
+            if info.accelerator != accelerator or str(info.topology) != topology:
+                continue
+            by_slice.setdefault(info.slice_id, []).append(node)
+            info_by_slice[info.slice_id] = info
+        out = {}
+        for slice_id, members in by_slice.items():
+            if len(members) != info_by_slice[slice_id].num_hosts:
+                continue  # partial view — unsafe to place
+            if any(n.spec.unschedulable or not n.is_ready() for n in members):
+                continue  # slice cordoned or degraded (e.g. mid-upgrade)
+            if self._slice_busy(members):
+                continue
+            out[slice_id] = sorted(members, key=lambda n: n.metadata.name)
+        return out
+
+    def _slice_busy(self, members) -> bool:
+        for node in members:
+            pods = self._client.list_pods(field_node_name=node.metadata.name)
+            if any(pod_requests_tpu(p) and p.status.phase in ("Running", "Pending")
+                   for p in pods):
+                return True
+        return False
+
+    # -- placement ----------------------------------------------------------
+
+    def place(self, workload: TPUWorkload) -> Optional[Placement]:
+        """Bind the workload to the first eligible slice; returns None when
+        no slice fits (caller requeues — same contract as a reconcile that
+        cannot progress)."""
+        slices = self.eligible_slices(workload.accelerator, workload.topology)
+        if not slices:
+            logger.info("no eligible %s/%s slice for workload %s",
+                        workload.accelerator, workload.topology, workload.name)
+            return None
+        slice_id, members = sorted(slices.items())[0]
+        hostnames = ",".join(
+            f"{workload.name}-{i}" for i in range(len(members)))
+        per_host = chips_per_host(workload.accelerator)
+        pods = []
+        for worker_id, node in enumerate(members):
+            pod = Pod(metadata=ObjectMeta(
+                name=f"{workload.name}-{worker_id}",
+                namespace=workload.namespace,
+                labels={**workload.labels, WORKLOAD_LABEL: workload.name}))
+            pod.spec.node_name = node.metadata.name
+            pod.spec.resource_requests = {TPU_RESOURCE: per_host}
+            pod.spec.env = {
+                **workload.env,
+                "TPU_WORKER_ID": str(worker_id),
+                "TPU_WORKER_HOSTNAMES": hostnames,
+                "TPU_ACCELERATOR_TYPE": workload.accelerator,
+                "TPU_TOPOLOGY": workload.topology,
+                # JAX distributed init: worker 0 is the coordinator
+                "JAX_COORDINATOR_ADDRESS": f"{workload.name}-0:8476",
+            }
+            pods.append(pod)
+        created = [self._create_pod(p) for p in pods]
+        return Placement(workload=workload.name, slice_id=slice_id,
+                         node_names=[n.metadata.name for n in members],
+                         pods=[p.metadata.name for p in created])
+
+    def _create_pod(self, pod: Pod) -> Pod:
+        # the abstract Client has no generic create; FakeCluster and real
+        # implementations expose one — kept behind a small indirection so the
+        # scheduler stays client-agnostic
+        create = getattr(self._client, "create_pod", None)
+        if create is not None:
+            return create(pod)
+        direct = self._client.direct()
+        create = getattr(direct, "create_pod", None)
+        if create is not None:
+            return create(pod)
+        raise NotImplementedError("client does not support pod creation")

@@ -1,0 +1,143 @@
+"""TPU topology: accelerator generations, slice shapes, GKE label scheme.
+
+GKE TPU VM node pools carry well-known labels describing the attached TPU
+(used here as scheduling metadata — the data-plane topology never enters the
+operator, per SURVEY §5.8):
+
+- ``cloud.google.com/gke-tpu-accelerator``: e.g. ``tpu-v5-lite-podslice``
+  (v5e), ``tpu-v5p-slice``, ``tpu-v4-podslice``.
+- ``cloud.google.com/gke-tpu-topology``: the chip grid, e.g. ``2x4`` (v5e),
+  ``2x2x2`` (v5p/v4 3-D tori).
+- ``cloud.google.com/gke-nodepool``: in GKE, one multi-host slice == one node
+  pool, so the nodepool name identifies the slice (all hosts of a v5e-16 or
+  v5p-64 slice live in one node pool).
+
+A slice's host count follows from chips-per-host: v5e packs 4 chips/VM (8 for
+the 8-chip single-host shape), v5p and v4 pack 4 chips/VM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+from ..core.objects import Node
+from ..upgrade.groups import NodeGrouper
+
+GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+# chips per TPU VM host by accelerator family
+_CHIPS_PER_HOST = {
+    "tpu-v4-podslice": 4,
+    "tpu-v5-lite-podslice": 4,   # v5e multi-host
+    "tpu-v5-lite-device": 8,     # v5e single-host 8-chip
+    "tpu-v5p-slice": 4,
+    "tpu-v6e-slice": 4,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUTopology:
+    """A chip grid like 2x4 or 4x4x4."""
+
+    dims: tuple
+
+    @classmethod
+    def parse(cls, s: str) -> "TPUTopology":
+        try:
+            dims = tuple(int(d) for d in s.lower().split("x"))
+        except ValueError:
+            raise ValueError(f"invalid TPU topology {s!r}")
+        if not dims or any(d <= 0 for d in dims):
+            raise ValueError(f"invalid TPU topology {s!r}")
+        return cls(dims=dims)
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    def __str__(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceInfo:
+    """Identity + shape of the slice a node belongs to."""
+
+    slice_id: str            # nodepool name (one pool == one slice on GKE)
+    accelerator: str         # e.g. tpu-v5p-slice
+    topology: TPUTopology    # chip grid
+    num_hosts: int           # VMs in the slice (== nodes to drain atomically)
+
+    @property
+    def num_chips(self) -> int:
+        return self.topology.num_chips
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+
+def chips_per_host(accelerator: str) -> int:
+    return _CHIPS_PER_HOST.get(accelerator, 4)
+
+
+def slice_info_for_node(node: Node) -> Optional[SliceInfo]:
+    """Derive SliceInfo from a node's GKE TPU labels; None for non-TPU
+    nodes."""
+    labels = node.metadata.labels
+    accel = labels.get(GKE_ACCELERATOR_LABEL)
+    topo = labels.get(GKE_TOPOLOGY_LABEL)
+    if not accel or not topo:
+        return None
+    topology = TPUTopology.parse(topo)
+    per_host = chips_per_host(accel)
+    num_hosts = max(1, topology.num_chips // per_host)
+    slice_id = labels.get(GKE_NODEPOOL_LABEL, node.metadata.name)
+    return SliceInfo(slice_id=slice_id, accelerator=accel, topology=topology,
+                     num_hosts=num_hosts)
+
+
+class TPUSliceGrouper(NodeGrouper):
+    """Groups nodes by slice membership so the state machine upgrades each
+    multi-host slice atomically (cordon all hosts, drain all, restart all
+    driver pods against a quiesced ICI domain, uncordon all — see
+    :mod:`k8s_operator_libs_tpu.upgrade.groups`).
+
+    Single-host slices and non-TPU nodes group by node name, reproducing the
+    reference's per-node scheduling for them.
+    """
+
+    def group_key(self, node: Node) -> str:
+        info = slice_info_for_node(node)
+        if info is None or not info.multi_host:
+            return node.metadata.name
+        return f"slice/{info.slice_id}"
+
+
+def validate_slice_membership(nodes, expected: Optional[SliceInfo] = None
+                              ) -> Dict[str, SliceInfo]:
+    """Check that every node of each multi-host slice is present: a drain
+    decision over a partial slice view is unsafe (the missing hosts would be
+    restarted later, breaking atomicity). Returns {slice_id: SliceInfo};
+    raises ValueError naming any slice whose observed host count differs from
+    its topology's."""
+    by_slice: Dict[str, list] = {}
+    infos: Dict[str, SliceInfo] = {}
+    for node in nodes:
+        info = slice_info_for_node(node)
+        if info is None or not info.multi_host:
+            continue
+        by_slice.setdefault(info.slice_id, []).append(node)
+        infos[info.slice_id] = info
+    for slice_id, members in by_slice.items():
+        want = infos[slice_id].num_hosts
+        if len(members) != want:
+            raise ValueError(
+                f"slice {slice_id}: saw {len(members)} member nodes, topology "
+                f"{infos[slice_id].topology} implies {want} hosts — refusing "
+                f"to act on a partial slice view")
+    return infos

@@ -1,0 +1,110 @@
+"""crdutil tests (mirrors reference crdutil_test.go: idempotent re-apply,
+schema update, multi-doc skip, missing-dir error, backoff retry)."""
+
+import os
+
+import pytest
+import yaml
+
+from k8s_operator_libs_tpu.crdutil.crdutil import (
+    EnsureCRDsError,
+    ensure_crds,
+    walk_crds_dir,
+)
+
+REPO_CRDS = os.path.join(os.path.dirname(__file__), "..", "crds")
+
+
+def write_crd(tmp_path, name, version="v1alpha1", fname="crd.yaml", extra=None):
+    doc = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": name},
+        "spec": {"group": "tpu.dev",
+                 "versions": [{"name": version, "served": True}]},
+    }
+    docs = [doc] + (extra or [])
+    path = tmp_path / fname
+    path.write_text(yaml.safe_dump_all(docs))
+    return str(tmp_path)
+
+
+def test_walk_collects_yaml_recursively(tmp_path):
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a.yaml").write_text("")
+    (tmp_path / "sub" / "b.yml").write_text("")
+    (tmp_path / "c.txt").write_text("")
+    files = walk_crds_dir(str(tmp_path))
+    assert [os.path.basename(f) for f in files] == ["a.yaml", "b.yml"]
+
+
+def test_missing_dir_is_fatal():
+    with pytest.raises(EnsureCRDsError, match="does not exist"):
+        walk_crds_dir("/nonexistent/crds")
+
+
+def test_apply_repo_crds_idempotent(cluster):
+    """Reference applies its test CRDs 4× and asserts stability
+    (crdutil_test.go:42-78)."""
+    for _ in range(4):
+        n = ensure_crds(cluster, [REPO_CRDS], sleep=lambda s: None)
+        assert n == 2  # two CRDs; the ConfigMap doc is skipped
+    names = sorted(c["metadata"]["name"] for c in cluster.list_crds())
+    assert names == ["tpuslicepolicies.tpu.dev", "tpuworkloads.tpu.dev"]
+
+
+def test_apply_updates_schema(cluster, tmp_path):
+    d = write_crd(tmp_path, "things.tpu.dev", version="v1alpha1")
+    ensure_crds(cluster, [d], sleep=lambda s: None)
+    assert cluster.get_crd("things.tpu.dev")["spec"]["versions"][0]["name"] == \
+        "v1alpha1"
+    write_crd(tmp_path, "things.tpu.dev", version="v1beta1")
+    ensure_crds(cluster, [d], sleep=lambda s: None)
+    crd = cluster.get_crd("things.tpu.dev")
+    assert crd["spec"]["versions"][0]["name"] == "v1beta1"
+
+
+def test_non_crd_docs_skipped(cluster, tmp_path):
+    d = write_crd(tmp_path, "things.tpu.dev",
+                  extra=[{"apiVersion": "v1", "kind": "ConfigMap",
+                          "metadata": {"name": "cm"}}])
+    assert ensure_crds(cluster, [d], sleep=lambda s: None) == 1
+
+
+def test_backoff_retries_transient_failures(cluster, tmp_path):
+    d = write_crd(tmp_path, "flaky.tpu.dev")
+    calls = {"n": 0}
+    real_create = cluster.create_crd
+
+    def flaky_create(crd):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient apiserver error")
+        return real_create(crd)
+
+    cluster.create_crd = flaky_create
+    sleeps = []
+    ensure_crds(cluster, [d], sleep=sleeps.append)
+    assert calls["n"] == 3
+    assert sleeps == [0.010, 0.050]  # exponential: 10ms then 50ms
+
+
+def test_backoff_gives_up_after_steps(cluster, tmp_path):
+    d = write_crd(tmp_path, "dead.tpu.dev")
+    cluster.create_crd = lambda crd: (_ for _ in ()).throw(RuntimeError("down"))
+    with pytest.raises(EnsureCRDsError, match="down"):
+        ensure_crds(cluster, [d], sleep=lambda s: None)
+
+
+def test_cli_dry_run(capsys):
+    import importlib.util
+    cli_path = os.path.join(os.path.dirname(__file__), "..", "cmd",
+                            "apply_crds.py")
+    spec = importlib.util.spec_from_file_location("apply_crds_cli", cli_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--crds-dir", REPO_CRDS, "--dry-run"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "applied 2 CRDs" in out
+    assert "tpuslicepolicies.tpu.dev" in out
