@@ -1,0 +1,3 @@
+"""Model zoo for the TPU workload harness (flagship: Llama-3-style LM)."""
+
+from .llama import LlamaConfig, forward, init_params  # noqa: F401

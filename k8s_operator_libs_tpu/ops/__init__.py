@@ -1,0 +1,3 @@
+"""TPU compute kernels (Pallas) with portable reference fallbacks."""
+
+from .attention import flash_attention, reference_attention  # noqa: F401

@@ -1,0 +1,6 @@
+"""Parallelism: device mesh, sharding rules, FSDP/TP train step, sequence-
+parallel ring attention."""
+
+from .mesh import batch_spec, make_mesh, param_specs  # noqa: F401
+from .fsdp import TrainState, init_train_state, make_train_step  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,94 @@
+"""Device mesh construction and sharding rules.
+
+The scaling-book recipe: pick a mesh, annotate shardings on params and batch,
+let XLA's SPMD partitioner insert the collectives, profile, iterate. Axes:
+
+- ``data``  — pure data parallelism (gradients all-reduced; rides DCN across
+  slices, since DP is the least communication-hungry axis);
+- ``fsdp``  — data parallelism with parameter/optimizer sharding (ZeRO-3):
+  params live sharded, XLA all-gathers them per layer inside the step and
+  reduce-scatters grads — these collectives must ride ICI;
+- ``tensor`` — megatron-style tensor parallelism within a host group
+  (activations all-reduced per block; the most bandwidth-hungry axis, so it
+  maps to the innermost/fastest ICI dimension);
+- ``seq``   — sequence/context parallelism for long-context training (ring
+  attention over ICI neighbors; see :mod:`.ring_attention`).
+
+Device order from ``jax.devices()`` already follows the physical torus on
+TPU, so axis order (data, fsdp, seq, tensor) puts ``tensor`` on the
+fastest-varying (nearest-neighbor) dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "seq", "tensor")
+
+
+def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
+              tensor: int = 1, devices=None) -> Mesh:
+    """Build a (data, fsdp, seq, tensor) mesh. ``fsdp=None`` absorbs all
+    remaining devices (the common pure-FSDP case, e.g. Llama-3-8B on a
+    v5p-64: fsdp=64)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if fsdp is None:
+        denom = data * seq * tensor
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by {denom}")
+        fsdp = n // denom
+    shape = (data, fsdp, seq, tensor)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh {shape} needs {np.prod(shape)} devices, have {n}")
+    return Mesh(np.asarray(devices).reshape(shape), AXES)
+
+
+# ------------------------------------------------------------- shardings
+
+
+def param_specs(params) -> Dict:
+    """PartitionSpecs for the Llama param pytree (models/llama.py layout).
+
+    FSDP rule: shard each weight's *largest* dim over "fsdp" and the other
+    model dim over "tensor" where that matches a megatron-legal split
+    (column-parallel wq/wk/wv/w_gate/w_up; row-parallel wo/w_down). Stacked
+    layer axis (leading L) is never sharded — it is scanned over. Norms are
+    replicated (tiny)."""
+    specs = {
+        "embed": P("fsdp", "tensor"),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tensor"),
+            "wk": P(None, "fsdp", "tensor"),
+            "wv": P(None, "fsdp", "tensor"),
+            "wo": P(None, "tensor", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tensor"),
+            "w_up": P(None, "fsdp", "tensor"),
+            "w_down": P(None, "tensor", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tensor"),
+    }
+    # structural check: same tree shape as params
+    jax.tree_util.tree_map(lambda a, b: None, params, specs,
+                           is_leaf=lambda x: isinstance(x, P))
+    return specs
+
+
+def batch_spec() -> P:
+    """Batch [B, T]: shard batch over every data-like axis and the sequence
+    dim over "seq" (context parallelism)."""
+    return P(("data", "fsdp"), "seq")
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a param pytree onto the mesh per param_specs."""
+    specs = param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)

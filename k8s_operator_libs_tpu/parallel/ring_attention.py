@@ -1,0 +1,91 @@
+"""Ring attention: sequence/context parallelism over the "seq" mesh axis.
+
+For contexts too long for one chip's HBM, the sequence dim is sharded over
+devices; each device holds a [B, T/n, H, Dh] chunk of Q/K/V. Attention then
+needs every (q-chunk, kv-chunk) pair: instead of all-gathering K/V (O(T·d)
+memory again), the K/V chunks travel the ring via ``lax.ppermute`` — at step
+s each device attends its resident Q chunk against the K/V chunk that
+originated s hops back, merging partial results with the same online-softmax
+accumulators the flash kernel uses. Communication is nearest-neighbor only,
+exactly what ICI is best at, and overlaps with the attention compute of the
+current chunk.
+
+Causality is enforced at the *chunk* level (a whole source chunk later in the
+sequence is masked) and the *element* level (diagonal chunks get the
+triangular mask), so the result is bitwise-equivalent in structure to full
+causal attention over the unsharded sequence.
+
+Usage: inside ``shard_map`` over a mesh with a "seq" axis (see
+``make_ring_attention``), with the sequence dimension sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq", causal: bool = True) -> jax.Array:
+    """Per-device body (call under shard_map). q,k,v: local chunks
+    [B, Tl, H, Dh], sequence-sharded over ``axis_name``."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tl, H, Dh = q.shape
+    scale = 1.0 / math.sqrt(Dh)
+    qf = q.astype(jnp.float32) * scale
+
+    def step(carry, s):
+        acc, m, l, kc, vc = carry
+        src = (my - s) % n  # which chunk we currently hold
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = my * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+            k_pos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+            logits = jnp.where((q_pos >= k_pos)[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * jnp.swapaxes(alpha, 1, 2) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        # pass the K/V chunk to the next device in the ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (acc_new, m_new, l_new, kc, vc), None
+
+    # pvary: the accumulators are device-varying over the seq axis (each
+    # device owns different rows) — required carry typing under shard_map
+    init = (
+        jax.lax.pvary(jnp.zeros((B, Tl, H, Dh), jnp.float32), axis_name),
+        jax.lax.pvary(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name),
+        jax.lax.pvary(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name),
+        k, v,
+    )
+    (acc, m, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    denom = jnp.swapaxes(jnp.maximum(l, 1e-30), 1, 2)  # [B, Tl, H, 1]
+    return (acc / denom).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, causal: bool = True,
+                        axis_name: str = "seq"):
+    """shard_map-wrapped ring attention over global [B, T, H, Dh] arrays with
+    T sharded over the mesh's seq axis."""
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    ))

@@ -1,0 +1,3 @@
+"""Training harness: orbax checkpoint/resume, upgrade-aware run loop."""
+
+from .harness import CheckpointingTrainer, TrainResult  # noqa: F401

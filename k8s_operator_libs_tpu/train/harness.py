@@ -1,0 +1,129 @@
+"""Upgrade-aware training harness: checkpoint, resume, drain-coordinated exit.
+
+This is the workload half of the BASELINE north star: "zero-workload-loss
+rolling libtpu upgrade ... while a JAX Llama-3-8B FSDP job checkpoint-resumes
+through the upgrade". The contract with the operator side
+(:mod:`k8s_operator_libs_tpu.upgrade`):
+
+1. the operator's ``waitForCompletion.podSelector`` matches this job's pods;
+2. when the job's slice is cordoned for upgrade, the job learns about it via
+   ``drain_signal`` (in a real pod: SIGTERM from eviction, or a watch on its
+   node's cordon status — here injectable for tests/bench);
+3. the harness saves a checkpoint *synchronously*, then exits cleanly — the
+   pod completes, the wait-for-jobs gate opens, the upgrade proceeds;
+4. after the slice returns (uncordon), the rescheduled job restores the
+   latest checkpoint and continues — downtime is checkpoint-save + restore +
+   re-warmup, not lost compute since the last periodic checkpoint.
+
+Checkpoints are orbax (async by default, so the save hides behind the next
+steps' compute; forced synchronous on drain), sharding-aware: each host
+writes its own param shards, restore re-shards to whatever mesh the resumed
+job has — the slice that comes back does not need the same device order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..models.llama import LlamaConfig
+from ..parallel.fsdp import TrainState, init_train_state, make_train_step
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: TrainState
+    steps_done: int
+    preempted: bool          # True = exited for a drain, checkpoint saved
+    last_checkpoint_step: int
+    wall_time_s: float
+
+
+class CheckpointingTrainer:
+    def __init__(self, cfg: LlamaConfig, checkpoint_dir: str,
+                 mesh=None, optimizer=None,
+                 checkpoint_interval: int = 100,
+                 keep: int = 3):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.optimizer = optimizer
+        self.checkpoint_interval = checkpoint_interval
+        self._mngr = ocp.CheckpointManager(
+            checkpoint_dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                                 create=True))
+        self._step_fn = make_train_step(cfg, optimizer, mesh)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def init_or_resume(self, rng: jax.Array) -> TrainState:
+        """Fresh init, or restore the latest checkpoint re-sharded onto this
+        job's mesh."""
+        latest = self._mngr.latest_step()
+        if latest is None:
+            logger.info("no checkpoint found, initializing from scratch")
+            return init_train_state(rng, self.cfg, self.optimizer, self.mesh)
+        logger.info("resuming from checkpoint step %d", latest)
+        # abstract target carries this run's shardings → orbax re-shards
+        fresh = init_train_state(rng, self.cfg, self.optimizer, self.mesh)
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          fresh)
+        return self._mngr.restore(latest,
+                                  args=ocp.args.StandardRestore(abstract))
+
+    def save(self, state: TrainState, wait: bool = False) -> int:
+        step = int(state.step)
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+        return step
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    # ------------------------------------------------------------ run loop
+
+    def run(self, state: TrainState, data: Iterator[Any],
+            num_steps: int,
+            drain_signal: Optional[Callable[[], bool]] = None,
+            on_step: Optional[Callable[[int, dict], None]] = None
+            ) -> TrainResult:
+        """Train until num_steps more steps are done or a drain is signalled.
+
+        Drain → synchronous checkpoint → return (preempted=True). Periodic
+        checkpoints every checkpoint_interval steps are async (orbax
+        overlaps them with compute)."""
+        t0 = time.monotonic()
+        start_step = int(state.step)
+        last_ckpt = self._mngr.latest_step() or start_step
+        done = 0
+        preempted = False
+        while done < num_steps:
+            if drain_signal is not None and drain_signal():
+                logger.info("drain signalled at step %d: checkpoint + exit",
+                            int(state.step))
+                last_ckpt = self.save(state, wait=True)
+                preempted = True
+                break
+            batch = next(data)
+            state, metrics = self._step_fn(state, batch)
+            done += 1
+            if on_step is not None:
+                on_step(int(metrics["step"]), metrics)
+            if done % self.checkpoint_interval == 0:
+                last_ckpt = self.save(state)  # async
+        return TrainResult(state=state, steps_done=done, preempted=preempted,
+                           last_checkpoint_step=last_ckpt,
+                           wall_time_s=time.monotonic() - t0)

@@ -7,11 +7,19 @@ vars must be set before jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image pins JAX_PLATFORMS=axon (one real TPU chip via tunnel) and
+# pre-imports jax from sitecustomize, so plain env overwrites are too late —
+# jax.config is the reliable switch. Tests run on the 8-device virtual CPU
+# mesh to validate multi-chip shardings without hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
