@@ -1,0 +1,40 @@
+# Build targets mirroring the reference Makefile's surface (generate / lint /
+# test / cov-report — reference Makefile:29,76-78,114-125), Python-native.
+
+PYTHON ?= python
+
+.PHONY: all test test-fast lint cov-report bench dryrun apply-crds-dry clean
+
+all: lint test
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:  ## operator-library tests only (skips slow JAX compiles)
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_jax_stack.py
+
+lint:  ## syntax + import sanity over the package (no third-party linters in image)
+	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd bench.py __graft_entry__.py
+	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
+	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
+	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
+	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
+
+cov-report:
+	$(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu --cov-report=term 2>/dev/null \
+	  || $(PYTHON) -m pytest tests/ -q  # pytest-cov not in image: fall back
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) -c \
+	  "import jax; jax.config.update('jax_platforms','cpu'); \
+	   import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+apply-crds-dry:
+	$(PYTHON) cmd/apply_crds.py --crds-dir crds --dry-run
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -rf .pytest_cache

@@ -45,6 +45,32 @@ PERIODIC_CKPT_INTERVAL_S = 600.0  # uncoordinated baseline checkpoints
 SLICE_HOSTS = 16  # v5p-64: 64 chips / 4 per host
 
 
+def _healthcheck(timeout_s: float = 120.0) -> bool:
+    """The attached TPU rides a tunnel that can wedge mid-RPC. Probe it in a
+    SUBPROCESS (a trivial jitted matmul must finish within timeout_s); on
+    failure, switch THIS process to CPU via jax.config **before** any backend
+    initializes here (updating jax_platforms after backend init is a no-op),
+    so the benchmark always produces a result."""
+    import subprocess
+
+    import jax
+
+    probe = ("import jax, jax.numpy as jnp; "
+             "y = jax.jit(lambda a: a @ a)(jnp.ones((256,256), jnp.bfloat16)); "
+             "jax.block_until_ready(y); print('ok')")
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], timeout=timeout_s,
+                             capture_output=True, text=True)
+        if out.returncode == 0 and "ok" in out.stdout:
+            return True
+    except subprocess.TimeoutExpired:
+        pass
+    print(json.dumps({"warning": "device unresponsive, falling back to CPU"}),
+          file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return False
+
+
 def measure_workload():
     """Real timings on the attached device."""
     import jax
@@ -183,6 +209,7 @@ def model_upgrade_pipeline():
 
 
 def main():
+    _healthcheck()
     workload = measure_workload()
     pipeline = model_upgrade_pipeline()
 

