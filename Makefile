@@ -3,9 +3,15 @@
 
 PYTHON ?= python
 
-.PHONY: all test test-fast lint cov-report bench dryrun apply-crds-dry clean
+.PHONY: all native test test-fast lint cov-report bench dryrun apply-crds-dry clean
 
-all: lint test
+all: lint native test
+
+native: build/libtokenloader.so  ## C++ mmap token loader
+
+build/libtokenloader.so: csrc/tokenloader.cpp
+	mkdir -p build
+	g++ -O3 -shared -fPIC -o $@ $<
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -1,0 +1,3 @@
+"""Input pipeline: native mmap token loader with prefetch."""
+
+from .loader import TokenDataset, write_token_file  # noqa: F401

@@ -1,0 +1,149 @@
+"""Autoregressive decoding with a KV cache, TPU-first.
+
+Decode is bandwidth-bound: each step streams the whole model once. The design
+keeps everything jit-friendly — static shapes (cache pre-allocated at
+``max_len``), ``lax.scan`` over decode steps, no Python in the loop — so XLA
+compiles one prefill program and one decode program, both MXU-shaped.
+
+The KV cache is a stacked pytree [L, B, max_len, KV, Dh] matching the model's
+scanned-layer layout; per decode step each layer writes one row via
+``lax.dynamic_update_slice`` and attends over the masked prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, rms_norm, rope
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # [L, B, max_len, KV, Dh]
+    v: jax.Array  # [L, B, max_len, KV, Dh]
+    length: jax.Array  # [] int32 — valid prefix length
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(KVCache, KVCache.tree_flatten,
+                                   KVCache.tree_unflatten)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> KVCache:
+    L, KV, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    dtype = dtype or cfg.dtype
+    shape = (L, batch, max_len, KV, Dh)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _attend_cached(cfg: LlamaConfig, q: jax.Array, k_cache: jax.Array,
+                   v_cache: jax.Array, q_pos: jax.Array,
+                   cache_len: jax.Array) -> jax.Array:
+    """q: [B, Tq, H, Dh] against cache [B, max_len, KV, Dh]; positions ≥
+    cache validity are masked. Returns [B, Tq, H, Dh]."""
+    H, KV = cfg.n_heads, cfg.n_kv_heads
+    if KV != H:
+        rep = H // KV
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    max_len = k_cache.shape[1]
+    k_pos = jnp.arange(max_len, dtype=jnp.int32)
+    # causal + validity: key visible iff k_pos <= q's absolute position
+    mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, max_len]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
+                    cfg: LlamaConfig) -> Tuple[jax.Array, KVCache]:
+    """Forward [B, T] starting at cache.length; appends K/V to the cache.
+    Used for both prefill (T = prompt len) and decode (T = 1)."""
+    B, T = tokens.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = cache.length + jnp.arange(T, dtype=jnp.int32)
+    pos_b = jnp.broadcast_to(positions, (B, T))
+    x = params["embed"][tokens]
+
+    def body(carry, layer_in):
+        x, = carry
+        layer, k_cache_l, v_cache_l = layer_in
+        h = rms_norm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, T, H, Dh)
+        k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
+        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+        q = rope(q, pos_b, cfg.rope_theta)
+        k = rope(k, pos_b, cfg.rope_theta)
+        k_cache_l = jax.lax.dynamic_update_slice(
+            k_cache_l, k.astype(k_cache_l.dtype), (0, cache.length, 0, 0))
+        v_cache_l = jax.lax.dynamic_update_slice(
+            v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
+        attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
+                              cache.length)
+        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+        h2 = rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu((h2 @ layer["w_gate"]).astype(jnp.float32)
+                           ).astype(h2.dtype)
+        x = x + (gate * (h2 @ layer["w_up"])) @ layer["w_down"]
+        return (x,), (k_cache_l, v_cache_l)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
+    return logits, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled decoding. prompt: [B, Tp] int32 →
+    [B, Tp + max_new_tokens]. One prefill pass + scanned single-token decode
+    steps, all inside one jit."""
+    B, Tp = prompt.shape
+    max_len = Tp + max_new_tokens
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = _forward_cached(params, prompt, cache, cfg)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits_last, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last / temperature, axis=-1).astype(jnp.int32)
+
+    first = sample(logits[:, -1], rng)
+
+    def step(carry, key):
+        tok, cache = carry
+        logits, cache = _forward_cached(params, tok[:, None], cache, cfg)
+        nxt = sample(logits[:, -1], key)
+        return (nxt, cache), tok
+
+    keys = jax.random.split(rng, max_new_tokens - 1)
+    (last, _), toks = jax.lax.scan(step, (first, cache), keys)
+    generated = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)
