@@ -140,9 +140,11 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
+def _block(cfg: LlamaConfig, attn_fn, x: jax.Array, layer: Params,
            positions: jax.Array) -> jax.Array:
-    """One decoder block (pre-norm attention + SwiGLU MLP)."""
+    """One decoder block (pre-norm attention + SwiGLU MLP). ``attn_fn`` is
+    the causal-attention primitive over [B, T, H, Dh] — the fused flash
+    kernel by default, ring attention under sequence parallelism."""
     B, T, D = x.shape
     H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -156,7 +158,7 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    attn = flash_attention(q, k, v, causal=True)
+    attn = attn_fn(q, k, v)
     x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
 
     h = rms_norm(x, layer["mlp_norm"])
@@ -165,18 +167,26 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
     return x
 
 
+def _default_attn(q, k, v):
+    return flash_attention(q, k, v, causal=True)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-            positions: Optional[jax.Array] = None) -> jax.Array:
+            positions: Optional[jax.Array] = None,
+            attn_fn=None) -> jax.Array:
     """tokens [B, T] int32 → logits [B, T, vocab] float32.
 
     Layers run under lax.scan over the stacked block weights; with
-    cfg.remat each block is rematerialized in the backward pass."""
+    cfg.remat each block is rematerialized in the backward pass. ``attn_fn``
+    overrides the attention primitive (see
+    :mod:`k8s_operator_libs_tpu.parallel.long_context`); ``positions``
+    overrides absolute positions (needed when the sequence dim is sharded)."""
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     x = params["embed"][tokens]  # [B, T, D]
 
-    block_fn = partial(_block, cfg)
+    block_fn = partial(_block, cfg, attn_fn or _default_attn)
     if cfg.remat:
         block_fn = jax.checkpoint(block_fn)
 

@@ -1,0 +1,91 @@
+"""Long-context training: the whole model under sequence parallelism.
+
+For contexts that exceed one chip's HBM (activations scale with T even under
+remat), the sequence dimension is sharded over the mesh's "seq" axis and the
+full forward runs per-device inside ``shard_map``:
+
+- embeddings / norms / MLPs are position-local → unchanged, zero comms;
+- attention is the only cross-position op → :func:`.ring_attention.
+  ring_attention` streams K/V chunks around the ICI ring with online-softmax
+  merging;
+- RoPE positions are offset by the device's chunk start;
+- the causal-LM shift crosses shard boundaries, so inputs/targets are shifted
+  *globally before sharding* (tokens [B, n·Tl + 1] → inputs/targets
+  [B, n·Tl]);
+- loss is a psum-weighted global mean; gradients of the replicated params are
+  psummed by shard_map's transpose automatically.
+
+``make_sp_train_step`` composes this with the same optimizer/TrainState as
+the FSDP path, so the harness and checkpoints are interchangeable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, forward
+from .fsdp import TrainState, default_optimizer
+from .ring_attention import ring_attention
+
+
+def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq"
+                 ) -> Callable:
+    """Returns ``loss(params, tokens)`` with tokens [B, n·Tl + 1] and the
+    model's sequence dim sharded over ``axis_name``."""
+
+    def shard_loss(params, inputs, targets):
+        # inputs/targets: local chunks [B, Tl]
+        n = jax.lax.psum(1, axis_name)
+        my = jax.lax.axis_index(axis_name)
+        B, Tl = inputs.shape
+        positions = my * Tl + jnp.broadcast_to(
+            jnp.arange(Tl, dtype=jnp.int32), (B, Tl))
+        attn = functools.partial(ring_attention, axis_name=axis_name,
+                                 causal=True)
+        logits = forward(params, inputs, cfg, positions=positions,
+                         attn_fn=attn)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        total = jax.lax.psum(jnp.sum(nll), axis_name)
+        count = jax.lax.psum(nll.size, axis_name)
+        return total / count
+
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(P(), P(None, axis_name), P(None, axis_name)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        return sharded(params, inputs, targets)
+
+    return loss
+
+
+def make_sp_train_step(cfg: LlamaConfig, mesh: Mesh,
+                       optimizer: Optional[optax.GradientTransformation] = None,
+                       axis_name: str = "seq") -> Callable:
+    """Jitted sequence-parallel ``train_step(state, tokens)`` — params
+    replicated over seq (combine with fsdp sharding on other axes via the
+    mesh), tokens [B, n·Tl + 1]."""
+    optimizer = optimizer or default_optimizer()
+    loss_fn = make_sp_loss(cfg, mesh, axis_name)
+
+    def train_step(state: TrainState, tokens: jax.Array
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   "step": state.step + 1}
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))

@@ -1,0 +1,40 @@
+"""Operator metrics over the upgrade state (reference exposes counter
+getters for operator metrics — upgrade_state.go:1034-1120; Prometheus
+registration is left to the consumer there, and here).
+
+:func:`collect` snapshots every counter for one component;
+:func:`render_prometheus` emits the text exposition format so a consumer can
+serve them from its /metrics endpoint without extra dependencies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .consts import UpgradeState
+from .upgrade_state import ClusterUpgradeState, ClusterUpgradeStateManager
+
+
+def collect(mgr: ClusterUpgradeStateManager,
+            state: ClusterUpgradeState) -> Dict[str, float]:
+    per_state = {f"nodes_in_state_{s or 'unknown'}": len(state.bucket(s))
+                 for s in UpgradeState.ALL}
+    return {
+        "total_managed_nodes": mgr.get_total_managed_nodes(state),
+        "upgrades_in_progress": mgr.get_upgrades_in_progress(state),
+        "upgrades_done": mgr.get_upgrades_done(state),
+        "upgrades_failed": mgr.get_upgrades_failed(state),
+        "upgrades_pending": mgr.get_upgrades_pending(state),
+        "unavailable_nodes": mgr.get_current_unavailable_nodes(state),
+        **per_state,
+    }
+
+
+def render_prometheus(component: str, metrics: Dict[str, float],
+                      prefix: str = "tpu_operator") -> str:
+    lines = []
+    for name, value in sorted(metrics.items()):
+        metric = f"{prefix}_{name}"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f'{metric}{{component="{component}"}} {value}')
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,55 @@
+"""Sequence-parallel (long-context) training path: loss/grad equivalence
+against the single-device reference, and convergence under training."""
+
+import numpy as np
+
+import jax
+
+from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+from k8s_operator_libs_tpu.parallel.fsdp import causal_lm_loss, init_train_state
+from k8s_operator_libs_tpu.parallel.long_context import (
+    make_sp_loss,
+    make_sp_train_step,
+)
+from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+
+CFG = LlamaConfig.tiny()
+
+
+def tokens_for(n_shards=8, local=16, batch=2, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed),
+                              (batch, n_shards * local + 1), 0, CFG.vocab_size)
+
+
+def test_sp_loss_matches_reference():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    mesh = make_mesh(fsdp=1, seq=8)
+    tokens = tokens_for()
+    l_sp = float(jax.jit(make_sp_loss(CFG, mesh))(params, tokens))
+    l_ref = float(causal_lm_loss(params, tokens, CFG))
+    assert abs(l_sp - l_ref) < 1e-3
+
+
+def test_sp_grads_match_reference():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    mesh = make_mesh(fsdp=1, seq=8)
+    tokens = tokens_for()
+    g_sp = jax.grad(make_sp_loss(CFG, mesh))(params, tokens)
+    g_ref = jax.grad(lambda p: causal_lm_loss(p, tokens, CFG))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_sp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=3e-2, atol=3e-3)
+
+
+def test_sp_training_converges():
+    mesh = make_mesh(fsdp=1, seq=8)
+    state = init_train_state(jax.random.PRNGKey(0), CFG)
+    step = make_sp_train_step(CFG, mesh)
+    tokens = tokens_for()
+    state, m0 = step(state, tokens)
+    for _ in range(4):
+        state, m = step(state, tokens)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert int(m["step"]) == 5

@@ -1,0 +1,115 @@
+"""TPUOperator reconcile-loop tests: BASELINE config-2 rolling upgrade,
+upgrade↔scheduler interplay, and the metrics exporter."""
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.tpu.operator import ManagedComponent, TPUOperator
+from k8s_operator_libs_tpu.tpu.scheduler import TPUWorkload
+from k8s_operator_libs_tpu.tpu.topology import (
+    GKE_ACCELERATOR_LABEL,
+    GKE_NODEPOOL_LABEL,
+    GKE_TOPOLOGY_LABEL,
+)
+from k8s_operator_libs_tpu.upgrade.metrics import collect, render_prometheus
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+NS = "kube-system"
+
+
+def make_operator(cluster, clock, policy=None):
+    policy = policy or DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="25%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    return TPUOperator(
+        cluster.client,
+        components=[ManagedComponent(name="libtpu", namespace=NS,
+                                     driver_labels={"app": "libtpu"},
+                                     policy=policy)],
+        recorder=cluster.recorder, clock=clock, synchronous=True)
+
+
+def setup_plain_fleet(cluster, n=4):
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels={"app": "libtpu"}, revision_hash="v1")
+    for i in range(n):
+        cluster.add_node(f"node{i}")
+        cluster.add_pod(f"libtpu-node{i}", f"node{i}", namespace=NS,
+                        owner_ds=ds, revision_hash="v1")
+    return ds
+
+
+def test_config2_four_node_rolling_upgrade(cluster, clock):
+    """BASELINE config 2: 4-node rolling driver upgrade, one node at a time,
+    driven purely through the operator's reconcile loop."""
+    setup_plain_fleet(cluster, 4)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    op = make_operator(cluster, clock)
+    keys = KeyFactory("libtpu")
+
+    max_parallel_seen = 0
+    for _ in range(60):
+        op.reconcile()
+        cluster.reconcile_daemonsets()
+        nodes = cluster.client.direct().list_nodes()
+        states = [n.metadata.labels.get(keys.state_label, "") for n in nodes]
+        in_prog = sum(1 for s in states
+                      if s not in ("", "upgrade-done", "upgrade-required"))
+        max_parallel_seen = max(max_parallel_seen, in_prog)
+        if all(s == "upgrade-done" for s in states):
+            break
+    assert all(n.metadata.labels.get(keys.state_label) == "upgrade-done"
+               for n in cluster.client.direct().list_nodes())
+    assert max_parallel_seen == 1  # maxParallelUpgrades honored
+    pods = cluster.client.direct().list_pods(namespace=NS)
+    assert sorted(p.metadata.labels["controller-revision-hash"]
+                  for p in pods) == ["v2"] * 4
+
+
+def test_workload_waits_until_slice_upgraded(cluster, clock):
+    """A slice mid-upgrade is cordoned, so placement is deferred until the
+    upgrade completes — the upgrade/scheduling interplay."""
+    labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+              GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-a"}
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels={"app": "libtpu"}, revision_hash="v1")
+    for i in range(4):
+        cluster.add_node(f"h{i}", labels=labels)
+        cluster.add_pod(f"libtpu-h{i}", f"h{i}", namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    op = make_operator(cluster, clock, DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60)))
+    op.submit(TPUWorkload(name="train", accelerator="tpu-v5-lite-podslice",
+                          topology="4x4"))
+    placed_while_cordoned = False
+    for _ in range(60):
+        op.reconcile()
+        cluster.reconcile_daemonsets()
+        cordoned = any(n.spec.unschedulable
+                       for n in cluster.client.direct().list_nodes())
+        if cordoned and op.placements:
+            placed_while_cordoned = True
+        if op.placements:
+            break
+    assert op.placements, "workload never placed"
+    assert not placed_while_cordoned
+    assert not op.pending_workloads
+
+
+def test_metrics_collect_and_render(cluster, clock, keys):
+    from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+        ClusterUpgradeStateManager)
+    ds = cluster.add_daemonset("drv", namespace=NS, labels={"app": "drv"},
+                               revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("drv-n0", "n0", namespace=NS, owner_ds=ds,
+                    revision_hash="v0")
+    mgr = ClusterUpgradeStateManager(cluster.client, keys, clock=clock,
+                                     synchronous=True)
+    state = mgr.build_state(NS, {"app": "drv"})
+    metrics = collect(mgr, state)
+    assert metrics["total_managed_nodes"] == 1
+    assert metrics["upgrades_in_progress"] == 0
+    text = render_prometheus("drv", metrics)
+    assert 'tpu_operator_total_managed_nodes{component="drv"} 1' in text
+    assert "# TYPE" in text
