@@ -1,0 +1,190 @@
+"""Mixture-of-Experts Llama variant (Mixtral-style) + expert parallelism.
+
+Second model family of the framework: the dense SwiGLU MLP is replaced by a
+top-k routed expert layer. TPU-first choices:
+
+- expert weights are STACKED on a leading [L, E, ...] axis (same scan-over-
+  layers trick as the dense model; the expert axis is additionally the unit
+  of expert-parallel sharding);
+- routing is computed densely ("dropless"): every expert runs on every token
+  and the top-k softmax gate zeroes the rest. This is exact (no capacity
+  dropping, no load-balance noise in the math) and maps onto the MXU as a
+  single batched einsum over E — the right call when E is small (8–16).
+  Capacity-based all-to-all dispatch, which wins when E is large and sparse,
+  is future work and slots in behind the same gate function;
+- a load-balancing auxiliary loss (mean gate fraction × mean router prob per
+  expert, Switch-style) keeps routing from collapsing.
+
+Expert parallelism: :func:`make_ep_loss` shards the expert axis over the
+mesh's "tensor" axis under shard_map — each device computes only its local
+experts on the (replicated) token stream and a psum merges the weighted
+outputs. EP and TP are alternatives for the innermost mesh axis, which is
+why they share it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from .llama import LlamaConfig, rms_norm, rope
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    router_aux_coef: float = 0.01
+
+    @classmethod
+    def tiny(cls, **overrides) -> "MoEConfig":
+        base = cls(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=256, max_seq_len=256, remat=False,
+                   n_experts=4, top_k=2)
+        return dataclasses.replace(base, **overrides)
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    from .llama import _init_dense
+
+    k_emb, k_blocks, k_out = jax.random.split(key, 3)
+    L, D, H, KV, Dh, F, E = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                             cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
+                             cfg.n_experts)
+
+    def stack(shape, scale_axis):
+        keys = jax.random.split(k_blocks, L)
+        return jax.vmap(lambda k: _init_dense(k, shape, scale_axis))(keys)
+
+    dt = cfg.dtype
+    return {
+        "embed": _init_dense(k_emb, (cfg.vocab_size, D), 1).astype(dt),
+        "blocks": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": stack((D, H * Dh), 0).astype(dt),
+            "wk": stack((D, KV * Dh), 0).astype(dt),
+            "wv": stack((D, KV * Dh), 0).astype(dt),
+            "wo": stack((H * Dh, D), 0).astype(dt),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            # router in fp32 for stable top-k
+            "router": stack((D, E), 0),
+            "w_gate": stack((E, D, F), 1).astype(dt),
+            "w_up": stack((E, D, F), 1).astype(dt),
+            "w_down": stack((E, F, D), 1).astype(dt),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "lm_head": _init_dense(k_out, (D, cfg.vocab_size), 0).astype(dt),
+    }
+
+
+def router_weights(h: jax.Array, router: jax.Array, top_k: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k gate. h [B,T,D], router [D,E] → (weights [B,T,E] with zeros off
+    the top-k and renormalized softmax mass on it, probs [B,T,E])."""
+    logits = (h.astype(jnp.float32) @ router)  # [B,T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, _ = jax.lax.top_k(probs, top_k)
+    thresh = top_vals[..., -1:]
+    mask = probs >= thresh
+    weights = jnp.where(mask, probs, 0.0)
+    weights = weights / jnp.maximum(
+        jnp.sum(weights, axis=-1, keepdims=True), 1e-9)
+    return weights, probs
+
+
+def moe_ffn(h: jax.Array, layer: Params, cfg: MoEConfig,
+            experts_slice: Optional[Tuple[int, int]] = None,
+            ep_axis: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+    """Dense-dispatch expert layer. Returns (out [B,T,D], aux_loss []).
+
+    ``experts_slice=(start, count)`` computes only that contiguous expert
+    range (expert parallelism). With ``ep_axis`` the partial expert outputs
+    are psummed over that mesh axis HERE — the residual stream every later
+    layer sees must be the full sum, not a local partial. The aux term stays
+    partial (it is linear; the wrapper psums it once at the end)."""
+    weights, probs = router_weights(h, layer["router"], cfg.top_k)
+    w_gate, w_up, w_down = layer["w_gate"], layer["w_up"], layer["w_down"]
+    if experts_slice is not None:
+        start, count = experts_slice
+        if w_gate.shape[0] != count:
+            # weights still hold all E experts — slice to the local range
+            # (under shard_map they arrive already local and this is skipped)
+            w_gate = jax.lax.dynamic_slice_in_dim(w_gate, start, count, 0)
+            w_up = jax.lax.dynamic_slice_in_dim(w_up, start, count, 0)
+            w_down = jax.lax.dynamic_slice_in_dim(w_down, start, count, 0)
+        weights = jax.lax.dynamic_slice_in_dim(weights, start, count, 2)
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", h, w_gate,
+                                  preferred_element_type=jnp.float32))
+    up = jnp.einsum("btd,edf->btef", h, w_up,
+                    preferred_element_type=jnp.float32)
+    per_expert = jnp.einsum("btef,efd->bted", (gate * up).astype(h.dtype),
+                            w_down)
+    out = jnp.einsum("bte,bted->btd", weights.astype(h.dtype), per_expert)
+    # Switch-style load-balance aux: E * Σ_e fraction_e · mean_prob_e
+    frac = jnp.mean((weights > 0).astype(jnp.float32), axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    if experts_slice is not None:
+        mean_prob = jax.lax.dynamic_slice_in_dim(
+            mean_prob, experts_slice[0], experts_slice[1], 0)
+    aux = cfg.n_experts * jnp.sum(frac * mean_prob)
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    return out, aux
+
+
+def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
+            positions: Optional[jax.Array] = None,
+            experts_slice: Optional[Tuple[int, int]] = None,
+            ep_axis: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+    """→ (logits [B,T,V] fp32, total aux loss []). Under expert parallelism
+    (``experts_slice`` + ``ep_axis``) each device computes its local experts
+    and the per-layer psum restores the full residual stream; the returned
+    aux is still partial (wrapper psums once). Attention is computed fully on
+    every device (cheap relative to experts at MoE scale)."""
+    B, T = tokens.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    x = params["embed"][tokens]
+
+    def block(x, layer):
+        h = rms_norm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, T, H, Dh)
+        k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
+        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = flash_attention(q, k, v, causal=True)
+        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+        h2 = rms_norm(x, layer["mlp_norm"])
+        moe_out, aux = moe_ffn(h2, layer, cfg, experts_slice, ep_axis)
+        return x + moe_out, aux
+
+    block_fn = jax.checkpoint(block) if cfg.remat else block
+
+    def scan_body(carry, layer):
+        x, aux_total = carry
+        x, aux = block_fn(x, layer)
+        return (x, aux_total + aux), None
+
+    aux_init = jnp.zeros((), jnp.float32)
+    if ep_axis is not None:
+        # the aux accumulator is device-varying (local experts only) — the
+        # scan carry must be typed accordingly under shard_map
+        aux_init = jax.lax.pvary(aux_init, ep_axis)
+    (x, aux_total), _ = jax.lax.scan(
+        scan_body, (x, aux_init), params["blocks"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32), aux_total

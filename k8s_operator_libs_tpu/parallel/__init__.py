@@ -5,3 +5,5 @@ from .mesh import batch_spec, make_mesh, param_specs  # noqa: F401
 from .fsdp import TrainState, init_train_state, make_train_step  # noqa: F401
 from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from .long_context import make_sp_loss, make_sp_train_step  # noqa: F401
+from .pipeline import make_pp_loss, make_pp_train_step  # noqa: F401
+from .expert import make_ep_loss, make_ep_train_step  # noqa: F401

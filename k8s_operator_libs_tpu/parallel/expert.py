@@ -1,0 +1,103 @@
+"""Expert parallelism: MoE expert weights sharded over the "tensor" axis.
+
+Each device holds E/n contiguous experts of every layer (the [L, E, ...]
+stacks shard on their expert axis), computes only those experts on the full
+token stream, and a per-layer psum (inside moe_ffn) restores the full
+residual stream. The router stays replicated — routing decisions are global.
+
+EP and TP are alternatives for the innermost mesh axis; they share "tensor".
+Token all-to-all dispatch (beats broadcast-compute when E is large and the
+batch is big) is future work behind the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.moe import MoEConfig, forward
+from .fsdp import TrainState, default_optimizer
+
+AXIS = "tensor"
+
+
+def ep_param_specs() -> Dict:
+    blocks = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, None), "wk": P(None, None, None),
+        "wv": P(None, None, None), "wo": P(None, None, None),
+        "mlp_norm": P(None, None),
+        "router": P(None, None, None),
+        "w_gate": P(None, AXIS, None, None),
+        "w_up": P(None, AXIS, None, None),
+        "w_down": P(None, AXIS, None, None),
+    }
+    return {"embed": P(None, None), "blocks": blocks,
+            "final_norm": P(None), "lm_head": P(None, None)}
+
+
+def make_ep_loss(cfg: MoEConfig, mesh: Mesh) -> Callable:
+    """Returns ``loss(params, tokens)`` with the expert axis sharded over
+    the mesh's tensor axis; tokens [B, T+1] replicated."""
+    n = mesh.shape[AXIS]
+    if cfg.n_experts % n:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
+                         f"{n}-way expert parallelism")
+    local_e = cfg.n_experts // n
+
+    def shard_loss(params, inputs, targets):
+        start = jax.lax.axis_index(AXIS) * local_e
+        logits, aux_partial = forward(params, inputs, cfg,
+                                      experts_slice=(start, local_e),
+                                      ep_axis=AXIS)
+        aux = jax.lax.psum(aux_partial, AXIS)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll) + cfg.router_aux_coef * aux
+
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(ep_param_specs(), P(None, None), P(None, None)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        return sharded(params, tokens[:, :-1], tokens[:, 1:])
+
+    return loss
+
+
+def moe_reference_loss(cfg: MoEConfig) -> Callable:
+    """Single-device reference: full dense-dispatch loss (for tests)."""
+
+    def loss(params, tokens):
+        logits, aux = forward(params, tokens[:, :-1], cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, tokens[:, 1:][..., None], axis=-1)[..., 0]
+        return jnp.mean(nll) + cfg.router_aux_coef * aux
+
+    return loss
+
+
+def make_ep_train_step(cfg: MoEConfig, mesh: Mesh,
+                       optimizer: Optional[optax.GradientTransformation] = None
+                       ) -> Callable:
+    optimizer = optimizer or default_optimizer()
+    loss_fn = make_ep_loss(cfg, mesh)
+
+    def train_step(state: TrainState, tokens: jax.Array
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   "step": state.step + 1}
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))

@@ -27,22 +27,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "seq", "tensor")
+AXES = ("stage", "data", "fsdp", "seq", "tensor")
 
 
 def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
-              tensor: int = 1, devices=None) -> Mesh:
-    """Build a (data, fsdp, seq, tensor) mesh. ``fsdp=None`` absorbs all
-    remaining devices (the common pure-FSDP case, e.g. Llama-3-8B on a
-    v5p-64: fsdp=64)."""
+              tensor: int = 1, stage: int = 1, devices=None) -> Mesh:
+    """Build a (stage, data, fsdp, seq, tensor) mesh. ``fsdp=None`` absorbs
+    all remaining devices (the common pure-FSDP case, e.g. Llama-3-8B on a
+    v5p-64: fsdp=64). ``stage`` is the pipeline-parallel axis (outermost:
+    stages exchange only boundary activations, the least ICI-hungry
+    traffic); ``tensor`` is innermost (per-block all-reduces ride
+    nearest-neighbor links)."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if fsdp is None:
-        denom = data * seq * tensor
+        denom = data * seq * tensor * stage
         if n % denom:
             raise ValueError(f"{n} devices not divisible by {denom}")
         fsdp = n // denom
-    shape = (data, fsdp, seq, tensor)
+    shape = (stage, data, fsdp, seq, tensor)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh {shape} needs {np.prod(shape)} devices, have {n}")
     return Mesh(np.asarray(devices).reshape(shape), AXES)

@@ -1,0 +1,158 @@
+"""Pipeline parallelism: the scanned layer stack sharded over "stage".
+
+The model's per-layer weights are already STACKED on a leading [L, ...] axis
+for ``lax.scan`` (models/llama.py) — pipeline parallelism falls out of
+sharding exactly that axis over the "stage" mesh axis: each stage holds L/S
+contiguous layers and runs the same scan over its local shard.
+
+Schedule: GPipe. The global batch splits into M microbatches; at pipeline
+tick t, stage s processes microbatch (t - s), boundary activations hop to
+the next stage via ``lax.ppermute`` (nearest-neighbor ICI traffic only).
+The whole schedule is one ``lax.scan`` over S + M - 1 ticks inside
+``shard_map``; jax autodiff transposes it into the backward pipeline
+(reverse ppermute) automatically — no hand-written backward schedule.
+
+Embedding/lm_head/norms are replicated across stages in this r1 design
+(stage 0 embeds, stage S-1 projects + computes the masked loss; the psum in
+the loss and shard_map's transpose give every stage its correct grads).
+
+Bubble fraction is (S-1)/(S-1+M): choose M ≥ 4·S for >80% utilization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, _block, _default_attn, rms_norm
+from .fsdp import TrainState, default_optimizer
+
+AXIS = "stage"
+
+
+def pp_param_specs(params) -> Dict:
+    """PartitionSpecs for pipeline parallelism: block stacks sharded over
+    "stage" on the layer axis; everything else replicated (combine with
+    fsdp/tensor specs on other axes for 3-D parallelism in later rounds)."""
+    blocks = {k: P(AXIS) if v.ndim == 2 else P(AXIS, None, None)
+              for k, v in params["blocks"].items()}
+    return {
+        "embed": P(None, None),
+        "blocks": blocks,
+        "final_norm": P(None),
+        "lm_head": P(None, None),
+    }
+
+
+def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
+                 ) -> Callable:
+    """Returns ``loss(params, tokens)`` with tokens [B, T+1]; B must divide
+    by num_microbatches."""
+    S = mesh.shape[AXIS]
+    M = num_microbatches
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                         f"{S} stages")
+
+    def stage_apply(blocks_local, x, positions):
+        """Run this stage's local layers over activation x [Bm, T, D]."""
+        block_fn = functools.partial(_block, cfg, _default_attn)
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+
+        def body(carry, layer):
+            return block_fn(carry, layer, positions), None
+
+        x, _ = jax.lax.scan(body, x, blocks_local)
+        return x
+
+    def shard_loss(params, inputs, targets):
+        # replicated inputs [B, T]; every stage sees the full batch and
+        # selects microbatches by index
+        s = jax.lax.axis_index(AXIS)
+        B, T = inputs.shape
+        Bm = B // M
+        D = cfg.d_model
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
+        dtype = params["embed"].dtype
+
+        def embed_mb(m):
+            mb = jax.lax.dynamic_slice_in_dim(inputs, m * Bm, Bm, axis=0)
+            return params["embed"][mb]
+
+        n_ticks = S + M - 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            x_cur, total, count = carry
+            # stage 0 ingests microbatch t (if still in range)
+            m_in = jnp.clip(t, 0, M - 1)
+            fresh = embed_mb(m_in)
+            x_cur = jnp.where(s == 0, fresh, x_cur)
+            # every stage applies its local layers
+            y = stage_apply(params["blocks"], x_cur, positions)
+            # last stage: if its current microbatch m = t - (S-1) is valid,
+            # project to logits and accumulate masked loss
+            m_out = t - (S - 1)
+            valid = jnp.logical_and(s == S - 1,
+                                    jnp.logical_and(m_out >= 0, m_out < M))
+            h = rms_norm(y, params["final_norm"])
+            logits = (h @ params["lm_head"]).astype(jnp.float32)
+            mb_t = jax.lax.dynamic_slice_in_dim(
+                targets, jnp.clip(m_out, 0, M - 1) * Bm, Bm, axis=0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, mb_t[..., None], axis=-1)[..., 0]
+            total = total + jnp.where(valid, jnp.sum(nll), 0.0)
+            count = count + jnp.where(valid, nll.size, 0)
+            # boundary activations hop to the next stage
+            x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
+            return (x_nxt, total, count), None
+
+        init = (jax.lax.pvary(jnp.zeros((Bm, T, D), dtype), AXIS),
+                jax.lax.pvary(jnp.zeros((), jnp.float32), AXIS),
+                jax.lax.pvary(jnp.zeros((), jnp.int32), AXIS))
+        (_, total, count), _ = jax.lax.scan(tick, init,
+                                            jnp.arange(n_ticks))
+        return jax.lax.psum(total, AXIS) / jax.lax.psum(count, AXIS)
+
+    block_spec = {k: (P(AXIS) if k.endswith("norm") else P(AXIS, None, None))
+                  for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                            "w_gate", "w_up", "w_down")}
+    param_spec = {"embed": P(None, None), "blocks": block_spec,
+                  "final_norm": P(None), "lm_head": P(None, None)}
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(param_spec, P(None, None), P(None, None)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        return sharded(params, tokens[:, :-1], tokens[:, 1:])
+
+    return loss
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
+                       num_microbatches: int = 4,
+                       optimizer: Optional[optax.GradientTransformation] = None
+                       ) -> Callable:
+    """Jitted pipeline-parallel ``train_step(state, tokens)``."""
+    optimizer = optimizer or default_optimizer()
+    loss_fn = make_pp_loss(cfg, mesh, num_microbatches)
+
+    def train_step(state: TrainState, tokens: jax.Array
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   "step": state.step + 1}
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))
