@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Training CLI: the workload a tpu-operator schedules onto a slice.
+
+Ties the framework's workload pieces together end-to-end:
+TokenDataset (native loader) → mesh + parallel train step (fsdp / sp / pp /
+ep) → CheckpointingTrainer (orbax, drain-coordinated exit on SIGTERM).
+
+In a pod, kubelet's SIGTERM during eviction/drain triggers the synchronous
+checkpoint + clean exit; on reschedule the same command resumes from the
+latest checkpoint (see docs/automatic-libtpu-upgrade.md).
+
+Example:
+    python cmd/train.py --data tokens.bin --ckpt /ckpt/run1 \
+        --model tiny --parallel fsdp --steps 1000 --batch 8 --seq 256
+"""
+
+import argparse
+import signal
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", required=True, help="token file (TOKS format)")
+    p.add_argument("--ckpt", required=True, help="checkpoint directory")
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "small", "llama3_8b", "moe_tiny"])
+    p.add_argument("--parallel", default="fsdp",
+                   choices=["none", "fsdp", "sp", "pp"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--ckpt-interval", type=int, default=50)
+    p.add_argument("--lr", type=float, default=3e-4)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.data import TokenDataset
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.parallel.fsdp import default_optimizer
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+    from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
+
+    cfg = {"tiny": LlamaConfig.tiny, "small": LlamaConfig.small,
+           "llama3_8b": LlamaConfig.llama3_8b}.get(args.model)
+    if cfg is None:
+        from k8s_operator_libs_tpu.models.moe import MoEConfig
+        cfg = MoEConfig.tiny
+    cfg = cfg(max_seq_len=args.seq)
+
+    mesh = None
+    if args.parallel == "fsdp" and len(jax.devices()) > 1:
+        mesh = make_mesh()
+    trainer = CheckpointingTrainer(cfg, args.ckpt, mesh=mesh,
+                                   optimizer=default_optimizer(args.lr),
+                                   checkpoint_interval=args.ckpt_interval)
+    state = trainer.init_or_resume(jax.random.PRNGKey(0))
+    start_step = int(state.step)
+
+    # drain coordination: SIGTERM (kubelet eviction) → checkpoint + exit
+    draining = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *_: draining.update(flag=True))
+
+    ds = TokenDataset(args.data)
+
+    def batches():
+        for arr in ds.batches(args.batch, args.seq + 1):
+            yield jnp.asarray(arr)
+
+    def on_step(step, metrics):
+        if step % 10 == 0:
+            print(f"step {step} loss {float(metrics['loss']):.4f}",
+                  flush=True)
+
+    result = trainer.run(state, batches(), num_steps=args.steps - start_step,
+                         drain_signal=lambda: draining["flag"],
+                         on_step=on_step)
+    trainer.close()
+    ds.close()
+    if result.preempted:
+        print(f"preempted at step {int(result.state.step)}; checkpoint "
+              f"{result.last_checkpoint_step} saved — exiting for upgrade")
+        return 0
+    print(f"done: {result.steps_done} steps in {result.wall_time_s:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
