@@ -21,6 +21,94 @@ import sys
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 
+def build_parallel(cfg, args, optimizer):
+    """Wire --model × --parallel to the right mesh + train-step + state-init
+    triple. MoE trains dense-dispatch on one device (--parallel none) or
+    expert-parallel (--parallel ep); Llama configs take fsdp / sp / pp."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+
+    is_moe = args.model == "moe_tiny"
+    n = len(jax.devices())
+
+    def llama_init(rng, mesh):
+        from k8s_operator_libs_tpu.models.llama import init_params
+        from k8s_operator_libs_tpu.parallel.fsdp import TrainState
+        params = init_params(rng, cfg)
+        return TrainState(params=params, opt_state=optimizer.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    if is_moe:
+        from k8s_operator_libs_tpu.models.moe import init_params as moe_init
+        from k8s_operator_libs_tpu.parallel.expert import (
+            make_ep_train_step, moe_reference_loss)
+        from k8s_operator_libs_tpu.parallel.fsdp import TrainState
+
+        def init_fn(rng):
+            params = moe_init(rng, cfg)
+            return TrainState(params=params,
+                              opt_state=optimizer.init(params),
+                              step=jnp.zeros((), jnp.int32))
+
+        if args.parallel == "ep" and n > 1:
+            t = math.gcd(n, cfg.n_experts)
+            mesh = make_mesh(tensor=t, fsdp=1, devices=jax.devices()[:t])
+            return mesh, make_ep_train_step(cfg, mesh, optimizer), init_fn
+        if args.parallel not in ("none", "ep"):
+            raise SystemExit(f"--model moe_tiny supports --parallel none|ep, "
+                             f"not {args.parallel}")
+        import optax
+
+        loss_fn = moe_reference_loss(cfg)
+
+        def dense_step(state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+            updates, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return (TrainState(params=new_params, opt_state=new_opt,
+                               step=state.step + 1),
+                    {"loss": loss, "grad_norm": optax.global_norm(grads),
+                     "step": state.step + 1})
+
+        return None, jax.jit(dense_step, donate_argnums=(0,)), init_fn
+
+    if args.parallel == "fsdp" and n > 1:
+        mesh = make_mesh()
+        if args.batch % n:
+            raise SystemExit(f"--batch {args.batch} must be divisible by "
+                             f"the {n}-way data·fsdp mesh")
+        return mesh, None, None  # harness defaults: FSDP step + sharded init
+    if args.parallel == "sp" and n > 1:
+        from k8s_operator_libs_tpu.parallel.long_context import (
+            make_sp_train_step)
+        mesh = make_mesh(seq=n, fsdp=1)
+        return (mesh, make_sp_train_step(cfg, mesh, optimizer),
+                lambda rng: llama_init(rng, mesh))
+    if args.parallel == "pp" and n > 1:
+        from k8s_operator_libs_tpu.parallel.pipeline import make_pp_train_step
+        s = math.gcd(n, cfg.n_layers)
+        if s < 2:
+            raise SystemExit(f"pipeline needs gcd(devices={n}, "
+                             f"layers={cfg.n_layers}) ≥ 2")
+        mesh = make_mesh(stage=s, fsdp=1, devices=jax.devices()[:s])
+        if args.batch % 4 == 0:
+            micro = 4
+        elif args.batch % 2 == 0:
+            micro = 2
+        else:
+            raise SystemExit("--batch must be divisible by 2 for pp")
+        return (mesh, make_pp_train_step(cfg, mesh, micro, optimizer),
+                lambda rng: llama_init(rng, mesh))
+    if args.parallel == "ep":
+        raise SystemExit("--parallel ep requires --model moe_tiny")
+    return None, None, None  # single device: plain jitted llama step
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="token file (TOKS format)")
@@ -28,7 +116,7 @@ def main(argv=None) -> int:
     p.add_argument("--model", default="tiny",
                    choices=["tiny", "small", "llama3_8b", "moe_tiny"])
     p.add_argument("--parallel", default="fsdp",
-                   choices=["none", "fsdp", "sp", "pp"])
+                   choices=["none", "fsdp", "sp", "pp", "ep"])
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
@@ -42,7 +130,6 @@ def main(argv=None) -> int:
     from k8s_operator_libs_tpu.data import TokenDataset
     from k8s_operator_libs_tpu.models.llama import LlamaConfig
     from k8s_operator_libs_tpu.parallel.fsdp import default_optimizer
-    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
     from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
 
     cfg = {"tiny": LlamaConfig.tiny, "small": LlamaConfig.small,
@@ -52,12 +139,12 @@ def main(argv=None) -> int:
         cfg = MoEConfig.tiny
     cfg = cfg(max_seq_len=args.seq)
 
-    mesh = None
-    if args.parallel == "fsdp" and len(jax.devices()) > 1:
-        mesh = make_mesh()
+    optimizer = default_optimizer(args.lr)
+    mesh, step_fn, init_fn = build_parallel(cfg, args, optimizer)
     trainer = CheckpointingTrainer(cfg, args.ckpt, mesh=mesh,
-                                   optimizer=default_optimizer(args.lr),
-                                   checkpoint_interval=args.ckpt_interval)
+                                   optimizer=optimizer,
+                                   checkpoint_interval=args.ckpt_interval,
+                                   step_fn=step_fn, init_fn=init_fn)
     state = trainer.init_or_resume(jax.random.PRNGKey(0))
     start_step = int(state.step)
 

@@ -7,15 +7,18 @@ traffic. The flash kernel streams K/V through VMEM in blocks, keeping the
 online-softmax running max/denominator in fp32 loop carries and writing only
 the [T, head_dim] output, so HBM traffic drops from O(T²) to O(T·d).
 
-Forward is the Pallas kernel; backward (training) uses a custom_vjp that
-recomputes gradients through the reference path — a deliberate r1 trade:
-numerically exact, and under ``jax.checkpoint`` the recompute happens anyway;
-a flash-bwd kernel is future work.
+Forward and backward are both Pallas kernels. The forward emits the
+per-row logsumexp alongside the output; the backward recomputes probability
+blocks from (q, k, lse) on the fly — two kernels, one gridded over q-blocks
+(dq) and one over k-blocks (dk/dv), each with fp32 accumulators — so the
+[T, T] matrix is never materialized in HBM in either direction.
 
 Dispatch rules (shape + platform gates, decided at trace time):
 - TPU backend, head_dim a multiple of 128, seq a multiple of the 128-row
-  q-block → Pallas kernel;
+  q-block → Pallas kernels;
 - anything else (CPU tests on the virtual mesh, tiny toy heads) → reference.
+Set ``INTERPRET = True`` to run the kernels in Pallas interpret mode on any
+backend (used by the CPU equivalence tests).
 """
 
 from __future__ import annotations
@@ -29,6 +32,10 @@ import jax.numpy as jnp
 Q_BLOCK = 128
 K_BLOCK = 128
 NEG_INF = -1e30
+
+# Run pallas kernels in interpret mode (any backend). Tests flip this to
+# exercise the real kernel logic without TPU hardware.
+INTERPRET = False
 
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -48,9 +55,12 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ------------------------------------------------------------- pallas kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len: int, causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, seq_len: int,
+                  causal: bool):
     """One (batch·head, q-block) program: stream K/V blocks with online
-    softmax. Block shapes: q/o [1, Q_BLOCK, Dh]; k/v [1, T, Dh]."""
+    softmax. Block shapes: q/o [1, Q_BLOCK, Dh]; k/v [1, T, Dh];
+    lse [1, Q_BLOCK] (per-row logsumexp of the scaled scores, saved for the
+    backward kernels)."""
     import jax.experimental.pallas as pl
 
     iq = pl.program_id(1)
@@ -87,24 +97,35 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len: int, causal: bool):
             jnp.full((Q_BLOCK, 1), NEG_INF, jnp.float32),
             jnp.zeros((Q_BLOCK, 1), jnp.float32))
     acc, m, l = jax.lax.fori_loop(0, kb_hi, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [Bq, 1] per-row logsumexp
+
+
+def _fold(x):  # [B, T, H, Dh] → [B·H, T, Dh]
+    B, T, H, Dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
+
+
+def _unfold(x, B, H):  # [B·H, T, Dh] → [B, T, H, Dh]
+    _, T, Dh = x.shape
+    return x.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
-                   causal: bool) -> jax.Array:
-    """q,k,v: [B, T, H, Dh] → [B, T, H, Dh] via pallas_call over a
-    (B·H, T//Q_BLOCK) grid. Full K/V per head rides VMEM (≤4 MB at 8k·128
-    bf16), streamed blockwise inside the kernel."""
+                   causal: bool):
+    """q,k,v: [B, T, H, Dh] → (out [B, T, H, Dh], lse [B·H, T, 1]) via
+    pallas_call over a (B·H, T//Q_BLOCK) grid. Full K/V per head rides VMEM
+    (≤4 MB at 8k·128 bf16), streamed blockwise inside the kernel. The lse
+    residual is a column vector — block (1, Q_BLOCK, 1) lowers because the
+    minor block dim equals the array's minor dim."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, Dh = q.shape
 
-    def fold(x):  # [B, T, H, Dh] → [B·H, T, Dh]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
-
     kernel = functools.partial(_flash_kernel, seq_len=T, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // Q_BLOCK),
         in_specs=[
@@ -115,11 +136,153 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, T, Dh), lambda bh, iq: (bh, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, Q_BLOCK, Dh), lambda bh, iq: (bh, iq, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, Q_BLOCK, Dh), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Q_BLOCK, 1), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(_fold(q), _fold(k), _fold(v))
+    return _unfold(out, B, H), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, seq_len: int, causal: bool):
+    """dq for one (batch·head, q-block) program. Recomputes probability
+    blocks from (q, k, lse); delta = rowsum(dO ⊙ O) is precomputed outside.
+    Block shapes: q/do/dq [1, Q_BLOCK, Dh]; k/v [1, T, Dh];
+    lse/delta [1, Q_BLOCK, 1] (per-row scalars as column vectors)."""
+    import jax.experimental.pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # [Bq, Dh]
+    do = do_ref[0].astype(jnp.float32)          # [Bq, Dh]
+    lse = lse_ref[0]                            # [Bq, 1]
+    delta = delta_ref[0]                        # [Bq, 1]
+    Dh = q.shape[-1]
+    scale = 1.0 / math.sqrt(Dh)
+
+    n_kb = seq_len // K_BLOCK
+    kb_hi = jnp.minimum(n_kb, (iq + 1) * Q_BLOCK // K_BLOCK) if causal else n_kb
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = iq * Q_BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (Q_BLOCK, K_BLOCK), 0)
+            k_pos = kb * K_BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (Q_BLOCK, K_BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                     # [Bq, Kb]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kb_hi, body,
+                           jnp.zeros((Q_BLOCK, Dh), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, seq_len: int, causal: bool):
+    """dk/dv for one (batch·head, k-block) program: stream q-blocks.
+    Block shapes: k/v/dk/dv [1, K_BLOCK, Dh]; q/do [1, T, Dh];
+    lse/delta [1, T, 1] (per-row scalars as column vectors)."""
+    import jax.experimental.pallas as pl
+
+    ik = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)            # [Bk, Dh]
+    v = v_ref[0].astype(jnp.float32)            # [Bk, Dh]
+    Dh = k.shape[-1]
+    scale = 1.0 / math.sqrt(Dh)
+
+    n_qb = seq_len // Q_BLOCK
+    # causal: only q-blocks at or after this k-block's rows contribute
+    qb_lo = (ik * K_BLOCK) // Q_BLOCK if causal else 0
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :]
+        delta_blk = delta_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * Q_BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (Q_BLOCK, K_BLOCK), 0)
+            k_pos = ik * K_BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (Q_BLOCK, K_BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)                                 # [Bq, Bk]
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [Bk, Dh]
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)                                # [Bq, Bk]
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [Bk, Dh]
+        return dk_new, dv_new
+
+    init = (jnp.zeros((K_BLOCK, Dh), jnp.float32),
+            jnp.zeros((K_BLOCK, Dh), jnp.float32))
+    dk, dv = jax.lax.fori_loop(qb_lo, n_qb, body, init)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal):
+    """Flash backward over folded [B·H, T, Dh] tensors; returns dq, dk, dv
+    in the original [B, T, H, Dh] layout."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, Dh = q.shape
+    qf, kf, vf, of, gf = map(_fold, (q, k, v, o, g))
+    # delta[i] = Σ_d dO[i,d]·O[i,d] — cheap elementwise reduce, XLA fuses it
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B·H, T, 1]
+
+    qblk = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    full3 = qblk((1, T, Dh), lambda bh, i: (bh, 0, 0))
+    full2 = qblk((1, T, 1), lambda bh, i: (bh, 0, 0))
+    qb3 = qblk((1, Q_BLOCK, Dh), lambda bh, i: (bh, i, 0))
+    qb2 = qblk((1, Q_BLOCK, 1), lambda bh, i: (bh, i, 0))
+    kb3 = qblk((1, K_BLOCK, Dh), lambda bh, i: (bh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, seq_len=T, causal=causal),
+        grid=(B * H, T // Q_BLOCK),
+        in_specs=[qb3, full3, full3, qb3, qb2, qb2],
+        out_specs=qb3,
         out_shape=jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
-    )(fold(q), fold(k), fold(v))
-    return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
+        interpret=INTERPRET,
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, seq_len=T, causal=causal),
+        grid=(B * H, T // K_BLOCK),
+        in_specs=[full3, kb3, kb3, full3, full2, full2],
+        out_specs=[kb3, kb3],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, Dh), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, Dh), v.dtype)],
+        interpret=INTERPRET,
+    )(qf, kf, vf, gf, lse, delta)
+
+    return (_unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H))
 
 
 # --------------------------------------------------------------- dispatch
@@ -134,18 +297,17 @@ def _use_pallas(q: jax.Array) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention(q, k, v, causal):
-    return _flash_forward(q, k, v, causal)
+    return _flash_forward(q, k, v, causal)[0]
 
 
 def _flash_fwd_rule(q, k, v, causal):
-    return _flash_forward(q, k, v, causal), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -50,7 +50,13 @@ class CheckpointingTrainer:
     def __init__(self, cfg: LlamaConfig, checkpoint_dir: str,
                  mesh=None, optimizer=None,
                  checkpoint_interval: int = 100,
-                 keep: int = 3):
+                 keep: int = 3,
+                 step_fn: Optional[Callable] = None,
+                 init_fn: Optional[Callable] = None):
+        """``step_fn(state, batch) -> (state, metrics)`` and
+        ``init_fn(rng) -> TrainState`` default to the Llama FSDP pair; pass
+        both to train another model family (MoE) or parallelism (sp/pp/ep)
+        through the same checkpoint/drain machinery."""
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
@@ -59,7 +65,10 @@ class CheckpointingTrainer:
             checkpoint_dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                                  create=True))
-        self._step_fn = make_train_step(cfg, optimizer, mesh)
+        self._step_fn = step_fn or make_train_step(cfg, optimizer, mesh)
+        self._init_fn = init_fn or (
+            lambda rng: init_train_state(rng, self.cfg, self.optimizer,
+                                         self.mesh))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -69,10 +78,10 @@ class CheckpointingTrainer:
         latest = self._mngr.latest_step()
         if latest is None:
             logger.info("no checkpoint found, initializing from scratch")
-            return init_train_state(rng, self.cfg, self.optimizer, self.mesh)
+            return self._init_fn(rng)
         logger.info("resuming from checkpoint step %d", latest)
         # abstract target carries this run's shardings → orbax re-shards
-        fresh = init_train_state(rng, self.cfg, self.optimizer, self.mesh)
+        fresh = self._init_fn(rng)
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           fresh)
         return self._mngr.restore(latest,
