@@ -35,7 +35,7 @@ def build_parallel(cfg, args, optimizer):
     is_moe = args.model == "moe_tiny"
     n = len(jax.devices())
 
-    def llama_init(rng, mesh):
+    def llama_init(rng):
         from k8s_operator_libs_tpu.models.llama import init_params
         from k8s_operator_libs_tpu.parallel.fsdp import TrainState
         params = init_params(rng, cfg)
@@ -45,7 +45,8 @@ def build_parallel(cfg, args, optimizer):
     if is_moe:
         from k8s_operator_libs_tpu.models.moe import init_params as moe_init
         from k8s_operator_libs_tpu.parallel.expert import (
-            make_ep_train_step, moe_reference_loss)
+            make_ep_train_step, make_train_step_from_loss,
+            moe_reference_loss)
         from k8s_operator_libs_tpu.parallel.fsdp import TrainState
 
         def init_fn(rng):
@@ -56,26 +57,25 @@ def build_parallel(cfg, args, optimizer):
 
         if args.parallel == "ep" and n > 1:
             t = math.gcd(n, cfg.n_experts)
+            if t < 2:
+                raise SystemExit(f"expert parallelism needs gcd(devices={n}, "
+                                 f"experts={cfg.n_experts}) ≥ 2")
+            if t < n:
+                print(f"ep: using {t} of {n} devices "
+                      f"(gcd with {cfg.n_experts} experts)", flush=True)
+            if args.moe_dispatch == "a2a" and args.batch % t:
+                raise SystemExit(f"--batch {args.batch} must be divisible by "
+                                 f"the {t}-way mesh for a2a dispatch")
             mesh = make_mesh(tensor=t, fsdp=1, devices=jax.devices()[:t])
-            return mesh, make_ep_train_step(cfg, mesh, optimizer), init_fn
+            step = make_ep_train_step(cfg, mesh, optimizer,
+                                      dispatch=args.moe_dispatch)
+            return mesh, step, init_fn
         if args.parallel not in ("none", "ep"):
             raise SystemExit(f"--model moe_tiny supports --parallel none|ep, "
                              f"not {args.parallel}")
-        import optax
-
-        loss_fn = moe_reference_loss(cfg)
-
-        def dense_step(state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
-            updates, new_opt = optimizer.update(grads, state.opt_state,
-                                               state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            return (TrainState(params=new_params, opt_state=new_opt,
-                               step=state.step + 1),
-                    {"loss": loss, "grad_norm": optax.global_norm(grads),
-                     "step": state.step + 1})
-
-        return None, jax.jit(dense_step, donate_argnums=(0,)), init_fn
+        return (None,
+                make_train_step_from_loss(moe_reference_loss(cfg), optimizer),
+                init_fn)
 
     if args.parallel == "fsdp" and n > 1:
         mesh = make_mesh()
@@ -86,9 +86,11 @@ def build_parallel(cfg, args, optimizer):
     if args.parallel == "sp" and n > 1:
         from k8s_operator_libs_tpu.parallel.long_context import (
             make_sp_train_step)
+        if args.seq % n:
+            raise SystemExit(f"--seq {args.seq} must be divisible by the "
+                             f"{n}-way seq mesh")
         mesh = make_mesh(seq=n, fsdp=1)
-        return (mesh, make_sp_train_step(cfg, mesh, optimizer),
-                lambda rng: llama_init(rng, mesh))
+        return mesh, make_sp_train_step(cfg, mesh, optimizer), llama_init
     if args.parallel == "pp" and n > 1:
         from k8s_operator_libs_tpu.parallel.pipeline import make_pp_train_step
         s = math.gcd(n, cfg.n_layers)
@@ -102,8 +104,7 @@ def build_parallel(cfg, args, optimizer):
             micro = 2
         else:
             raise SystemExit("--batch must be divisible by 2 for pp")
-        return (mesh, make_pp_train_step(cfg, mesh, micro, optimizer),
-                lambda rng: llama_init(rng, mesh))
+        return mesh, make_pp_train_step(cfg, mesh, micro, optimizer), llama_init
     if args.parallel == "ep":
         raise SystemExit("--parallel ep requires --model moe_tiny")
     return None, None, None  # single device: plain jitted llama step
@@ -117,6 +118,10 @@ def main(argv=None) -> int:
                    choices=["tiny", "small", "llama3_8b", "moe_tiny"])
     p.add_argument("--parallel", default="fsdp",
                    choices=["none", "fsdp", "sp", "pp", "ep"])
+    p.add_argument("--moe-dispatch", default="dense",
+                   choices=["dense", "a2a"],
+                   help="EP dispatch: dense (replicated tokens) or "
+                        "capacity-based all-to-all")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)

@@ -6,12 +6,13 @@ top-k routed expert layer. TPU-first choices:
 - expert weights are STACKED on a leading [L, E, ...] axis (same scan-over-
   layers trick as the dense model; the expert axis is additionally the unit
   of expert-parallel sharding);
-- routing is computed densely ("dropless"): every expert runs on every token
-  and the top-k softmax gate zeroes the rest. This is exact (no capacity
-  dropping, no load-balance noise in the math) and maps onto the MXU as a
-  single batched einsum over E — the right call when E is small (8–16).
-  Capacity-based all-to-all dispatch, which wins when E is large and sparse,
-  is future work and slots in behind the same gate function;
+- two dispatch strategies share one gate function (:func:`router_weights`):
+  dense "dropless" dispatch (:func:`moe_ffn` — every expert runs on every
+  token, the top-k softmax gate zeroes the rest; exact, MXU-friendly batched
+  einsum over E, right when E is small) and capacity-based all-to-all
+  dispatch (:func:`moe_ffn_a2a` — tokens batch-sharded, capacity-bounded
+  buffers travel to their experts over ICI; FLOPs scale with top_k/E, right
+  when E is large);
 - a load-balancing auxiliary loss (mean gate fraction × mean router prob per
   expert, Switch-style) keeps routing from collapsing.
 
@@ -140,15 +141,82 @@ def moe_ffn(h: jax.Array, layer: Params, cfg: MoEConfig,
     return out, aux
 
 
+def moe_ffn_a2a(h: jax.Array, layer: Params, cfg: MoEConfig,
+                n_shards: int, capacity: int, axis: str
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Capacity-based all-to-all expert dispatch (GShard/Switch style).
+
+    The complement of dense dispatch (:func:`moe_ffn`): tokens are sharded
+    over ``axis`` (each device holds a batch shard ``h [B/n, T, D]``), expert
+    weights arrive shard_map-local (``[E/n, D, F]``), and tokens physically
+    travel to their experts over ICI:
+
+      route locally → pack per-expert buffers ``[E, C, D]`` (one-hot
+      dispatch einsum) → ``all_to_all`` (each device keeps only its local
+      experts' buffers, from every peer) → batched expert FFN on ``[E/n,
+      n·C, D]`` → reverse ``all_to_all`` → weighted combine back into token
+      order.
+
+    ``capacity`` C is the per-(source-device, expert) buffer depth; tokens
+    beyond it are dropped (contribute nothing for that expert — the standard
+    capacity-factor trade). With C ≥ per-expert max load the result equals
+    dense dispatch exactly. This path wins over dense compute when E is
+    large: FLOPs are O(top_k/E) of dense, at the price of 2 all_to_alls.
+
+    Returns (out [B/n, T, D], aux []) — aux is the full-E load-balance term
+    measured on the LOCAL batch shard; callers pmean it over ``axis``.
+    """
+    Bl, T, D = h.shape
+    E = cfg.n_experts
+    El = E // n_shards
+    C = capacity
+    G = Bl * T
+    weights, probs = router_weights(h, layer["router"], cfg.top_k)
+    w = weights.reshape(G, E)
+    hg = h.reshape(G, D)
+    mask = w > 0
+    # position of each token within its expert's buffer; overflow → dropped
+    pos = jnp.cumsum(mask.astype(jnp.int32), axis=0) - 1          # [G, E]
+    keep = jnp.logical_and(mask, pos < C)
+    dispatch = jnp.where(keep[..., None],
+                         jax.nn.one_hot(pos, C, dtype=h.dtype), 0)  # [G,E,C]
+    xs = jnp.einsum("gec,gd->ecd", dispatch, hg)                  # [E, C, D]
+    # split the expert axis across devices; after the a2a, axis 0 indexes
+    # the SOURCE device and axis 1 this device's local experts
+    xs = jax.lax.all_to_all(xs.reshape(n_shards, El, C, D), axis,
+                            split_axis=0, concat_axis=0)
+    xin = xs.transpose(1, 0, 2, 3).reshape(El, n_shards * C, D)
+    gate = jax.nn.silu(jnp.einsum("ekd,edf->ekf", xin, layer["w_gate"],
+                                  preferred_element_type=jnp.float32))
+    up = jnp.einsum("ekd,edf->ekf", xin, layer["w_up"],
+                    preferred_element_type=jnp.float32)
+    out = jnp.einsum("ekf,efd->ekd", (gate * up).astype(h.dtype),
+                     layer["w_down"])                              # [El,nC,D]
+    out = out.reshape(El, n_shards, C, D).transpose(1, 0, 2, 3)
+    out = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0)
+    out = out.reshape(E, C, D)                                     # [E, C, D]
+    combine = dispatch * w[..., None].astype(h.dtype)              # [G, E, C]
+    y = jnp.einsum("gec,ecd->gd", combine, out).reshape(Bl, T, D)
+    frac = jnp.mean(mask.astype(jnp.float32), axis=0)              # [E]
+    mean_prob = jnp.mean(probs.reshape(G, E), axis=0)
+    aux = cfg.n_experts * jnp.sum(frac * mean_prob)
+    return y, aux
+
+
 def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
             positions: Optional[jax.Array] = None,
             experts_slice: Optional[Tuple[int, int]] = None,
-            ep_axis: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
+            ep_axis: Optional[str] = None,
+            ffn_fn: Optional[Any] = None) -> Tuple[jax.Array, jax.Array]:
     """→ (logits [B,T,V] fp32, total aux loss []). Under expert parallelism
     (``experts_slice`` + ``ep_axis``) each device computes its local experts
     and the per-layer psum restores the full residual stream; the returned
     aux is still partial (wrapper psums once). Attention is computed fully on
-    every device (cheap relative to experts at MoE scale)."""
+    every device (cheap relative to experts at MoE scale).
+
+    ``ffn_fn`` overrides the expert layer entirely — ``(h, layer) -> (out,
+    aux)`` — used by the all-to-all dispatch path (:func:`moe_ffn_a2a`),
+    where tokens are batch-sharded and out comes back complete (no psum)."""
     B, T = tokens.shape
     H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     if positions is None:
@@ -169,7 +237,10 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
         attn = flash_attention(q, k, v, causal=True)
         x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
         h2 = rms_norm(x, layer["mlp_norm"])
-        moe_out, aux = moe_ffn(h2, layer, cfg, experts_slice, ep_axis)
+        if ffn_fn is not None:
+            moe_out, aux = ffn_fn(h2, layer)
+        else:
+            moe_out, aux = moe_ffn(h2, layer, cfg, experts_slice, ep_axis)
         return x + moe_out, aux
 
     block_fn = jax.checkpoint(block) if cfg.remat else block
@@ -183,7 +254,7 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
     if ep_axis is not None:
         # the aux accumulator is device-varying (local experts only) — the
         # scan carry must be typed accordingly under shard_map
-        aux_init = jax.lax.pvary(aux_init, ep_axis)
+        aux_init = jax.lax.pcast(aux_init, ep_axis, to='varying')
     (x, aux_total), _ = jax.lax.scan(
         scan_body, (x, aux_init), params["blocks"])
     x = rms_norm(x, params["final_norm"])

@@ -6,12 +6,22 @@ token stream, and a per-layer psum (inside moe_ffn) restores the full
 residual stream. The router stays replicated — routing decisions are global.
 
 EP and TP are alternatives for the innermost mesh axis; they share "tensor".
-Token all-to-all dispatch (beats broadcast-compute when E is large and the
-batch is big) is future work behind the same interface.
+
+Two dispatch strategies behind one interface:
+
+- **dense** (:func:`make_ep_loss`): tokens replicated, every device runs its
+  local experts on the full stream, per-layer psum merges. Zero routing
+  communication; FLOPs do not shrink with top_k. Right when E is small.
+- **all-to-all** (:func:`make_ep_a2a_loss`): tokens batch-sharded over the
+  same axis; capacity-bounded buffers hop to their experts via
+  ``lax.all_to_all`` (GShard). FLOPs scale with top_k/E; the two a2as ride
+  ICI. Right when E is large or the batch is big.
 """
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
@@ -19,7 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.moe import MoEConfig, forward
+from ..models.moe import MoEConfig, forward, moe_ffn_a2a
 from .fsdp import TrainState, default_optimizer
 
 AXIS = "tensor"
@@ -70,6 +80,49 @@ def make_ep_loss(cfg: MoEConfig, mesh: Mesh) -> Callable:
     return loss
 
 
+def make_ep_a2a_loss(cfg: MoEConfig, mesh: Mesh,
+                     capacity_factor: float = 2.0) -> Callable:
+    """Returns ``loss(params, tokens)`` using capacity-based all-to-all
+    dispatch: the batch is SHARDED over the tensor axis (B must divide), the
+    expert stacks are sharded on their expert axis, and tokens physically
+    travel to their experts (models/moe.py:moe_ffn_a2a).
+
+    Per-(device, expert) buffer capacity C = ceil(capacity_factor · top_k ·
+    G / E), G = local tokens per device. capacity_factor ≥ E/top_k makes
+    dispatch lossless (C = G); ~1-2 is the usual train-time trade."""
+    n = mesh.shape[AXIS]
+    if cfg.n_experts % n:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
+                         f"{n}-way expert parallelism")
+
+    def shard_loss(params, inputs, targets):
+        Bl, T = inputs.shape
+        G = Bl * T
+        cap = min(G, math.ceil(capacity_factor * cfg.top_k * G
+                               / cfg.n_experts))
+        ffn = functools.partial(moe_ffn_a2a, cfg=cfg, n_shards=n,
+                                capacity=cap, axis=AXIS)
+        logits, aux_local = forward(params, inputs, cfg, ep_axis=AXIS,
+                                    ffn_fn=ffn)
+        aux = jax.lax.pmean(aux_local, AXIS)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jax.lax.pmean(jnp.mean(nll), AXIS) + cfg.router_aux_coef * aux
+
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(ep_param_specs(), P(AXIS, None), P(AXIS, None)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        if tokens.shape[0] % n:
+            raise ValueError(f"batch {tokens.shape[0]} not divisible by "
+                             f"{n}-way a2a expert parallelism")
+        return sharded(params, tokens[:, :-1], tokens[:, 1:])
+
+    return loss
+
+
 def moe_reference_loss(cfg: MoEConfig) -> Callable:
     """Single-device reference: full dense-dispatch loss (for tests)."""
 
@@ -83,11 +136,13 @@ def moe_reference_loss(cfg: MoEConfig) -> Callable:
     return loss
 
 
-def make_ep_train_step(cfg: MoEConfig, mesh: Mesh,
-                       optimizer: Optional[optax.GradientTransformation] = None
-                       ) -> Callable:
+def make_train_step_from_loss(loss_fn: Callable,
+                              optimizer: Optional[
+                                  optax.GradientTransformation] = None
+                              ) -> Callable:
+    """Jitted, donated ``train_step(state, tokens)`` around any
+    ``loss(params, tokens)`` — the one step body every MoE path shares."""
     optimizer = optimizer or default_optimizer()
-    loss_fn = make_ep_loss(cfg, mesh)
 
     def train_step(state: TrainState, tokens: jax.Array
                    ) -> Tuple[TrainState, Dict[str, jax.Array]]:
@@ -101,3 +156,19 @@ def make_ep_train_step(cfg: MoEConfig, mesh: Mesh,
                           step=state.step + 1), metrics
 
     return jax.jit(train_step, donate_argnums=(0,))
+
+
+def make_ep_train_step(cfg: MoEConfig, mesh: Mesh,
+                       optimizer: Optional[optax.GradientTransformation] = None,
+                       dispatch: str = "dense",
+                       capacity_factor: float = 2.0) -> Callable:
+    """``dispatch`` picks the EP strategy: "dense" (replicated tokens,
+    psum-merged local experts) or "a2a" (batch-sharded tokens, capacity-based
+    all-to-all — see :func:`make_ep_a2a_loss`)."""
+    if dispatch == "dense":
+        loss_fn = make_ep_loss(cfg, mesh)
+    elif dispatch == "a2a":
+        loss_fn = make_ep_a2a_loss(cfg, mesh, capacity_factor)
+    else:
+        raise ValueError(f"unknown dispatch {dispatch!r}")
+    return make_train_step_from_loss(loss_fn, optimizer)

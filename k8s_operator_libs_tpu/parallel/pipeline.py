@@ -113,9 +113,9 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
             x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
             return (x_nxt, total, count), None
 
-        init = (jax.lax.pvary(jnp.zeros((Bm, T, D), dtype), AXIS),
-                jax.lax.pvary(jnp.zeros((), jnp.float32), AXIS),
-                jax.lax.pvary(jnp.zeros((), jnp.int32), AXIS))
+        init = (jax.lax.pcast(jnp.zeros((Bm, T, D), dtype), AXIS, to='varying'),
+                jax.lax.pcast(jnp.zeros((), jnp.float32), AXIS, to='varying'),
+                jax.lax.pcast(jnp.zeros((), jnp.int32), AXIS, to='varying'))
         (_, total, count), _ = jax.lax.scan(tick, init,
                                             jnp.arange(n_ticks))
         return jax.lax.psum(total, AXIS) / jax.lax.psum(count, AXIS)

@@ -67,9 +67,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # pvary: the accumulators are device-varying over the seq axis (each
     # device owns different rows) — required carry typing under shard_map
     init = (
-        jax.lax.pvary(jnp.zeros((B, Tl, H, Dh), jnp.float32), axis_name),
-        jax.lax.pvary(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name),
-        jax.lax.pvary(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name),
+        jax.lax.pcast(jnp.zeros((B, Tl, H, Dh), jnp.float32), axis_name, to='varying'),
+        jax.lax.pcast(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name, to='varying'),
+        jax.lax.pcast(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name, to='varying'),
         k, v,
     )
     (acc, m, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))

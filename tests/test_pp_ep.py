@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
 from k8s_operator_libs_tpu.models import moe as moe_mod
 from k8s_operator_libs_tpu.parallel.expert import (
+    make_ep_a2a_loss,
     make_ep_loss,
     make_ep_train_step,
     moe_reference_loss,
@@ -135,3 +136,63 @@ def test_ep_grads_match_and_training_converges(ep_mesh):
     for _ in range(3):
         state, m = step(state, tokens)
     assert float(m["loss"]) < float(m0["loss"])
+
+
+# ------------------------------------------------------- EP all-to-all
+
+
+def test_ep_a2a_loss_matches_reference_lossless(ep_mesh):
+    # capacity_factor = E/top_k makes C = G (no token ever dropped) so the
+    # a2a path must agree with dense dispatch exactly (aux off: its
+    # per-shard estimate legitimately differs from the global-batch term)
+    cfg = moe_mod.MoEConfig.tiny(router_aux_coef=0.0)
+    params = moe_mod.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    cf = cfg.n_experts / cfg.top_k
+    l_a2a = float(jax.jit(make_ep_a2a_loss(cfg, ep_mesh, cf))(params, tokens))
+    l_ref = float(jax.jit(moe_reference_loss(cfg))(params, tokens))
+    assert abs(l_a2a - l_ref) < 1e-3
+
+
+def test_ep_a2a_grads_match_reference_lossless(ep_mesh):
+    cfg = moe_mod.MoEConfig.tiny(router_aux_coef=0.0)
+    params = moe_mod.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    cf = cfg.n_experts / cfg.top_k
+    g_a2a = jax.grad(make_ep_a2a_loss(cfg, ep_mesh, cf))(params, tokens)
+    g_ref = jax.grad(moe_reference_loss(cfg))(params, tokens)
+    for a, b in zip(jax.tree_util.tree_leaves(g_a2a),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=3e-2, atol=3e-3)
+
+
+def test_ep_a2a_tight_capacity_drops_but_trains(ep_mesh):
+    # starved capacity: loss may deviate from dense (tokens dropped) but the
+    # step must stay finite and reduce loss over a few iterations
+    cfg = moe_mod.MoEConfig.tiny()
+    params = moe_mod.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    opt = default_optimizer()
+    state = TrainState(params=params, opt_state=opt.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    step = make_ep_train_step(cfg, ep_mesh, opt, dispatch="a2a",
+                              capacity_factor=0.5)
+    state, m0 = step(state, tokens)
+    for _ in range(3):
+        state, m = step(state, tokens)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["loss"]) < float(m0["loss"])
+
+
+def test_ep_a2a_rejects_indivisible_batch(ep_mesh):
+    cfg = moe_mod.MoEConfig.tiny()
+    params = moe_mod.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 33), 0,
+                                cfg.vocab_size)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_ep_a2a_loss(cfg, ep_mesh)(params, tokens)
