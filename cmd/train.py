@@ -3,7 +3,7 @@
 
 Ties the framework's workload pieces together end-to-end:
 TokenDataset (native loader) → mesh + parallel train step (fsdp / sp / pp /
-ep) → CheckpointingTrainer (orbax, drain-coordinated exit on SIGTERM).
+ep / 3d) → CheckpointingTrainer (orbax, drain-coordinated exit on SIGTERM).
 
 In a pod, kubelet's SIGTERM during eviction/drain triggers the synchronous
 checkpoint + clean exit; on reschedule the same command resumes from the
@@ -24,7 +24,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 def build_parallel(cfg, args, optimizer):
     """Wire --model × --parallel to the right mesh + train-step + state-init
     triple. MoE trains dense-dispatch on one device (--parallel none) or
-    expert-parallel (--parallel ep); Llama configs take fsdp / sp / pp."""
+    expert-parallel (--parallel ep, dense or a2a dispatch); Llama configs
+    take fsdp / sp / pp / 3d (composed pp x dp x tp)."""
     import math
 
     import jax
@@ -34,18 +35,15 @@ def build_parallel(cfg, args, optimizer):
 
     is_moe = args.model == "moe_tiny"
     n = len(jax.devices())
-
-    def llama_init(rng):
-        from k8s_operator_libs_tpu.models.llama import init_params
-        from k8s_operator_libs_tpu.parallel.fsdp import TrainState
-        params = init_params(rng, cfg)
-        return TrainState(params=params, opt_state=optimizer.init(params),
-                          step=jnp.zeros((), jnp.int32))
+    if args.moe_dispatch != "dense" and not (
+            is_moe and args.parallel == "ep" and n > 1):
+        raise SystemExit("--moe-dispatch a2a requires --model moe_tiny "
+                         "--parallel ep on >1 device")
 
     if is_moe:
         from k8s_operator_libs_tpu.models.moe import init_params as moe_init
         from k8s_operator_libs_tpu.parallel.expert import (
-            make_ep_train_step, make_train_step_from_loss,
+            init_ep_state, make_ep_train_step, make_train_step_from_loss,
             moe_reference_loss)
         from k8s_operator_libs_tpu.parallel.fsdp import TrainState
 
@@ -69,7 +67,8 @@ def build_parallel(cfg, args, optimizer):
             mesh = make_mesh(tensor=t, fsdp=1, devices=jax.devices()[:t])
             step = make_ep_train_step(cfg, mesh, optimizer,
                                       dispatch=args.moe_dispatch)
-            return mesh, step, init_fn
+            return (mesh, step,
+                    lambda rng: init_ep_state(rng, cfg, mesh, optimizer))
         if args.parallel not in ("none", "ep"):
             raise SystemExit(f"--model moe_tiny supports --parallel none|ep, "
                              f"not {args.parallel}")
@@ -86,13 +85,18 @@ def build_parallel(cfg, args, optimizer):
     if args.parallel == "sp" and n > 1:
         from k8s_operator_libs_tpu.parallel.long_context import (
             make_sp_train_step)
+        from k8s_operator_libs_tpu.parallel.fsdp import (
+            init_train_state, replicated_specs)
         if args.seq % n:
             raise SystemExit(f"--seq {args.seq} must be divisible by the "
                              f"{n}-way seq mesh")
         mesh = make_mesh(seq=n, fsdp=1)
-        return mesh, make_sp_train_step(cfg, mesh, optimizer), llama_init
+        return (mesh, make_sp_train_step(cfg, mesh, optimizer),
+                lambda rng: init_train_state(rng, cfg, optimizer, mesh,
+                                             pspecs=replicated_specs))
     if args.parallel == "pp" and n > 1:
-        from k8s_operator_libs_tpu.parallel.pipeline import make_pp_train_step
+        from k8s_operator_libs_tpu.parallel.pipeline import (
+            init_pp_state, make_pp_train_step)
         s = math.gcd(n, cfg.n_layers)
         if s < 2:
             raise SystemExit(f"pipeline needs gcd(devices={n}, "
@@ -104,7 +108,24 @@ def build_parallel(cfg, args, optimizer):
             micro = 2
         else:
             raise SystemExit("--batch must be divisible by 2 for pp")
-        return mesh, make_pp_train_step(cfg, mesh, micro, optimizer), llama_init
+        return (mesh, make_pp_train_step(cfg, mesh, micro, optimizer),
+                lambda rng: init_pp_state(rng, cfg, mesh, optimizer))
+    if args.parallel == "3d" and n > 1:
+        from k8s_operator_libs_tpu.parallel.composed import (
+            init_composed_state, make_composed_train_step)
+        if n % 4:
+            raise SystemExit(f"--parallel 3d needs a multiple of 4 devices "
+                             f"(stage=2 x tensor=2), have {n}")
+        if cfg.n_heads % 2 or cfg.n_kv_heads % 2 or cfg.n_layers % 2:
+            raise SystemExit("--parallel 3d needs even heads/kv-heads/layers")
+        dp = n // 4
+        micro = 2
+        if args.batch % (dp * micro):
+            raise SystemExit(f"--batch {args.batch} must be divisible by "
+                             f"data({dp}) x microbatches({micro})")
+        mesh = make_mesh(stage=2, data=dp, fsdp=1, tensor=2)
+        return (mesh, make_composed_train_step(cfg, mesh, micro, optimizer),
+                lambda rng: init_composed_state(rng, cfg, mesh, optimizer))
     if args.parallel == "ep":
         raise SystemExit("--parallel ep requires --model moe_tiny")
     return None, None, None  # single device: plain jitted llama step
@@ -117,7 +138,7 @@ def main(argv=None) -> int:
     p.add_argument("--model", default="tiny",
                    choices=["tiny", "small", "llama3_8b", "moe_tiny"])
     p.add_argument("--parallel", default="fsdp",
-                   choices=["none", "fsdp", "sp", "pp", "ep"])
+                   choices=["none", "fsdp", "sp", "pp", "ep", "3d"])
     p.add_argument("--moe-dispatch", default="dense",
                    choices=["dense", "a2a"],
                    help="EP dispatch: dense (replicated tokens) or "

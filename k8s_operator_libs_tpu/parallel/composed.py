@@ -1,0 +1,214 @@
+"""Composed 3-D parallelism: pipeline × FSDP × tensor (+ data) in ONE step.
+
+The other modules each shard one axis (fsdp.py, pipeline.py, expert.py,
+long_context.py); this one composes them the way a real multi-pod TPU run
+does — a single ``shard_map`` over the full ``(stage, data, fsdp, tensor)``
+mesh, one jitted train step, no nesting:
+
+- **stage**  — GPipe schedule over the stacked layer axis, boundary
+  activations hop via ``lax.ppermute`` (nearest-neighbor ICI), exactly as
+  :mod:`.pipeline`;
+- **data**   — batch sharding; each data replica pipelines its own
+  microbatches, the loss is ``pmean``-ed and autodiff's transpose inserts
+  the gradient all-reduce;
+- **fsdp**   — ZeRO-3 *storage* sharding: weights arrive shard_map-local
+  with one model dim split over "fsdp" and are ``all_gather``-ed before
+  use. The transpose of ``all_gather`` is ``psum_scatter``, so gradients
+  leave reduce-scattered back onto the shards — ZeRO-3 semantics fall out
+  of autodiff, no hand-written backward;
+- **tensor** — Megatron head/FFN sharding within each stage: wq/wk/wv and
+  w_gate/w_up column-split over "tensor", wo/w_down row-split, one psum
+  after each of the two row-parallel matmuls per block.
+
+Axis order matches :mod:`.mesh`: "tensor" innermost (per-block psums ride
+nearest-neighbor ICI), "stage" outermost (boundary activations only).
+
+r1 simplification shared with :mod:`.pipeline`: embed/lm_head are gathered
+in full on every device (storage stays fsdp-sharded); fine at Llama-3-8B
+scale on v5p (≈1 GB bf16), revisit for larger vocab or >8B.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, rms_norm, rope
+from ..ops.attention import flash_attention
+from .fsdp import TrainState, init_train_state, make_train_step_from_loss
+from .pipeline import gpipe_schedule
+
+
+def composed_param_specs() -> Dict:
+    """Storage PartitionSpecs: layer stacks over "stage", one model dim over
+    "fsdp", the Megatron-legal dim over "tensor". These are both the
+    shard_map in_specs and (as NamedShardings) the at-rest layout."""
+    return {
+        "embed": P(None, "fsdp"),
+        "blocks": {
+            "attn_norm": P("stage", None),
+            "wq": P("stage", "fsdp", "tensor"),
+            "wk": P("stage", "fsdp", "tensor"),
+            "wv": P("stage", "fsdp", "tensor"),
+            "wo": P("stage", "tensor", "fsdp"),
+            "mlp_norm": P("stage", None),
+            "w_gate": P("stage", "fsdp", "tensor"),
+            "w_up": P("stage", "fsdp", "tensor"),
+            "w_down": P("stage", "tensor", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", None),
+    }
+
+
+def _check_divisibility(cfg: LlamaConfig, mesh: Mesh) -> None:
+    S, tp, fs = mesh.shape["stage"], mesh.shape["tensor"], mesh.shape["fsdp"]
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                         f"{S} stages")
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(f"heads {cfg.n_heads}/kv {cfg.n_kv_heads} not "
+                         f"divisible by {tp}-way tensor parallelism")
+    if cfg.d_ff % tp:
+        raise ValueError(f"d_ff {cfg.d_ff} not divisible by {tp}-way "
+                         f"tensor parallelism")
+    if cfg.d_model % fs or cfg.d_ff % fs:
+        raise ValueError(f"d_model {cfg.d_model}/d_ff {cfg.d_ff} not "
+                         f"divisible by {fs}-way fsdp")
+
+
+def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
+                       ) -> Callable:
+    """Returns ``loss(params, tokens)``, tokens [B, T+1]; B must divide by
+    data · num_microbatches. Params use :func:`composed_param_specs`."""
+    S = mesh.shape["stage"]
+    tp = mesh.shape["tensor"]
+    dp = mesh.shape["data"]
+    M = num_microbatches
+    _check_divisibility(cfg, mesh)
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    Hl, KVl = H // tp, KV // tp
+
+    def gather(w, axis):
+        return jax.lax.all_gather(w, "fsdp", axis=axis, tiled=True)
+
+    def tp_block(x, layer, positions):
+        """Decoder block with tp-local heads/FFN columns; two psums over
+        "tensor" restore the full residual stream (Megatron)."""
+        Bm, T, D = x.shape
+        h = rms_norm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(Bm, T, Hl, Dh)
+        k = (h @ layer["wk"]).reshape(Bm, T, KVl, Dh)
+        v = (h @ layer["wv"]).reshape(Bm, T, KVl, Dh)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = flash_attention(q, k, v, causal=True)
+        x = x + jax.lax.psum(
+            attn.reshape(Bm, T, Hl * Dh) @ layer["wo"], "tensor")
+        h = rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(
+            (h @ layer["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        x = x + jax.lax.psum(
+            (gate * (h @ layer["w_up"])) @ layer["w_down"], "tensor")
+        return x
+
+    def shard_loss(params, inputs, targets):
+        # inputs [Bd, T] local to this data replica; replicated over
+        # stage/fsdp/tensor
+        s = jax.lax.axis_index("stage")
+        Bd, T = inputs.shape
+        Bm = Bd // M
+        D = cfg.d_model
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
+
+        # ZeRO-3: gather this stage's layer shards over "fsdp" once per
+        # step; autodiff transposes each gather into a grad reduce-scatter
+        blocks = {
+            "attn_norm": params["blocks"]["attn_norm"],
+            "wq": gather(params["blocks"]["wq"], 1),
+            "wk": gather(params["blocks"]["wk"], 1),
+            "wv": gather(params["blocks"]["wv"], 1),
+            "wo": gather(params["blocks"]["wo"], 2),
+            "mlp_norm": params["blocks"]["mlp_norm"],
+            "w_gate": gather(params["blocks"]["w_gate"], 1),
+            "w_up": gather(params["blocks"]["w_up"], 1),
+            "w_down": gather(params["blocks"]["w_down"], 2),
+        }
+        embed = gather(params["embed"], 1)            # [V, D]
+        lm_head = gather(params["lm_head"], 0)        # [D, V]
+        dtype = embed.dtype
+
+        block_fn = jax.checkpoint(tp_block) if cfg.remat else tp_block
+
+        def stage_apply(x):
+            def body(carry, layer):
+                return block_fn(carry, layer, positions), None
+            x, _ = jax.lax.scan(body, x, blocks)
+            return x
+
+        def project_nll(y, mb_t):
+            h = rms_norm(y, params["final_norm"])
+            logits = (h @ lm_head).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, mb_t[..., None],
+                                        axis=-1)[..., 0]
+
+        # carries are varying over stage (ppermute/axis_index), data (the
+        # batch shard), and fsdp (gathered weights keep fsdp vma-typing)
+        total, count = gpipe_schedule(
+            S, M, s, inputs, targets,
+            embed_mb=lambda mb: embed[mb],
+            stage_apply=stage_apply,
+            project_nll=project_nll,
+            init_x=jnp.zeros((Bm, T, D), dtype),
+            varying_axes=("stage", "data", "fsdp"))
+        local = total / count
+        # mean over data replicas; pmean over fsdp is a numeric no-op
+        # (values replicated) but clears its vma-varying type ("tensor" is
+        # already invariant: the per-block psums reduced it)
+        return jax.lax.pmean(local, ("data", "fsdp"))
+
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(composed_param_specs(), P("data", None), P("data", None)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        if tokens.shape[0] % (dp * M):
+            raise ValueError(f"batch {tokens.shape[0]} not divisible by "
+                             f"data({dp}) x microbatches({M})")
+        return sharded(params, tokens[:, :-1], tokens[:, 1:])
+
+    return loss
+
+
+def make_composed_train_step(cfg: LlamaConfig, mesh: Mesh,
+                             num_microbatches: int = 4,
+                             optimizer: Optional[
+                                 optax.GradientTransformation] = None
+                             ) -> Callable:
+    """Jitted pp × fsdp × tp (+ dp) ``train_step(state, tokens)``. Gradients
+    arrive on the same storage sharding as the params, so the optimizer
+    update runs shard-local (ZeRO-3)."""
+    return make_train_step_from_loss(
+        make_composed_loss(cfg, mesh, num_microbatches), optimizer)
+
+
+def init_composed_state(rng: jax.Array, cfg: LlamaConfig, mesh: Mesh,
+                        optimizer: Optional[
+                            optax.GradientTransformation] = None
+                        ) -> TrainState:
+    """Initialize a TrainState already laid out per
+    :func:`composed_param_specs` — params and adam moments land sharded over
+    stage/fsdp/tensor at init, so the full model never materializes on one
+    device (required at Llama-3-8B scale)."""
+    return init_train_state(rng, cfg, optimizer, mesh,
+                            pspecs=composed_param_specs())

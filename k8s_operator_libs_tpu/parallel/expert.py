@@ -30,9 +30,22 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.moe import MoEConfig, forward, moe_ffn_a2a
-from .fsdp import TrainState, default_optimizer
+from .fsdp import (TrainState, default_optimizer,  # noqa: F401
+                   init_train_state, make_train_step_from_loss)
 
 AXIS = "tensor"
+
+
+def init_ep_state(rng: jax.Array, cfg: MoEConfig, mesh: Mesh,
+                  optimizer: Optional[optax.GradientTransformation] = None
+                  ) -> TrainState:
+    """TrainState laid out per :func:`ep_param_specs` (expert stacks sharded
+    over "tensor", rest replicated) and committed to the mesh's devices —
+    required so checkpoint restore re-shards onto the EP layout instead of
+    a single device."""
+    from ..models.moe import init_params as moe_init
+    return init_train_state(rng, cfg, optimizer, mesh,
+                            pspecs=ep_param_specs(), params_init=moe_init)
 
 
 def ep_param_specs() -> Dict:
@@ -134,28 +147,6 @@ def moe_reference_loss(cfg: MoEConfig) -> Callable:
         return jnp.mean(nll) + cfg.router_aux_coef * aux
 
     return loss
-
-
-def make_train_step_from_loss(loss_fn: Callable,
-                              optimizer: Optional[
-                                  optax.GradientTransformation] = None
-                              ) -> Callable:
-    """Jitted, donated ``train_step(state, tokens)`` around any
-    ``loss(params, tokens)`` — the one step body every MoE path shares."""
-    optimizer = optimizer or default_optimizer()
-
-    def train_step(state: TrainState, tokens: jax.Array
-                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
-        updates, new_opt = optimizer.update(grads, state.opt_state,
-                                            state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
-                   "step": state.step + 1}
-        return TrainState(params=new_params, opt_state=new_opt,
-                          step=state.step + 1), metrics
-
-    return jax.jit(train_step, donate_argnums=(0,))
 
 
 def make_ep_train_step(cfg: MoEConfig, mesh: Mesh,

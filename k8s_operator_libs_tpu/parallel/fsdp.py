@@ -58,10 +58,11 @@ def causal_lm_loss(params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     return jnp.mean(nll)
 
 
-def _state_shardings(state_shape, mesh: Mesh):
-    """Shardings for the whole TrainState: params by rule, optimizer moments
-    inherit their param's spec (same shapes), step replicated."""
-    pspecs = param_specs(state_shape.params)
+def _state_shardings(state_shape, mesh: Mesh, pspecs=None):
+    """Shardings for the whole TrainState: params by rule (``pspecs``
+    overrides the FSDP default — e.g. composed 3-D storage specs), optimizer
+    moments inherit their param's spec (same shapes), step replicated."""
+    pspecs = pspecs if pspecs is not None else param_specs(state_shape.params)
 
     def spec_like(path_tree):
         return pspecs
@@ -91,15 +92,31 @@ def _spec_for_shape(leaf, pspecs, params) -> P:
     return P()
 
 
+def replicated_specs(params) -> Any:
+    """P() for every leaf — fully replicated at-rest layout (sp/pp paths
+    whose shard_map gathers nothing; the loss shards activations, not
+    weights)."""
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
 def init_train_state(rng: jax.Array, cfg: LlamaConfig,
                      optimizer: Optional[optax.GradientTransformation] = None,
-                     mesh: Optional[Mesh] = None) -> TrainState:
+                     mesh: Optional[Mesh] = None,
+                     pspecs=None,
+                     params_init: Optional[Callable] = None) -> TrainState:
     """Initialize params (+ optimizer state) — sharded at init when a mesh is
-    given, so the full model never materializes on one device."""
+    given, so the full model never materializes on one device AND the state
+    is committed to the mesh's devices (checkpoint restore re-shards onto
+    the same layout; see train/harness.py). ``pspecs`` overrides the at-rest
+    param layout (default: FSDP param_specs rule) — either a spec pytree or
+    a callable ``params_shape -> spec pytree``. ``params_init`` overrides
+    the model initializer (default: Llama ``init_params``) for other model
+    families (MoE)."""
     optimizer = optimizer or default_optimizer()
+    params_init = params_init or init_params
 
     def init_fn(rng):
-        params = init_params(rng, cfg)
+        params = params_init(rng, cfg)
         opt_state = optimizer.init(params)
         return TrainState(params=params, opt_state=opt_state,
                           step=jnp.zeros((), jnp.int32))
@@ -107,8 +124,40 @@ def init_train_state(rng: jax.Array, cfg: LlamaConfig,
     if mesh is None:
         return jax.jit(init_fn)(rng)
     shape = jax.eval_shape(init_fn, rng)
-    shardings = _state_shardings(shape, mesh)
+    if callable(pspecs):
+        pspecs = pspecs(shape.params)
+    shardings = _state_shardings(shape, mesh, pspecs)
     return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def _train_step_body(loss_fn: Callable,
+                     optimizer: optax.GradientTransformation) -> Callable:
+    """The one step body every parallel path shares: value_and_grad →
+    optimizer update → TrainState + {loss, grad_norm, step} metrics."""
+
+    def train_step(state: TrainState, tokens: jax.Array
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   "step": state.step + 1}
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), metrics
+
+    return train_step
+
+
+def make_train_step_from_loss(loss_fn: Callable,
+                              optimizer: Optional[
+                                  optax.GradientTransformation] = None
+                              ) -> Callable:
+    """Jitted, donated ``train_step(state, tokens)`` around any
+    ``loss(params, tokens)`` — used by the pp/ep/3d paths, whose losses are
+    already shard_map'd (the sharding lives in the loss, not the jit)."""
+    return jax.jit(_train_step_body(loss_fn, optimizer or default_optimizer()),
+                   donate_argnums=(0,))
 
 
 def make_train_step(cfg: LlamaConfig,
@@ -120,21 +169,8 @@ def make_train_step(cfg: LlamaConfig,
     is pinned via in/out_shardings (donated, so params update in place in
     HBM)."""
     optimizer = optimizer or default_optimizer()
-
-    def train_step(state: TrainState, tokens: jax.Array
-                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        loss, grads = jax.value_and_grad(causal_lm_loss)(
-            state.params, tokens, cfg)
-        updates, new_opt = optimizer.update(grads, state.opt_state,
-                                            state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": loss,
-            "grad_norm": optax.global_norm(grads),
-            "step": state.step + 1,
-        }
-        return TrainState(params=new_params, opt_state=new_opt,
-                          step=state.step + 1), metrics
+    train_step = _train_step_body(
+        lambda params, tokens: causal_lm_loss(params, tokens, cfg), optimizer)
 
     if mesh is None:
         return jax.jit(train_step, donate_argnums=(0,))

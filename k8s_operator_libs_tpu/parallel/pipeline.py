@@ -35,6 +35,52 @@ from .fsdp import TrainState, default_optimizer
 AXIS = "stage"
 
 
+def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
+                   embed_mb: Callable, stage_apply: Callable,
+                   project_nll: Callable, init_x,
+                   varying_axes=(AXIS,)) -> Tuple[jax.Array, jax.Array]:
+    """The GPipe tick loop, shared by :func:`make_pp_loss` and the composed
+    3-D step (:mod:`.composed`). Runs inside shard_map over the "stage"
+    axis. At tick t, stage s holds microbatch (t - s); stage 0 ingests via
+    ``embed_mb(mb_tokens)``, every stage runs ``stage_apply(x)``, the last
+    stage accumulates ``project_nll(y, mb_targets)`` for valid microbatches,
+    and boundary activations hop via ``lax.ppermute``.
+
+    ``varying_axes`` types the scan carries for shard_map's vma check: the
+    axes the activations are device-varying over ("stage" always; callers
+    with batch-sharded inputs or fsdp-gathered weights add those axes).
+    Returns (total_nll, token_count), both psummed over "stage"."""
+    n_ticks = S + M - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    Bm = inputs.shape[0] // M
+    s = stage_index
+
+    def tick(carry, t):
+        x_cur, total, count = carry
+        m_in = jnp.clip(t, 0, M - 1)
+        mb = jax.lax.dynamic_slice_in_dim(inputs, m_in * Bm, Bm, axis=0)
+        x_cur = jnp.where(s == 0, embed_mb(mb), x_cur)
+        y = stage_apply(x_cur)
+        m_out = t - (S - 1)
+        valid = jnp.logical_and(s == S - 1,
+                                jnp.logical_and(m_out >= 0, m_out < M))
+        mb_t = jax.lax.dynamic_slice_in_dim(
+            targets, jnp.clip(m_out, 0, M - 1) * Bm, Bm, axis=0)
+        nll = project_nll(y, mb_t)
+        total = total + jnp.where(valid, jnp.sum(nll), 0.0)
+        count = count + jnp.where(valid, nll.size, 0)
+        x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
+        return (x_nxt, total, count), None
+
+    varying = functools.partial(jax.lax.pcast, axis_name=varying_axes,
+                                to="varying")
+    init = (varying(init_x),
+            varying(jnp.zeros((), jnp.float32)),
+            jax.lax.pcast(jnp.zeros((), jnp.int32), AXIS, to="varying"))
+    (_, total, count), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+    return jax.lax.psum(total, AXIS), jax.lax.psum(count, AXIS)
+
+
 def pp_param_specs(params) -> Dict:
     """PartitionSpecs for pipeline parallelism: block stacks sharded over
     "stage" on the layer axis; everything else replicated (combine with
@@ -74,51 +120,24 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
     def shard_loss(params, inputs, targets):
         # replicated inputs [B, T]; every stage sees the full batch and
         # selects microbatches by index
-        s = jax.lax.axis_index(AXIS)
         B, T = inputs.shape
         Bm = B // M
-        D = cfg.d_model
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
-        dtype = params["embed"].dtype
 
-        def embed_mb(m):
-            mb = jax.lax.dynamic_slice_in_dim(inputs, m * Bm, Bm, axis=0)
-            return params["embed"][mb]
-
-        n_ticks = S + M - 1
-        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
-
-        def tick(carry, t):
-            x_cur, total, count = carry
-            # stage 0 ingests microbatch t (if still in range)
-            m_in = jnp.clip(t, 0, M - 1)
-            fresh = embed_mb(m_in)
-            x_cur = jnp.where(s == 0, fresh, x_cur)
-            # every stage applies its local layers
-            y = stage_apply(params["blocks"], x_cur, positions)
-            # last stage: if its current microbatch m = t - (S-1) is valid,
-            # project to logits and accumulate masked loss
-            m_out = t - (S - 1)
-            valid = jnp.logical_and(s == S - 1,
-                                    jnp.logical_and(m_out >= 0, m_out < M))
+        def project_nll(y, mb_t):
             h = rms_norm(y, params["final_norm"])
             logits = (h @ params["lm_head"]).astype(jnp.float32)
-            mb_t = jax.lax.dynamic_slice_in_dim(
-                targets, jnp.clip(m_out, 0, M - 1) * Bm, Bm, axis=0)
             logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, mb_t[..., None], axis=-1)[..., 0]
-            total = total + jnp.where(valid, jnp.sum(nll), 0.0)
-            count = count + jnp.where(valid, nll.size, 0)
-            # boundary activations hop to the next stage
-            x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
-            return (x_nxt, total, count), None
+            return -jnp.take_along_axis(logp, mb_t[..., None],
+                                        axis=-1)[..., 0]
 
-        init = (jax.lax.pcast(jnp.zeros((Bm, T, D), dtype), AXIS, to='varying'),
-                jax.lax.pcast(jnp.zeros((), jnp.float32), AXIS, to='varying'),
-                jax.lax.pcast(jnp.zeros((), jnp.int32), AXIS, to='varying'))
-        (_, total, count), _ = jax.lax.scan(tick, init,
-                                            jnp.arange(n_ticks))
-        return jax.lax.psum(total, AXIS) / jax.lax.psum(count, AXIS)
+        total, count = gpipe_schedule(
+            S, M, jax.lax.axis_index(AXIS), inputs, targets,
+            embed_mb=lambda mb: params["embed"][mb],
+            stage_apply=lambda x: stage_apply(params["blocks"], x, positions),
+            project_nll=project_nll,
+            init_x=jnp.zeros((Bm, T, cfg.d_model), params["embed"].dtype))
+        return total / count
 
     block_spec = {k: (P(AXIS) if k.endswith("norm") else P(AXIS, None, None))
                   for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
@@ -134,6 +153,17 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
         return sharded(params, tokens[:, :-1], tokens[:, 1:])
 
     return loss
+
+
+def init_pp_state(rng: jax.Array, cfg: LlamaConfig, mesh: Mesh,
+                  optimizer: Optional[optax.GradientTransformation] = None
+                  ) -> TrainState:
+    """TrainState laid out per :func:`pp_param_specs` (layer stacks sharded
+    over "stage") and committed to the mesh's devices — required so
+    checkpoint restore re-shards onto the PP layout."""
+    from .fsdp import init_train_state
+    return init_train_state(rng, cfg, optimizer, mesh,
+                            pspecs=pp_param_specs)
 
 
 def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
