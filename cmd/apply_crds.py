@@ -6,11 +6,13 @@ pre-upgrade hook Job so CRDs are installed *and upgraded* despite Helm's
 install-once CRD handling (reference pkg/crdutil/README.md:31-57).
 
 Usage:
-    apply_crds.py --crds-dir ./crds [--crds-dir ./more-crds] [--dry-run]
+    apply_crds.py --crds-dir ./crds [--crds-dir ./more-crds]
+                  [--kubeconfig ~/.kube/config | --in-cluster | --dry-run]
 
-Against a real cluster this would build an apiextensions client from
-kubeconfig; in this repo the in-cluster client is injectable and --dry-run
-prints what would be applied (useful in CI and for chart linting).
+Live mode builds a stdlib-HTTP apiextensions client
+(core/liveclient.py:LiveCRDClient) from a kubeconfig or the in-cluster
+serviceaccount; --dry-run prints what would be applied (useful in CI and
+for chart linting).
 """
 
 import argparse
@@ -44,17 +46,32 @@ def main(argv=None) -> int:
                         help="directory containing CRD YAMLs (repeatable)")
     parser.add_argument("--dry-run", action="store_true",
                         help="print what would be applied, touch nothing")
+    parser.add_argument("--kubeconfig", default=None,
+                        help="kubeconfig path (default: $KUBECONFIG or "
+                             "~/.kube/config)")
+    parser.add_argument("--context", default=None,
+                        help="kubeconfig context (default: current-context)")
+    parser.add_argument("--in-cluster", action="store_true",
+                        help="use the pod serviceaccount instead of a "
+                             "kubeconfig")
     args = parser.parse_args(argv)
     if not args.crds_dir:
         parser.error("at least one --crds-dir is required")
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     if args.dry_run:
         client = _DryRunClient()
-    else:  # pragma: no cover - needs a live cluster
-        print("error: no in-cluster client available in this environment; "
-              "use --dry-run or inject a client via crdutil.ensure_crds",
-              file=sys.stderr)
-        return 2
+    else:
+        from k8s_operator_libs_tpu.core.liveclient import (
+            KubeConfig, KubeHTTP, LiveCRDClient)
+        import yaml
+        try:
+            kc = (KubeConfig.in_cluster() if args.in_cluster else
+                  KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
+        except (OSError, KeyError, RuntimeError, yaml.YAMLError) as exc:
+            print(f"error: cannot load cluster config: {exc}",
+                  file=sys.stderr)
+            return 2
+        client = LiveCRDClient(KubeHTTP(kc))
     try:
         n = crdutil.ensure_crds(client, args.crds_dir)
     except crdutil.EnsureCRDsError as exc:

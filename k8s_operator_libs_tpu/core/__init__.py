@@ -5,8 +5,10 @@ controller-runtime ``client.Client`` and an uncached client-go
 ``kubernetes.Interface`` (reference pkg/upgrade/upgrade_state.go:106-107,
 127-135). This package provides the same split as abstract Python interfaces
 (:mod:`.client`), a minimal typed object model (:mod:`.objects`), a
-kubectl-drain-equivalent helper (:mod:`.drain`), and an in-process fake
-apiserver with envtest semantics (:mod:`.fakecluster`).
+kubectl-drain-equivalent helper (:mod:`.drain`), an in-process fake
+apiserver with envtest semantics (:mod:`.fakecluster`), an HTTP façade over
+it (:mod:`.httpapi`), and the production stdlib-HTTP client for real
+clusters (:mod:`.liveclient`, k8s JSON ↔ object model in :mod:`.serde`).
 """
 
 from .objects import (  # noqa: F401

@@ -1,0 +1,297 @@
+"""Live-cluster Kubernetes client over stdlib HTTP(S).
+
+Production transport for the framework: implements the :class:`~.client.
+Client` ABC and crdutil's ``CRDClient`` protocol against a real apiserver
+(GKE) using only the standard library — ``urllib`` + ``ssl`` — since the
+image carries no ``kubernetes`` package. The reference reaches its cluster
+through client-go + controller-runtime (upgrade_state.go:106-107); this is
+the equivalent seam, parsed into the same typed object model by
+:mod:`.serde` so every manager above runs unchanged.
+
+Auth config resolution (client-go loading-rules analog):
+- :meth:`KubeConfig.from_kubeconfig` — parse a kubeconfig YAML: current
+  context → cluster server + CA (file or base64 ``-data``), user client
+  cert/key (file or ``-data``) or bearer token;
+- :meth:`KubeConfig.in_cluster` — the pod path: ``KUBERNETES_SERVICE_HOST``
+  + the mounted serviceaccount token/CA
+  (/var/run/secrets/kubernetes.io/serviceaccount).
+
+Caching note: the reference pairs a *cached* controller-runtime client with
+an *uncached* clientset and bridges staleness with the provider's
+poll-until-synced barrier. This client is uncached (every read hits the
+apiserver) — ``direct()`` returns self, and the barrier degenerates to a
+single immediately-true poll. An informer cache is a later optimization;
+correctness never depends on it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import json
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import yaml
+
+from . import serde
+from .client import Client, ConflictError, NotFoundError
+from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class KubeConfig:
+    server: str
+    ca_file: Optional[str] = None
+    client_cert_file: Optional[str] = None
+    client_key_file: Optional[str] = None
+    token: Optional[str] = None
+    insecure_skip_tls_verify: bool = False
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None,
+                        context: Optional[str] = None) -> "KubeConfig":
+        if path is None:
+            # $KUBECONFIG is a colon-separated list (client-go loading
+            # rules); full merging is out of scope — use the first file
+            # that exists, falling back to ~/.kube/config
+            env = os.environ.get("KUBECONFIG", "")
+            candidates = ([p for p in env.split(os.pathsep) if p]
+                          or [os.path.expanduser("~/.kube/config")])
+            path = next((p for p in candidates if os.path.exists(p)),
+                        candidates[0])
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context")
+        ctx = _named(cfg.get("contexts"), ctx_name, "context")
+        cluster = _named(cfg.get("clusters"), ctx["cluster"], "cluster")
+        user = _named(cfg.get("users"), ctx["user"], "user")
+        return cls(
+            server=cluster["server"].rstrip("/"),
+            ca_file=_file_or_data(cluster, "certificate-authority"),
+            client_cert_file=_file_or_data(user, "client-certificate"),
+            client_key_file=_file_or_data(user, "client-key"),
+            token=user.get("token"),
+            insecure_skip_tls_verify=bool(
+                cluster.get("insecure-skip-tls-verify", False)),
+        )
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in a cluster "
+                               "(KUBERNETES_SERVICE_HOST unset)")
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        return cls(server=f"https://{host}:{port}",
+                   ca_file=os.path.join(SA_DIR, "ca.crt"), token=token)
+
+
+def _named(entries, name, kind) -> Dict:
+    for e in entries or []:
+        if e.get("name") == name:
+            return e.get(kind, {})
+    raise KeyError(f"kubeconfig has no {kind} named {name!r}")
+
+
+def _file_or_data(section: Dict, key: str) -> Optional[str]:
+    """Resolve ``<key>`` (a path) or ``<key>-data`` (base64 inline, written
+    to a 0600 temp file so ssl can load it — key material must not outlive
+    the process, so removal is registered with atexit)."""
+    if section.get(key):
+        return section[key]
+    data = section.get(f"{key}-data")
+    if not data:
+        return None
+    tmp = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+    tmp.write(base64.b64decode(data))
+    tmp.close()
+    atexit.register(_unlink_quiet, tmp.name)
+    return tmp.name
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class KubeHTTP:
+    """Minimal REST transport: JSON in/out, k8s status → typed errors."""
+
+    def __init__(self, config: KubeConfig):
+        self.config = config
+        self._ctx: Optional[ssl.SSLContext] = None
+        if config.server.startswith("https"):
+            ctx = ssl.create_default_context(cafile=config.ca_file)
+            if config.insecure_skip_tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if config.client_cert_file:
+                ctx.load_cert_chain(config.client_cert_file,
+                                    config.client_key_file)
+            self._ctx = ctx
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict] = None,
+                params: Optional[Dict[str, str]] = None,
+                content_type: str = "application/json") -> Dict:
+        url = self.config.server + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            if exc.code == 404:
+                raise NotFoundError(f"{method} {path}: {detail}") from exc
+            if exc.code == 409:
+                raise ConflictError(f"{method} {path}: {detail}") from exc
+            raise RuntimeError(
+                f"{method} {path}: HTTP {exc.code}: {detail}") from exc
+        return json.loads(payload) if payload else {}
+
+
+def _selector_params(label_selector: Optional[Dict[str, str]] = None,
+                     field_node_name: Optional[str] = None
+                     ) -> Optional[Dict[str, str]]:
+    params = {}
+    if label_selector:
+        params["labelSelector"] = ",".join(
+            f"{k}={v}" for k, v in sorted(label_selector.items()))
+    if field_node_name:
+        params["fieldSelector"] = f"spec.nodeName={field_node_name}"
+    return params or None
+
+
+class LiveClient(Client):
+    """:class:`~.client.Client` over a real apiserver. Uncached — see the
+    module docstring for how that interacts with the cache-sync barrier."""
+
+    def __init__(self, http: KubeHTTP):
+        self._http = http
+
+    # ------------------------------------------------------------- reads
+
+    def get_node(self, name: str) -> Node:
+        return serde.node_from_json(
+            self._http.request("GET", f"/api/v1/nodes/{name}"))
+
+    def list_nodes(self, label_selector=None) -> List[Node]:
+        j = self._http.request("GET", "/api/v1/nodes",
+                               params=_selector_params(label_selector))
+        return [serde.node_from_json(i) for i in j.get("items", [])]
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return serde.pod_from_json(self._http.request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"))
+
+    def list_pods(self, namespace=None, label_selector=None,
+                  field_node_name=None) -> List[Pod]:
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        j = self._http.request("GET", path, params=_selector_params(
+            label_selector, field_node_name))
+        return [serde.pod_from_json(i) for i in j.get("items", [])]
+
+    def list_daemonsets(self, namespace=None,
+                        label_selector=None) -> List[DaemonSet]:
+        path = (f"/apis/apps/v1/namespaces/{namespace}/daemonsets"
+                if namespace else "/apis/apps/v1/daemonsets")
+        j = self._http.request("GET", path,
+                               params=_selector_params(label_selector))
+        return [serde.daemonset_from_json(i) for i in j.get("items", [])]
+
+    def list_controller_revisions(self, namespace=None, label_selector=None
+                                  ) -> List[ControllerRevision]:
+        path = (f"/apis/apps/v1/namespaces/{namespace}/controllerrevisions"
+                if namespace else "/apis/apps/v1/controllerrevisions")
+        j = self._http.request("GET", path,
+                               params=_selector_params(label_selector))
+        return [serde.controller_revision_from_json(i)
+                for i in j.get("items", [])]
+
+    def get_job(self, namespace: str, name: str) -> Job:
+        return serde.job_from_json(self._http.request(
+            "GET", f"/apis/batch/v1/namespaces/{namespace}/jobs/{name}"))
+
+    # ------------------------------------------------------------ writes
+
+    def patch_node_metadata(self, name, labels=None,
+                            annotations=None) -> Node:
+        meta: Dict = {}
+        if labels is not None:
+            meta["labels"] = labels          # None values → JSON null deletes
+        if annotations is not None:
+            meta["annotations"] = annotations
+        return serde.node_from_json(self._http.request(
+            "PATCH", f"/api/v1/nodes/{name}", body={"metadata": meta},
+            content_type="application/strategic-merge-patch+json"))
+
+    def patch_node_unschedulable(self, name: str, unschedulable: bool
+                                 ) -> Node:
+        return serde.node_from_json(self._http.request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            body={"spec": {"unschedulable": unschedulable}},
+            content_type="application/strategic-merge-patch+json"))
+
+    def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        body = None
+        if grace_period_seconds is not None:
+            body = {"gracePeriodSeconds": grace_period_seconds}
+        self._http.request(
+            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}", body)
+
+    def evict_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        body: Dict = {"apiVersion": "policy/v1", "kind": "Eviction",
+                      "metadata": {"name": name, "namespace": namespace}}
+        if grace_period_seconds is not None:
+            body["deleteOptions"] = {
+                "gracePeriodSeconds": grace_period_seconds}
+        self._http.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/eviction", body)
+
+    def direct(self) -> "LiveClient":
+        return self
+
+
+CRD_PATH = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
+
+
+class LiveCRDClient:
+    """crdutil ``CRDClient`` over a real apiserver (the apiextensions
+    clientset analog — reference pkg/crdutil/crdutil.go:77-85)."""
+
+    def __init__(self, http: KubeHTTP):
+        self._http = http
+
+    def get_crd(self, name: str) -> dict:
+        return self._http.request("GET", f"{CRD_PATH}/{name}")
+
+    def create_crd(self, crd: dict) -> dict:
+        return self._http.request("POST", CRD_PATH, body=crd)
+
+    def update_crd(self, crd: dict) -> dict:
+        name = crd["metadata"]["name"]
+        return self._http.request("PUT", f"{CRD_PATH}/{name}", body=crd)
