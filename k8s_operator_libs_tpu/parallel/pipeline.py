@@ -66,6 +66,9 @@ def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
                                 jnp.logical_and(m_out >= 0, m_out < M))
         mb_t = jax.lax.dynamic_slice_in_dim(
             targets, jnp.clip(m_out, 0, M - 1) * Bm, Bm, axis=0)
+        # compute-then-mask rather than lax.cond: cond's transpose inside
+        # scan-under-shard_map aborts XLA (jax 0.9); the structural fix is
+        # projecting only the M collected last-stage outputs after the loop
         nll = project_nll(y, mb_t)
         total = total + jnp.where(valid, jnp.sum(nll), 0.0)
         count = count + jnp.where(valid, nll.size, 0)
