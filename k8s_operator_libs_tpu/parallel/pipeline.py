@@ -42,45 +42,50 @@ def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
     """The GPipe tick loop, shared by :func:`make_pp_loss` and the composed
     3-D step (:mod:`.composed`). Runs inside shard_map over the "stage"
     axis. At tick t, stage s holds microbatch (t - s); stage 0 ingests via
-    ``embed_mb(mb_tokens)``, every stage runs ``stage_apply(x)``, the last
-    stage accumulates ``project_nll(y, mb_targets)`` for valid microbatches,
-    and boundary activations hop via ``lax.ppermute``.
+    ``embed_mb(mb_tokens)``, every stage runs ``stage_apply(x)``, and
+    boundary activations hop via ``lax.ppermute``.
+
+    Projection is NOT in the tick loop: a warm-up scan runs the first S-1
+    ticks carrying only the boundary activation, then the main scan runs
+    the M ticks at which the LAST stage finishes microbatches 0..M-1,
+    stacking its block outputs. ``project_nll`` then runs ONCE on the
+    stacked ``[M·Bm, T, D]`` window (must be batch-shape-agnostic) — M
+    projections instead of S+M-1 compute-then-masked ones, fused into one
+    big [M·Bm·T, D] x [D, V] matmul that tiles the MXU far better than
+    per-tick slivers, with no dead warm-up slices held in HBM. (Skipping
+    the projection on non-last stages too needs lax.cond, whose transpose
+    aborts XLA inside scan-under-shard_map on jax 0.9; masking the summed
+    scalar keeps autodiff happy at negligible cost.)
 
     ``varying_axes`` types the scan carries for shard_map's vma check: the
     axes the activations are device-varying over ("stage" always; callers
     with batch-sharded inputs or fsdp-gathered weights add those axes).
     Returns (total_nll, token_count), both psummed over "stage"."""
-    n_ticks = S + M - 1
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     Bm = inputs.shape[0] // M
     s = stage_index
 
     def tick(carry, t):
-        x_cur, total, count = carry
+        x_cur = carry
         m_in = jnp.clip(t, 0, M - 1)
         mb = jax.lax.dynamic_slice_in_dim(inputs, m_in * Bm, Bm, axis=0)
         x_cur = jnp.where(s == 0, embed_mb(mb), x_cur)
         y = stage_apply(x_cur)
-        m_out = t - (S - 1)
-        valid = jnp.logical_and(s == S - 1,
-                                jnp.logical_and(m_out >= 0, m_out < M))
-        mb_t = jax.lax.dynamic_slice_in_dim(
-            targets, jnp.clip(m_out, 0, M - 1) * Bm, Bm, axis=0)
-        # compute-then-mask rather than lax.cond: cond's transpose inside
-        # scan-under-shard_map aborts XLA (jax 0.9); the structural fix is
-        # projecting only the M collected last-stage outputs after the loop
-        nll = project_nll(y, mb_t)
-        total = total + jnp.where(valid, jnp.sum(nll), 0.0)
-        count = count + jnp.where(valid, nll.size, 0)
         x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
-        return (x_nxt, total, count), None
+        return x_nxt, y
 
-    varying = functools.partial(jax.lax.pcast, axis_name=varying_axes,
-                                to="varying")
-    init = (varying(init_x),
-            varying(jnp.zeros((), jnp.float32)),
-            jax.lax.pcast(jnp.zeros((), jnp.int32), AXIS, to="varying"))
-    (_, total, count), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+    x = jax.lax.pcast(init_x, varying_axes, to="varying")
+    if S > 1:  # warm-up: outputs not yet at the last stage, don't stack
+        x, _ = jax.lax.scan(lambda c, t: (tick(c, t)[0], None), x,
+                            jnp.arange(S - 1))
+    # microbatch m leaves the last stage at tick S-1+m; stacked rows are
+    # m-major so the window lines up with targets' [M*Bm, T] row order
+    _, ys = jax.lax.scan(tick, x, jnp.arange(S - 1, S + M - 1))
+    win = ys.reshape((M * Bm,) + ys.shape[2:])
+    nll = project_nll(win, targets[:M * Bm])
+    is_last = s == S - 1
+    total = jnp.where(is_last, jnp.sum(nll), 0.0)
+    count = jnp.where(is_last, nll.size, 0)
     return jax.lax.psum(total, AXIS), jax.lax.psum(count, AXIS)
 
 
