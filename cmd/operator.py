@@ -1,0 +1,210 @@
+#!/usr/bin/env python3
+"""tpu-operator binary: the reconcile-loop driver over the libraries.
+
+The reference ships no control loop — its consumers (GPU/Network Operator)
+own Reconcile() and call BuildState/ApplyState per tick (SURVEY §1). This is
+that consumer for TPU fleets: a deployable process that
+
+1. loads a YAML config naming the managed driver components (libtpu,
+   tpu-device-plugin) and their DriverUpgradePolicySpec,
+2. connects via the stdlib live client (kubeconfig or in-cluster
+   serviceaccount — core/liveclient.py),
+3. optionally bootstraps the shipped CRDs (the Helm-hook equivalent),
+4. runs TPUOperator.reconcile() every --interval seconds with slice-atomic
+   grouping, and
+5. serves /metrics (Prometheus text) and /healthz on --metrics-port.
+
+Config YAML shape (keys follow the CRD camelCase convention):
+
+    components:
+      - name: libtpu
+        namespace: kube-system
+        driverLabels: {app: libtpu}
+        policy:
+          autoUpgrade: true
+          maxParallelUpgrades: 1
+          maxUnavailable: "25%"
+          drain: {enable: true, force: true, timeoutSecond: 300}
+
+SIGTERM/SIGINT finish the current tick, then exit 0 (upgrade progress lives
+in node labels, so the next instance resumes mid-flight — reference
+upgrade_state.go:68-72 semantics).
+"""
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: E402
+from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
+    ManagedComponent, TPUOperator)
+from k8s_operator_libs_tpu.upgrade import metrics as metrics_mod  # noqa: E402
+
+logger = logging.getLogger("tpu-operator")
+
+
+def load_components(path: str):
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    comps = []
+    for c in cfg.get("components") or []:
+        comps.append(ManagedComponent(
+            name=c["name"],
+            namespace=c.get("namespace", "kube-system"),
+            driver_labels=dict(c.get("driverLabels") or {}),
+            policy=DriverUpgradePolicySpec.from_dict(c.get("policy") or {}),
+        ))
+    if not comps:
+        raise ValueError(f"{path}: no components defined")
+    return comps
+
+
+def build_client(args):
+    from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
+                                                       LiveClient)
+    kc = (KubeConfig.in_cluster() if args.in_cluster else
+          KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
+    return LiveClient(KubeHTTP(kc))
+
+
+class MetricsServer:
+    """Serves /metrics (Prometheus text) + /healthz. The handler reads a
+    snapshot dict the reconcile loop refreshes after every tick."""
+
+    def __init__(self, port: int):
+        self.snapshot = {"text": "", "healthy": False}
+        snapshot = self.snapshot
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    body = snapshot["text"].encode()
+                    ctype = "text/plain; version=0.0.4"
+                    code = 200
+                elif self.path == "/healthz":
+                    body = b"ok" if snapshot["healthy"] else b"not ready"
+                    ctype = "text/plain"
+                    code = 200 if snapshot["healthy"] else 503
+                else:
+                    body, ctype, code = b"not found", "text/plain", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def render_metrics(operator: TPUOperator, states) -> str:
+    """Prometheus text from the states the tick just acted on — no second
+    round of apiserver LISTs per scrape interval."""
+    chunks = []
+    for comp in operator.components:
+        state = states.get(comp.name)
+        if state is None:
+            continue
+        chunks.append(metrics_mod.render_prometheus(
+            comp.name, metrics_mod.collect(operator.managers[comp.name],
+                                           state)))
+    return "".join(chunks)
+
+
+def main(argv=None, stop=None, on_ready=None) -> int:
+    """``stop`` (threading.Event) and ``on_ready(metrics_server)`` are
+    injection points for embedding/tests; production runs use signals."""
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True,
+                   help="operator config YAML (components + policies)")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--context", default=None)
+    p.add_argument("--in-cluster", action="store_true")
+    p.add_argument("--interval", type=float, default=30.0,
+                   help="seconds between reconcile ticks")
+    p.add_argument("--once", action="store_true",
+                   help="run a single reconcile tick and exit")
+    p.add_argument("--metrics-port", type=int, default=8080,
+                   help="/metrics + /healthz port (0 = ephemeral, "
+                        "-1 = disabled)")
+    p.add_argument("--ensure-crds", default=None, metavar="DIR",
+                   help="apply CRDs from DIR before the first tick")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    try:
+        components = load_components(args.config)
+        client = build_client(args)
+    except Exception as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.ensure_crds:
+        from k8s_operator_libs_tpu.core.liveclient import LiveCRDClient
+        from k8s_operator_libs_tpu.crdutil import crdutil
+        n = crdutil.ensure_crds(LiveCRDClient(client.http),
+                                [args.ensure_crds])
+        logger.info("bootstrapped %d CRDs", n)
+
+    operator = TPUOperator(client, components)
+    stop = stop or threading.Event()
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread — caller controls the injected stop event
+
+    server = (MetricsServer(args.metrics_port)
+              if args.metrics_port >= 0 else None)
+    if on_ready is not None:
+        on_ready(server)
+    logger.info("managing %s every %.0fs%s",
+                [c.name for c in components], args.interval,
+                f", metrics on :{server.port}" if server else "")
+    ticks = 0
+    try:
+        while not stop.is_set():
+            t0 = time.monotonic()
+            states = operator.reconcile()
+            ticks += 1
+            if server:
+                server.snapshot["text"] = render_metrics(operator, states)
+                # healthy = the last tick reconciled every component; an
+                # apiserver outage flips this off so k8s probes can restart us
+                server.snapshot["healthy"] = all(
+                    s is not None for s in states.values())
+            if args.once:
+                break
+            stop.wait(max(0.0, args.interval - (time.monotonic() - t0)))
+    finally:
+        if server:
+            server.stop()
+    logger.info("exiting after %d ticks", ticks)
+    print(json.dumps({"ticks": ticks}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

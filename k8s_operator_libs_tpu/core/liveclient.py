@@ -191,6 +191,12 @@ class LiveClient(Client):
     def __init__(self, http: KubeHTTP):
         self._http = http
 
+    @property
+    def http(self) -> KubeHTTP:
+        """The underlying transport (shared with LiveCRDClient by binaries
+        that do both — cmd/operator.py's --ensure-crds bootstrap)."""
+        return self._http
+
     # ------------------------------------------------------------- reads
 
     def get_node(self, name: str) -> Node:

@@ -8,9 +8,12 @@ contiguous layers and runs the same scan over its local shard.
 Schedule: GPipe. The global batch splits into M microbatches; at pipeline
 tick t, stage s processes microbatch (t - s), boundary activations hop to
 the next stage via ``lax.ppermute`` (nearest-neighbor ICI traffic only).
-The whole schedule is one ``lax.scan`` over S + M - 1 ticks inside
-``shard_map``; jax autodiff transposes it into the backward pipeline
-(reverse ppermute) automatically — no hand-written backward schedule.
+The schedule is a warm-up ``lax.scan`` of S - 1 ticks (carry only)
+followed by a main scan of M ticks whose stacked last-stage outputs are
+projected to the loss ONCE after the loop (one big MXU-friendly matmul —
+see :func:`gpipe_schedule`), all inside ``shard_map``; jax autodiff
+transposes the scans into the backward pipeline (reverse ppermute)
+automatically — no hand-written backward schedule.
 
 Embedding/lm_head/norms are replicated across stages in this r1 design
 (stage 0 embeds, stage S-1 projects + computes the masked loss; the psum in

@@ -79,17 +79,24 @@ class TPUOperator:
 
     # ---------------------------------------------------------- reconcile
 
-    def reconcile(self) -> None:
+    def reconcile(self) -> Dict[str, Optional[object]]:
         """One tick: upgrade pipeline per component, then placement of
         pending workloads. Errors from one component don't starve the others
-        (each reconcile is idempotent; the next tick retries)."""
+        (each reconcile is idempotent; the next tick retries).
+
+        Returns {component name: the ClusterUpgradeState this tick acted on,
+        or None if its reconcile raised} — consumers render metrics and
+        health from it without re-listing the cluster (cmd/operator.py)."""
+        states: Dict[str, Optional[object]] = {}
         for comp in self.components:
             mgr = self.managers[comp.name]
             try:
                 state = mgr.build_state(comp.namespace, comp.driver_labels)
                 mgr.apply_state(state, comp.policy)
+                states[comp.name] = state
             except Exception:
                 logger.exception("upgrade reconcile failed for %s", comp.name)
+                states[comp.name] = None
         still_pending: List[TPUWorkload] = []
         for wl in self._pending:
             placement = self.scheduler.place(wl)
@@ -100,3 +107,4 @@ class TPUOperator:
                             placement.slice_id)
                 self.placements.append(placement)
         self._pending = still_pending
+        return states

@@ -125,7 +125,7 @@ def test_ensure_crds_over_http(tmp_path, live):
 
 def cluster_url(live):
     # the fixture's client already points at the server; reuse its config
-    return live[1]._http.config.server
+    return live[1].http.config.server
 
 
 def test_apply_crds_cli_live_mode(tmp_path):
@@ -190,3 +190,126 @@ def test_full_upgrade_over_live_http_transport(live):
     assert len(pods) == 2
     assert all(p.metadata.labels["controller-revision-hash"] == "v2"
                for p in pods)
+
+
+# -------------------------------------------------- operator binary
+
+
+def _load_cli(name):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_cli", os.path.join(os.path.dirname(__file__), "..",
+                                    "cmd", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_operator_env(tmp_path, server_url, token=None):
+    user = {"token": token} if token else {}
+    kubeconfig = {
+        "current-context": "fake",
+        "contexts": [{"name": "fake",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server_url}}],
+        "users": [{"name": "u", "user": user}],
+    }
+    kc = tmp_path / "kubeconfig"
+    kc.write_text(yaml.safe_dump(kubeconfig))
+    config = {"components": [{
+        "name": "libtpu", "namespace": "tpu",
+        "driverLabels": {"app": "d"},
+        "policy": {"autoUpgrade": True, "maxParallelUpgrades": 1,
+                   "drain": {"enable": True, "force": True}},
+    }]}
+    cfg = tmp_path / "operator.yaml"
+    cfg.write_text(yaml.safe_dump(config))
+    return kc, cfg
+
+
+def test_operator_binary_once_drives_upgrade(tmp_path):
+    """cmd/operator.py --once ticks drive a 2-node rolling upgrade to done
+    over the live HTTP transport, bootstrapping CRDs on the way."""
+    import os
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+    op = _load_cli("operator")
+    cluster = FakeCluster()
+    _seed(cluster)
+    cluster.bump_daemonset_revision("libtpu", "tpu", "v2")
+    keys = KeyFactory("libtpu")
+    with FakeAPIServer(cluster) as srv:
+        kc, cfg = _write_operator_env(tmp_path, srv.base_url)
+        crds_dir = os.path.join(os.path.dirname(__file__), "..", "crds")
+        for _ in range(40):
+            rc = op.main(["--config", str(cfg), "--kubeconfig", str(kc),
+                          "--once", "--metrics-port", "-1",
+                          "--ensure-crds", crds_dir])
+            assert rc == 0
+            cluster.reconcile_daemonsets()
+            nodes = cluster.client.direct().list_nodes()
+            if all(n.metadata.labels.get(keys.state_label) == UpgradeState.DONE
+                   for n in nodes):
+                break
+        nodes = cluster.client.direct().list_nodes()
+        assert all(n.metadata.labels.get(keys.state_label)
+                   == UpgradeState.DONE for n in nodes)
+        assert any("tpuslicepolicies" in c["metadata"]["name"]
+                   for c in cluster.list_crds())
+
+
+def test_operator_binary_metrics_and_shutdown(tmp_path):
+    """The reconcile loop serves Prometheus metrics + /healthz on an
+    ephemeral port, goes unhealthy when the apiserver disappears, and exits
+    cleanly when the stop event fires (the SIGTERM path, driven directly
+    since tests run off the main thread)."""
+    import threading
+    import time
+    import urllib.request
+
+    op = _load_cli("operator")
+    cluster = FakeCluster()
+    _seed(cluster)
+    srv = FakeAPIServer(cluster).start()
+    kc, cfg = _write_operator_env(tmp_path, srv.base_url)
+    stop = threading.Event()
+    captured = {}
+    rcs = []
+    t = threading.Thread(target=lambda: rcs.append(op.main(
+        ["--config", str(cfg), "--kubeconfig", str(kc),
+         "--interval", "0.1", "--metrics-port", "0"],
+        stop=stop, on_ready=lambda s: captured.update(server=s))))
+    t.start()
+    try:
+        deadline = time.time() + 15
+        body = ""
+        while time.time() < deadline:
+            server = captured.get("server")
+            if server is not None and server.snapshot["healthy"]:
+                port = server.port
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics") as r:
+                    body = r.read().decode()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz") as r:
+                    assert r.read() == b"ok"
+                break
+            time.sleep(0.1)
+        assert 'tpu_operator_total_managed_nodes{component="libtpu"} 2' \
+            in body, body
+        # apiserver outage: /healthz must flip to 503 so k8s probes restart us
+        srv.stop()
+        deadline = time.time() + 15
+        while time.time() < deadline and server.snapshot["healthy"]:
+            time.sleep(0.1)
+        assert not server.snapshot["healthy"]
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+    finally:
+        stop.set()
+        t.join(timeout=15)
+    assert rcs == [0]
