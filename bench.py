@@ -97,17 +97,21 @@ def measure_workload():
                                   dtype=jnp.int32)
 
     batch = make_batch()
-    # warmup/compile
+    # warmup/compile. Sync on a scalar readback, not just block_until_ready:
+    # on the tunneled backend the latter has been observed returning before
+    # execution finishes, which once inflated tokens/s ~50x past the roofline
     t0 = time.monotonic()
-    state, _ = trainer._step_fn(state, batch)
+    state, m = trainer._step_fn(state, batch)
     jax.block_until_ready(state.params)
+    float(m["loss"])
     compile_s = time.monotonic() - t0
     # steady-state throughput
-    n = 10
+    n = 20
     t0 = time.monotonic()
     for _ in range(n):
         state, metrics = trainer._step_fn(state, batch)
     jax.block_until_ready(state.params)
+    float(metrics["loss"])
     step_s = (time.monotonic() - t0) / n
     # synchronous checkpoint save (what the drain pays)
     t0 = time.monotonic()

@@ -170,9 +170,10 @@ def main(argv=None, stop=None, on_ready=None) -> int:
 
     operator = TPUOperator(client, components)
     stop = stop or threading.Event()
+    prev_handlers = {}
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):
-            signal.signal(sig, lambda *_: stop.set())
+            prev_handlers[sig] = signal.signal(sig, lambda *_: stop.set())
     except ValueError:
         pass  # not the main thread — caller controls the injected stop event
 
@@ -184,26 +185,31 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                 [c.name for c in components], args.interval,
                 f", metrics on :{server.port}" if server else "")
     ticks = 0
+    last_ok = False
     try:
         while not stop.is_set():
             t0 = time.monotonic()
             states = operator.reconcile()
             ticks += 1
+            last_ok = all(s is not None for s in states.values())
             if server:
                 server.snapshot["text"] = render_metrics(operator, states)
                 # healthy = the last tick reconciled every component; an
                 # apiserver outage flips this off so k8s probes can restart us
-                server.snapshot["healthy"] = all(
-                    s is not None for s in states.values())
+                server.snapshot["healthy"] = last_ok
             if args.once:
                 break
             stop.wait(max(0.0, args.interval - (time.monotonic() - t0)))
     finally:
         if server:
             server.stop()
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
     logger.info("exiting after %d ticks", ticks)
-    print(json.dumps({"ticks": ticks}))
-    return 0
+    print(json.dumps({"ticks": ticks, "last_tick_ok": last_ok}))
+    # a single-tick run (bootstrap/CI Job) must fail loudly if nothing
+    # reconciled; the long-running loop reports through /healthz instead
+    return 0 if (last_ok or not args.once) else 1
 
 
 if __name__ == "__main__":

@@ -129,27 +129,11 @@ def cluster_url(live):
 
 
 def test_apply_crds_cli_live_mode(tmp_path):
-    # "cmd" collides with the stdlib module, so load the CLI by path
-    import importlib.util
     import os
-    spec = importlib.util.spec_from_file_location(
-        "apply_crds_cli", os.path.join(os.path.dirname(__file__), "..",
-                                       "cmd", "apply_crds.py"))
-    cli_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(cli_mod)
-    apply_main = cli_mod.main
+    apply_main = _load_cli("apply_crds").main
     cluster = FakeCluster()
     with FakeAPIServer(cluster, token="t0k") as srv:
-        kubeconfig = {
-            "current-context": "fake",
-            "contexts": [{"name": "fake",
-                          "context": {"cluster": "c", "user": "u"}}],
-            "clusters": [{"name": "c",
-                          "cluster": {"server": srv.base_url}}],
-            "users": [{"name": "u", "user": {"token": "t0k"}}],
-        }
-        kc_path = tmp_path / "kubeconfig"
-        kc_path.write_text(yaml.safe_dump(kubeconfig))
+        kc_path, _ = _write_operator_env(tmp_path, srv.base_url, token="t0k")
         crds_dir = os.path.join(os.path.dirname(__file__), "..", "crds")
         rc = apply_main(["--crds-dir", crds_dir,
                          "--kubeconfig", str(kc_path)])
@@ -313,3 +297,12 @@ def test_operator_binary_metrics_and_shutdown(tmp_path):
         stop.set()
         t.join(timeout=15)
     assert rcs == [0]
+
+
+def test_operator_binary_once_fails_loudly_when_unreachable(tmp_path):
+    # bootstrap/CI contract: a single tick that reconciles nothing is rc=1
+    op = _load_cli("operator")
+    kc, cfg = _write_operator_env(tmp_path, "http://127.0.0.1:1")
+    rc = op.main(["--config", str(cfg), "--kubeconfig", str(kc),
+                  "--once", "--metrics-port", "-1"])
+    assert rc == 1
