@@ -69,10 +69,12 @@ def load_components(path: str):
 
 def build_client(args):
     from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
-                                                       LiveClient)
+                                                       LiveClient,
+                                                       LiveEventRecorder)
     kc = (KubeConfig.in_cluster() if args.in_cluster else
           KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
-    return LiveClient(KubeHTTP(kc))
+    http = KubeHTTP(kc)
+    return LiveClient(http), LiveEventRecorder(http)
 
 
 class MetricsServer:
@@ -156,7 +158,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
 
     try:
         components = load_components(args.config)
-        client = build_client(args)
+        client, recorder = build_client(args)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -168,7 +170,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                                 [args.ensure_crds])
         logger.info("bootstrapped %d CRDs", n)
 
-    operator = TPUOperator(client, components)
+    operator = TPUOperator(client, components, recorder=recorder)
     stop = stop or threading.Event()
     prev_handlers = {}
     try:

@@ -67,6 +67,11 @@ class FakeRecorder(EventRecorder):
         with self._lock:
             self.events.append(make_event(obj, event_type, reason, message))
 
+    def record(self, event: Event) -> None:
+        """Append an already-built Event (the HTTP facade's POST route)."""
+        with self._lock:
+            self.events.append(event)
+
     def drain(self) -> List[Event]:
         with self._lock:
             out, self.events = self.events, []

@@ -140,6 +140,9 @@ class _Handler(BaseHTTPRequestHandler):
             r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/eviction", path)
         if m and method == "POST":
             return self._delete_pod(m.group(1), m.group(2), evict=True)
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
+        if m and method == "POST":
+            return self._record_event(self._body())
         m = re.fullmatch(
             r"/apis/apps/v1(?:/namespaces/([^/]+))?/daemonsets", path)
         if m and method == "GET":
@@ -196,6 +199,25 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, "NotFound", f"pod {ns}/{name} not found")
         self._send(200, {"kind": "Status", "status": "Success"})
 
+    def _record_event(self, ev: Dict) -> None:
+        from .objects import Event
+        # real apiserver semantics: Event names must be unique; a recorder
+        # that reuses names (e.g. a resettable counter) must see the 409
+        name = (ev.get("metadata") or {}).get("name", "")
+        seen = self.server.event_names  # type: ignore[attr-defined]
+        if name in seen:
+            return self._error(409, "AlreadyExists",
+                               f"events \"{name}\" already exists")
+        seen.add(name)
+        inv = ev.get("involvedObject") or {}
+        self.cluster.recorder.record(Event(
+            object_kind=inv.get("kind", ""),
+            object_name=inv.get("name", ""),
+            event_type=ev.get("type", "Normal"),
+            reason=ev.get("reason", ""),
+            message=ev.get("message", "")))
+        self._send(201, ev)
+
     def _crd_create(self, crd: Dict) -> None:
         try:
             self._send(201, self.cluster.create_crd(crd))
@@ -235,6 +257,7 @@ class FakeAPIServer:
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
         self._server.cluster = cluster          # type: ignore[attr-defined]
         self._server.token = token              # type: ignore[attr-defined]
+        self._server.event_names = set()        # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
 

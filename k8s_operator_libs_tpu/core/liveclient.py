@@ -282,6 +282,47 @@ class LiveClient(Client):
         return self
 
 
+class LiveEventRecorder:
+    """record.EventRecorder analog posting real k8s Events (the reference
+    emits one per state/annotation change and drain result —
+    util.go:141-153). Event objects land in the object's namespace (nodes →
+    "default"). Failures are swallowed: an event is advisory, never worth
+    failing a reconcile over."""
+
+    def __init__(self, http: KubeHTTP, namespace: str = "default"):
+        import itertools
+        import threading
+        self._http = http
+        self._default_ns = namespace
+        self._seq = itertools.count()  # itertools.count is thread-safe
+        self._lock = threading.Lock()
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        import time as _time
+        kind = getattr(obj, "kind", type(obj).__name__)
+        meta = getattr(obj, "metadata", None)
+        name = getattr(meta, "name", "")
+        ns = getattr(meta, "namespace", "") or self._default_ns
+        # unique across drain threads AND process restarts (client-go's
+        # recorder uses a timestamp suffix for the same reason): a reused
+        # name would 409 against Events persisted from a prior --once run
+        uid = f"{_time.time_ns():x}.{next(self._seq)}"
+        body = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": f"{name}.{reason.lower()}.{uid}",
+                         "namespace": ns},
+            "involvedObject": {"kind": kind, "name": name,
+                               "namespace": ns if kind != "Node" else ""},
+            "type": event_type, "reason": reason, "message": message,
+            "reportingComponent": "tpu-operator",
+        }
+        try:
+            self._http.request("POST", f"/api/v1/namespaces/{ns}/events",
+                               body=body)
+        except Exception:  # advisory only
+            pass
+
+
 CRD_PATH = "/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
 
 

@@ -306,3 +306,48 @@ def test_operator_binary_once_fails_loudly_when_unreachable(tmp_path):
     rc = op.main(["--config", str(cfg), "--kubeconfig", str(kc),
                   "--once", "--metrics-port", "-1"])
     assert rc == 1
+
+
+def test_live_event_recorder_posts_events(tmp_path):
+    """State transitions driven through the operator binary land as real
+    k8s Events via POST /api/v1/namespaces/{ns}/events (reference
+    util.go:141-153 parity for the production transport)."""
+    op = _load_cli("operator")
+    cluster = FakeCluster()
+    _seed(cluster)
+    cluster.bump_daemonset_revision("libtpu", "tpu", "v2")
+    with FakeAPIServer(cluster) as srv:
+        kc, cfg = _write_operator_env(tmp_path, srv.base_url)
+        for _ in range(6):
+            assert op.main(["--config", str(cfg), "--kubeconfig", str(kc),
+                            "--once", "--metrics-port", "-1"]) == 0
+            cluster.reconcile_daemonsets()
+        events = cluster.recorder.events
+        assert any(e.reason == "LIBTPUDriverUpgrade" and
+                   e.object_kind == "Node" for e in events), events[:5]
+
+
+def test_event_name_collision_rejected_and_recorder_unique():
+    """The fake apiserver enforces Event-name uniqueness (409 on duplicates,
+    real-apiserver parity), and LiveEventRecorder's timestamped names never
+    collide across recorder restarts (the --once Job case)."""
+    from k8s_operator_libs_tpu.core.liveclient import LiveEventRecorder
+
+    cluster = FakeCluster()
+    node = cluster.add_node("n0")
+    with FakeAPIServer(cluster) as srv:
+        http = KubeHTTP(KubeConfig(server=srv.base_url))
+        dup = {"metadata": {"name": "fixed", "namespace": "default"},
+               "involvedObject": {"kind": "Node", "name": "n0"},
+               "type": "Normal", "reason": "R", "message": "m"}
+        http.request("POST", "/api/v1/namespaces/default/events", body=dup)
+        with pytest.raises(ConflictError):
+            http.request("POST", "/api/v1/namespaces/default/events",
+                         body=dup)
+        # two recorder "processes" (restart simulation) + threads: no drops
+        for _ in range(2):
+            rec = LiveEventRecorder(http)
+            for _ in range(3):
+                rec.event(node, "Normal", "LIBTPUDriverUpgrade", "msg")
+        assert len([e for e in cluster.recorder.events
+                    if e.reason == "LIBTPUDriverUpgrade"]) == 6
