@@ -204,11 +204,12 @@ class _Handler(BaseHTTPRequestHandler):
         # real apiserver semantics: Event names must be unique; a recorder
         # that reuses names (e.g. a resettable counter) must see the 409
         name = (ev.get("metadata") or {}).get("name", "")
-        seen = self.server.event_names  # type: ignore[attr-defined]
-        if name in seen:
-            return self._error(409, "AlreadyExists",
-                               f"events \"{name}\" already exists")
-        seen.add(name)
+        with self.server.event_lock:  # type: ignore[attr-defined]
+            seen = self.server.event_names  # type: ignore[attr-defined]
+            if name in seen:
+                return self._error(409, "AlreadyExists",
+                                   f"events \"{name}\" already exists")
+            seen.add(name)
         inv = ev.get("involvedObject") or {}
         self.cluster.recorder.record(Event(
             object_kind=inv.get("kind", ""),
@@ -258,6 +259,7 @@ class FakeAPIServer:
         self._server.cluster = cluster          # type: ignore[attr-defined]
         self._server.token = token              # type: ignore[attr-defined]
         self._server.event_names = set()        # type: ignore[attr-defined]
+        self._server.event_lock = threading.Lock()  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
 

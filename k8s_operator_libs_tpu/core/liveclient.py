@@ -291,11 +291,9 @@ class LiveEventRecorder:
 
     def __init__(self, http: KubeHTTP, namespace: str = "default"):
         import itertools
-        import threading
         self._http = http
         self._default_ns = namespace
         self._seq = itertools.count()  # itertools.count is thread-safe
-        self._lock = threading.Lock()
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         import time as _time

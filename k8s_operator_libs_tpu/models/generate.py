@@ -56,8 +56,10 @@ def _attend_cached(cfg: LlamaConfig, q: jax.Array, k_cache: jax.Array,
                    v_cache: jax.Array, q_pos: jax.Array,
                    cache_len: jax.Array) -> jax.Array:
     """q: [B, Tq, H, Dh] against cache [B, max_len, KV, Dh]; positions ≥
-    cache validity are masked. Returns [B, Tq, H, Dh]."""
-    H, KV = cfg.n_heads, cfg.n_kv_heads
+    cache validity are masked. Returns [B, Tq, H, Dh]. Head counts come from
+    the array shapes, so this works unchanged on tensor-parallel shards
+    (H/tp, KV/tp local heads)."""
+    H, KV = q.shape[2], k_cache.shape[2]
     if KV != H:
         rep = H // KV
         k_cache = jnp.repeat(k_cache, rep, axis=2)
@@ -75,11 +77,17 @@ def _attend_cached(cfg: LlamaConfig, q: jax.Array, k_cache: jax.Array,
 
 
 def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
-                    cfg: LlamaConfig) -> Tuple[jax.Array, KVCache]:
+                    cfg: LlamaConfig,
+                    tp_axis: Optional[str] = None) -> Tuple[jax.Array, KVCache]:
     """Forward [B, T] starting at cache.length; appends K/V to the cache.
-    Used for both prefill (T = prompt len) and decode (T = 1)."""
+    Used for both prefill (T = prompt len) and decode (T = 1).
+
+    With ``tp_axis`` (inside shard_map) the weights and cache arrive with
+    head dims already sharded (Megatron column/row split); two psums per
+    block restore the full residual stream. Head counts are derived from
+    the weight shapes, so the same code runs both ways."""
     B, T = tokens.shape
-    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    Dh = cfg.head_dim
     positions = cache.length + jnp.arange(T, dtype=jnp.int32)
     pos_b = jnp.broadcast_to(positions, (B, T))
     x = params["embed"][tokens]
@@ -87,6 +95,8 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     def body(carry, layer_in):
         x, = carry
         layer, k_cache_l, v_cache_l = layer_in
+        H = layer["wq"].shape[-1] // Dh     # local heads (H/tp under TP)
+        KV = layer["wk"].shape[-1] // Dh
         h = rms_norm(x, layer["attn_norm"])
         q = (h @ layer["wq"]).reshape(B, T, H, Dh)
         k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
@@ -99,11 +109,17 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
             v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
         attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
                               cache.length)
-        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+        attn_out = attn.reshape(B, T, H * Dh) @ layer["wo"]
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        x = x + attn_out
         h2 = rms_norm(x, layer["mlp_norm"])
         gate = jax.nn.silu((h2 @ layer["w_gate"]).astype(jnp.float32)
                            ).astype(h2.dtype)
-        x = x + (gate * (h2 @ layer["w_up"])) @ layer["w_down"]
+        mlp_out = (gate * (h2 @ layer["w_up"])) @ layer["w_down"]
+        if tp_axis is not None:
+            mlp_out = jax.lax.psum(mlp_out, tp_axis)
+        x = x + mlp_out
         return (x,), (k_cache_l, v_cache_l)
 
     (x,), (new_k, new_v) = jax.lax.scan(
@@ -114,19 +130,13 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
-def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
-             max_new_tokens: int = 32, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
-    """Greedy (temperature=0) or sampled decoding. prompt: [B, Tp] int32 →
-    [B, Tp + max_new_tokens]. One prefill pass + scanned single-token decode
-    steps, all inside one jit."""
-    B, Tp = prompt.shape
-    max_len = Tp + max_new_tokens
-    cache = init_cache(cfg, B, max_len)
-    logits, cache = _forward_cached(params, prompt, cache, cfg)
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
+def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
+                 cfg: LlamaConfig, max_new_tokens: int, temperature: float,
+                 rng: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+    """Prefill + scanned single-token decode: the one loop both the
+    single-device and tensor-parallel paths share (only the cache layout
+    and the tp_axis psums differ)."""
+    logits, cache = _forward_cached(params, prompt, cache, cfg, tp_axis)
 
     def sample(logits_last, key):
         if temperature == 0.0:
@@ -138,12 +148,79 @@ def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
 
     def step(carry, key):
         tok, cache = carry
-        logits, cache = _forward_cached(params, tok[:, None], cache, cfg)
-        nxt = sample(logits[:, -1], key)
-        return (nxt, cache), tok
+        logits, cache = _forward_cached(params, tok[:, None], cache, cfg,
+                                        tp_axis)
+        return (sample(logits[:, -1], key), cache), tok
 
     keys = jax.random.split(rng, max_new_tokens - 1)
     (last, _), toks = jax.lax.scan(step, (first, cache), keys)
     generated = jnp.concatenate(
         [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled decoding. prompt: [B, Tp] int32 →
+    [B, Tp + max_new_tokens]. One prefill pass + scanned single-token decode
+    steps, all inside one jit."""
+    B, Tp = prompt.shape
+    cache = init_cache(cfg, B, Tp + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _decode_loop(params, prompt, cache, cfg, max_new_tokens,
+                        temperature, rng)
+
+
+def tp_generate_param_specs():
+    """At-rest / shard_map specs for tensor-parallel decode: Megatron
+    column-split wq/wk/wv/w_gate/w_up, row-split wo/w_down; embed/lm_head
+    replicated (full logits are needed on every device for sampling)."""
+    from jax.sharding import PartitionSpec as P
+    blocks = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tensor"), "wk": P(None, None, "tensor"),
+        "wv": P(None, None, "tensor"), "wo": P(None, "tensor", None),
+        "mlp_norm": P(None, None),
+        "w_gate": P(None, None, "tensor"), "w_up": P(None, None, "tensor"),
+        "w_down": P(None, "tensor", None),
+    }
+    return {"embed": P(None, None), "blocks": blocks,
+            "final_norm": P(None), "lm_head": P(None, None)}
+
+
+def make_tp_generate(cfg: LlamaConfig, mesh, max_new_tokens: int = 32,
+                     temperature: float = 0.0):
+    """Tensor-parallel ``generate(params, prompt, rng?) -> tokens``: heads
+    and FFN columns sharded over the mesh's "tensor" axis, and — the real
+    inference win — the KV cache sharded on its head axis, so each device
+    holds KV/tp of the cache (decode is cache-bandwidth-bound; TP divides
+    both the weight streaming and the cache traffic per chip)."""
+    from jax.sharding import PartitionSpec as P
+    tp = mesh.shape["tensor"]
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(f"heads {cfg.n_heads}/kv {cfg.n_kv_heads} not "
+                         f"divisible by {tp}-way tensor parallelism")
+    if cfg.d_ff % tp:
+        raise ValueError(f"d_ff {cfg.d_ff} not divisible by {tp}")
+
+    def shard_gen(params, prompt, rng):
+        B, Tp = prompt.shape
+        # local cache shard: KV/tp heads per device
+        local_cfg = dataclasses.replace(cfg, n_kv_heads=cfg.n_kv_heads // tp)
+        cache = init_cache(local_cfg, B, Tp + max_new_tokens)
+        return _decode_loop(params, prompt, cache, cfg, max_new_tokens,
+                            temperature, rng, tp_axis="tensor")
+
+    sharded = jax.shard_map(
+        shard_gen, mesh=mesh,
+        in_specs=(tp_generate_param_specs(), P(None, None), P(None)),
+        out_specs=P(None, None))
+
+    def generate_fn(params, prompt, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return sharded(params, prompt, rng)
+
+    return jax.jit(generate_fn)
