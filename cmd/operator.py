@@ -144,6 +144,10 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     p.add_argument("--in-cluster", action="store_true")
     p.add_argument("--interval", type=float, default=30.0,
                    help="seconds between reconcile ticks")
+    p.add_argument("--watch", action="store_true",
+                   help="watch nodes and reconcile immediately on change "
+                        "(controller-runtime style; --interval becomes the "
+                        "resync fallback)")
     p.add_argument("--once", action="store_true",
                    help="run a single reconcile tick and exit")
     p.add_argument("--metrics-port", type=int, default=8080,
@@ -183,6 +187,26 @@ def main(argv=None, stop=None, on_ready=None) -> int:
               if args.metrics_port >= 0 else None)
     if on_ready is not None:
         on_ready(server)
+    dirty = threading.Event()  # watch events request an early tick
+    if args.watch and not args.once:
+        def watch_loop(source_name, watch_fn):
+            while not stop.is_set():
+                try:
+                    for _etype, _obj in watch_fn(
+                            timeout_seconds=args.interval):
+                        dirty.set()
+                        if stop.is_set():
+                            return
+                except Exception as exc:
+                    logger.warning("%s watch dropped (%s); retrying",
+                                   source_name, exc)
+                    stop.wait(1.0)
+        # nodes drive admission/cordon/uncordon; pods drive the
+        # driver-restart and wait-for-jobs transitions
+        for name, fn in (("node", client.watch_nodes),
+                         ("pod", client.watch_pods)):
+            threading.Thread(target=watch_loop, args=(name, fn),
+                             daemon=True).start()
     logger.info("managing %s every %.0fs%s",
                 [c.name for c in components], args.interval,
                 f", metrics on :{server.port}" if server else "")
@@ -201,7 +225,19 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                 server.snapshot["healthy"] = last_ok
             if args.once:
                 break
-            stop.wait(max(0.0, args.interval - (time.monotonic() - t0)))
+            remaining = max(0.0, args.interval - (time.monotonic() - t0))
+            if args.watch:
+                # wake on the first watch event OR at the resync interval,
+                # still honoring stop promptly; then coalesce the burst a
+                # state transition causes
+                deadline = time.monotonic() + remaining
+                while (not stop.is_set() and not dirty.is_set()
+                       and time.monotonic() < deadline):
+                    dirty.wait(0.25)
+                dirty.clear()
+                stop.wait(0.05)
+            else:
+                stop.wait(remaining)
     finally:
         if server:
             server.stop()

@@ -22,6 +22,7 @@ API objects whose status tests hand-set (reference upgrade_suit_test.go:73-97,
 from __future__ import annotations
 
 import heapq
+import queue
 import itertools
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -94,8 +95,29 @@ class FakeCluster:
         self._pending_seq = itertools.count()
         self._cache: Dict[Key, object] = {}
         self._crds: Dict[str, dict] = {}
+        self._watchers: List["queue.Queue"] = []
         self.recorder = FakeRecorder()
         self.client: Client = _FakeClient(self, cached=True)
+
+    # ------------------------------------------------------------------ watch
+
+    def subscribe(self) -> "queue.Queue":
+        """Watch the STORE (uncached — real apiserver watch semantics):
+        every create/update/delete lands as ("ADDED"|"MODIFIED"|"DELETED",
+        kind, deep-copied object) on the returned queue."""
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            self._watchers.append(q)
+        return q
+
+    def unsubscribe(self, q: "queue.Queue") -> None:
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    def _notify(self, event_type: str, kind: str, obj) -> None:
+        for q in list(self._watchers):
+            q.put((event_type, kind, deep_copy(obj)))
 
     # ------------------------------------------------------------------ store
 
@@ -136,6 +158,7 @@ class FakeCluster:
             self._bump(stored)
             self._store[key] = stored
             self._publish(key, stored)
+            self._notify("ADDED", key[0], stored)
             return deep_copy(stored)
 
     def update(self, obj):
@@ -153,6 +176,7 @@ class FakeCluster:
             self._bump(stored)
             self._store[key] = stored
             self._publish(key, stored)
+            self._notify("MODIFIED", key[0], stored)
             return deep_copy(stored)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -160,8 +184,10 @@ class FakeCluster:
             key = (kind, namespace, name)
             if key not in self._store:
                 raise NotFoundError(key)
+            gone = self._store[key]
             del self._store[key]
             self._publish(key, None)
+            self._notify("DELETED", kind, gone)
 
     def get(self, kind: str, namespace: str, name: str, cached: bool = False):
         with self._lock:

@@ -122,6 +122,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         m = re.fullmatch(r"/api/v1/nodes", path)
         if m and method == "GET":
+            if qs.get("watch", ["false"])[0] == "true":
+                return self._watch("Node", None, qs)
             return self._list("Node", None, qs)
         m = re.fullmatch(r"/api/v1/nodes/([^/]+)", path)
         if m and method == "GET":
@@ -130,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._patch_node(m.group(1), self._body())
         m = re.fullmatch(r"/api/v1(?:/namespaces/([^/]+))?/pods", path)
         if m and method == "GET":
+            if qs.get("watch", ["false"])[0] == "true":
+                return self._watch("Pod", m.group(1), qs)
             return self._list("Pod", m.group(1), qs)
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
         if m and method == "GET":
@@ -198,6 +202,49 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError:
             return self._error(404, "NotFound", f"pod {ns}/{name} not found")
         self._send(200, {"kind": "Status", "status": "Success"})
+
+    def _watch(self, kind: str, namespace: Optional[str], qs: Dict) -> None:
+        """Streaming watch: one JSON object per line, connection held open
+        until ``timeoutSeconds`` (default 30) or client disconnect — the
+        real apiserver's chunked watch shape (client-go reconnects on
+        timeout; so does our client)."""
+        import json as _json
+        import queue as _queue
+        import time as _time
+        sel = _parse_label_selector(qs)
+        timeout = float(qs.get("timeoutSeconds", ["30"])[0])
+        q = self.cluster.subscribe()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.flush()
+            deadline = _time.monotonic() + timeout
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    etype, ekind, obj = q.get(timeout=min(remaining, 0.25))
+                except _queue.Empty:
+                    continue
+                if ekind != kind:
+                    continue
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if sel and not all(obj.metadata.labels.get(k) == v
+                                   for k, v in sel.items()):
+                    continue
+                line = _json.dumps({"type": etype,
+                                    "object": _TO_JSON[kind](obj)})
+                self.wfile.write(line.encode() + b"\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up — normal watch termination
+        finally:
+            self.cluster.unsubscribe(q)
+            self.close_connection = True
 
     def _record_event(self, ev: Dict) -> None:
         from .objects import Event

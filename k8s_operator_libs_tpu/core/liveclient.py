@@ -143,20 +143,41 @@ class KubeHTTP:
                                     config.client_key_file)
             self._ctx = ctx
 
+    def _build_request(self, method: str, path: str,
+                       params: Optional[Dict[str, str]] = None,
+                       data: Optional[bytes] = None
+                       ) -> urllib.request.Request:
+        url = self.config.server + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        return req
+
+    def stream_lines(self, path: str,
+                     params: Optional[Dict[str, str]] = None,
+                     read_timeout: float = 60.0):
+        """GET a line-delimited JSON stream (the k8s watch wire format),
+        yielding one parsed dict per line until the server closes the
+        connection. Used by :meth:`LiveClient.watch_nodes`."""
+        req = self._build_request("GET", path, params)
+        with urllib.request.urlopen(req, context=self._ctx,
+                                    timeout=read_timeout) as resp:
+            for raw in resp:
+                raw = raw.strip()
+                if raw:
+                    yield json.loads(raw)
+
     def request(self, method: str, path: str,
                 body: Optional[Dict] = None,
                 params: Optional[Dict[str, str]] = None,
                 content_type: str = "application/json") -> Dict:
-        url = self.config.server + path
-        if params:
-            url += "?" + urllib.parse.urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
+        req = self._build_request(method, path, params, data)
         if data is not None:
             req.add_header("Content-Type", content_type)
-        if self.config.token:
-            req.add_header("Authorization", f"Bearer {self.config.token}")
         try:
             with urllib.request.urlopen(req, context=self._ctx,
                                         timeout=30) as resp:
@@ -170,6 +191,17 @@ class KubeHTTP:
             raise RuntimeError(
                 f"{method} {path}: HTTP {exc.code}: {detail}") from exc
         return json.loads(payload) if payload else {}
+
+
+class WatchError(RuntimeError):
+    """A watch stream delivered an ERROR event (e.g. 410 Gone: the resource
+    version expired). Consumers must re-list and re-establish the watch —
+    cmd/operator.py's watch loop does so by catching and reconnecting."""
+
+
+def _check_watch_error(ev: Dict) -> None:
+    if ev.get("type") == "ERROR":
+        raise WatchError(str(ev.get("object")))
 
 
 def _selector_params(label_selector: Optional[Dict[str, str]] = None,
@@ -240,6 +272,37 @@ class LiveClient(Client):
     def get_job(self, namespace: str, name: str) -> Job:
         return serde.job_from_json(self._http.request(
             "GET", f"/apis/batch/v1/namespaces/{namespace}/jobs/{name}"))
+
+    # ------------------------------------------------------------- watch
+
+    def watch_nodes(self, label_selector=None, timeout_seconds: float = 30.0):
+        """Yield ("ADDED"|"MODIFIED"|"DELETED", Node) until the server ends
+        the watch window (controller-runtime informer analog: consumers
+        loop, reconnecting per window — see cmd/operator.py --watch)."""
+        params = _selector_params(label_selector) or {}
+        params.update({"watch": "true",
+                       "timeoutSeconds": str(timeout_seconds)})
+        for ev in self._http.stream_lines("/api/v1/nodes", params,
+                                          read_timeout=timeout_seconds + 30):
+            _check_watch_error(ev)
+            yield ev.get("type", ""), serde.node_from_json(
+                ev.get("object") or {})
+
+    def watch_pods(self, namespace: Optional[str] = None,
+                   label_selector=None, timeout_seconds: float = 30.0):
+        """Yield ("ADDED"|"MODIFIED"|"DELETED", Pod) — an operator watches
+        the driver pods it owns as well as nodes (driver-pod recreation is
+        what unblocks pod-restart-required)."""
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        params = _selector_params(label_selector) or {}
+        params.update({"watch": "true",
+                       "timeoutSeconds": str(timeout_seconds)})
+        for ev in self._http.stream_lines(path, params,
+                                          read_timeout=timeout_seconds + 30):
+            _check_watch_error(ev)
+            yield ev.get("type", ""), serde.pod_from_json(
+                ev.get("object") or {})
 
     # ------------------------------------------------------------ writes
 

@@ -144,7 +144,10 @@ def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
         return jax.random.categorical(
             key, logits_last / temperature, axis=-1).astype(jnp.int32)
 
-    first = sample(logits[:, -1], rng)
+    # split BEFORE the first sample — reusing rng as both a sampling key and
+    # the split root correlates the first token with later draws
+    rng, first_key = jax.random.split(rng)
+    first = sample(logits[:, -1], first_key)
 
     def step(carry, key):
         tok, cache = carry
