@@ -201,10 +201,19 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                     logger.warning("%s watch dropped (%s); retrying",
                                    source_name, exc)
                     stop.wait(1.0)
-        # nodes drive admission/cordon/uncordon; pods drive the
-        # driver-restart and wait-for-jobs transitions
-        for name, fn in (("node", client.watch_nodes),
-                         ("pod", client.watch_pods)):
+        # nodes drive admission/cordon/uncordon; each component's DRIVER
+        # pods (scoped by namespace + selector — never a cluster-wide pod
+        # watch, which would tick on unrelated workload churn) drive the
+        # driver-restart transitions
+        import functools
+        sources = [("node", client.watch_nodes)]
+        for comp in components:
+            sources.append((
+                f"pod:{comp.name}",
+                functools.partial(client.watch_pods,
+                                  namespace=comp.namespace,
+                                  label_selector=comp.driver_labels)))
+        for name, fn in sources:
             threading.Thread(target=watch_loop, args=(name, fn),
                              daemon=True).start()
     logger.info("managing %s every %.0fs%s",

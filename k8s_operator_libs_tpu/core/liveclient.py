@@ -281,7 +281,8 @@ class LiveClient(Client):
         loop, reconnecting per window — see cmd/operator.py --watch)."""
         params = _selector_params(label_selector) or {}
         params.update({"watch": "true",
-                       "timeoutSeconds": str(timeout_seconds)})
+                       # int string: the real apiserver ParseInts this
+                       "timeoutSeconds": str(int(timeout_seconds))})
         for ev in self._http.stream_lines("/api/v1/nodes", params,
                                           read_timeout=timeout_seconds + 30):
             _check_watch_error(ev)
@@ -297,7 +298,8 @@ class LiveClient(Client):
                 else "/api/v1/pods")
         params = _selector_params(label_selector) or {}
         params.update({"watch": "true",
-                       "timeoutSeconds": str(timeout_seconds)})
+                       # int string: the real apiserver ParseInts this
+                       "timeoutSeconds": str(int(timeout_seconds))})
         for ev in self._http.stream_lines(path, params,
                                           read_timeout=timeout_seconds + 30):
             _check_watch_error(ev)

@@ -102,8 +102,16 @@ class NodeUpgradeStateProvider:
     def _wait_synced(self, name: str, pred) -> None:
         """Poll-until-visible (:92-117). Raises CacheSyncTimeoutError after
         sync_timeout — the reference returns an error, failing the current
-        ApplyState pass; the next reconcile retries idempotently."""
+        ApplyState pass; the next reconcile retries idempotently.
+
+        Polling is ADAPTIVE where the reference's is fixed-1 s: start at
+        sync_poll/20 and back off x2 to sync_poll. Same contract (bounded by
+        sync_timeout, poll-until-visible), far lower added latency — informer
+        caches typically sync in tens of ms, and at slice scale the barrier
+        runs once per node per transition (16-host v5p-64: ~140 barriers per
+        rolling upgrade, so 1 s vs ~0.1 s each is minutes of downtime)."""
         deadline = self._clock.now() + self._sync_timeout
+        poll = self._sync_poll / 20.0
         while True:
             try:
                 if pred(self._client.get_node(name)):
@@ -114,4 +122,5 @@ class NodeUpgradeStateProvider:
                 raise CacheSyncTimeoutError(
                     f"cached client did not reflect write to node {name} "
                     f"within {self._sync_timeout}s")
-            self._clock.sleep(self._sync_poll)
+            self._clock.sleep(poll)
+            poll = min(poll * 2.0, self._sync_poll)
