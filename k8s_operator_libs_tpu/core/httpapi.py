@@ -135,6 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
             if qs.get("watch", ["false"])[0] == "true":
                 return self._watch("Pod", m.group(1), qs)
             return self._list("Pod", m.group(1), qs)
+        if m and method == "POST" and m.group(1):
+            return self._create_pod(m.group(1), self._body())
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
         if m and method == "GET":
             return self._get_one("Pod", m.group(1), m.group(2))
@@ -195,6 +197,16 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError:
             return self._error(404, "NotFound", f"node {name} not found")
         self._send(200, serde.node_to_json(node))
+
+    def _create_pod(self, ns: str, body: Dict) -> None:
+        pod = serde.pod_from_json(body)
+        pod.metadata.namespace = ns
+        try:
+            created = self.cluster.create(pod)
+            self.cluster.flush_cache()
+        except ConflictError as exc:
+            return self._error(409, "AlreadyExists", str(exc))
+        self._send(201, serde.pod_to_json(created))
 
     def _delete_pod(self, ns: str, name: str, evict: bool = False) -> None:
         try:

@@ -326,6 +326,13 @@ class LiveClient(Client):
             body={"spec": {"unschedulable": unschedulable}},
             content_type="application/strategic-merge-patch+json"))
 
+    def create_pod(self, pod: Pod) -> Pod:
+        """POST a pod (the SliceScheduler's placement write)."""
+        ns = pod.metadata.namespace or "default"
+        return serde.pod_from_json(self._http.request(
+            "POST", f"/api/v1/namespaces/{ns}/pods",
+            body=serde.pod_to_json(pod)))
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         body = None
         if grace_period_seconds is not None:

@@ -36,12 +36,15 @@ WORKLOAD_LABEL = "tpu.dev/workload"
 
 @dataclasses.dataclass
 class TPUWorkload:
-    """A JAX job wanting one whole slice."""
+    """A JAX job wanting ``num_slices`` whole slices (1 = single-slice;
+    >1 = multislice over DCN, wired with the MEGASCALE env JAX's multislice
+    runtime reads)."""
 
     name: str
     accelerator: str            # e.g. "tpu-v5p-slice"
     topology: str               # e.g. "4x4x4"
     namespace: str = "default"
+    num_slices: int = 1
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
 
@@ -49,9 +52,10 @@ class TPUWorkload:
 @dataclasses.dataclass
 class Placement:
     workload: str
-    slice_id: str
+    slice_id: str               # first slice (compat); see slice_ids
     node_names: List[str]
     pods: List[str]
+    slice_ids: List[str] = dataclasses.field(default_factory=list)
 
 
 class SliceScheduler:
@@ -97,40 +101,88 @@ class SliceScheduler:
     # -- placement ----------------------------------------------------------
 
     def place(self, workload: TPUWorkload) -> Optional[Placement]:
-        """Bind the workload to the first eligible slice; returns None when
-        no slice fits (caller requeues — same contract as a reconcile that
-        cannot progress)."""
+        """Bind the workload to the first ``num_slices`` eligible slices —
+        all-or-nothing (a multislice job without all its slices would wedge
+        at MEGASCALE init); returns None when not enough slices fit (caller
+        requeues — same contract as a reconcile that cannot progress).
+
+        Single-slice pods get the JAX distributed-init env; multislice pods
+        additionally get the MEGASCALE variables JAX's multislice runtime
+        reads (slices talk over DCN; slice 0's worker 0 coordinates)."""
+        if workload.num_slices < 1:
+            raise ValueError(f"workload {workload.name}: num_slices must be "
+                             f">= 1, got {workload.num_slices}")
         slices = self.eligible_slices(workload.accelerator, workload.topology)
-        if not slices:
-            logger.info("no eligible %s/%s slice for workload %s",
-                        workload.accelerator, workload.topology, workload.name)
+        if len(slices) < workload.num_slices:
+            logger.info("need %d eligible %s/%s slices for workload %s, "
+                        "have %d", workload.num_slices, workload.accelerator,
+                        workload.topology, workload.name, len(slices))
             return None
-        slice_id, members = sorted(slices.items())[0]
-        hostnames = ",".join(
-            f"{workload.name}-{i}" for i in range(len(members)))
+        chosen = sorted(slices.items())[:workload.num_slices]
+        multi = workload.num_slices > 1
         per_host = chips_per_host(workload.accelerator)
+        # worker-0-of-slice-0 coordinates; a slice's pods are named
+        # <prefix>-<worker_id> with prefix = workload name (+ slice idx
+        # when multislice)
+        coordinator = (f"{workload.name}-0-0" if multi
+                       else f"{workload.name}-0")
         pods = []
-        for worker_id, node in enumerate(members):
-            pod = Pod(metadata=ObjectMeta(
-                name=f"{workload.name}-{worker_id}",
-                namespace=workload.namespace,
-                labels={**workload.labels, WORKLOAD_LABEL: workload.name}))
-            pod.spec.node_name = node.metadata.name
-            pod.spec.resource_requests = {TPU_RESOURCE: per_host}
-            pod.spec.env = {
-                **workload.env,
-                "TPU_WORKER_ID": str(worker_id),
-                "TPU_WORKER_HOSTNAMES": hostnames,
-                "TPU_ACCELERATOR_TYPE": workload.accelerator,
-                "TPU_TOPOLOGY": workload.topology,
-                # JAX distributed init: worker 0 is the coordinator
-                "JAX_COORDINATOR_ADDRESS": f"{workload.name}-0:8476",
-            }
-            pods.append(pod)
-        created = [self._create_pod(p) for p in pods]
-        return Placement(workload=workload.name, slice_id=slice_id,
-                         node_names=[n.metadata.name for n in members],
-                         pods=[p.metadata.name for p in created])
+        all_nodes = []
+        for slice_idx, (slice_id, members) in enumerate(chosen):
+            prefix = (f"{workload.name}-{slice_idx}" if multi
+                      else workload.name)
+            hostnames = ",".join(f"{prefix}-{i}"
+                                 for i in range(len(members)))
+            for worker_id, node in enumerate(members):
+                pod = Pod(metadata=ObjectMeta(
+                    name=f"{prefix}-{worker_id}",
+                    namespace=workload.namespace,
+                    labels={**workload.labels,
+                            WORKLOAD_LABEL: workload.name}))
+                pod.spec.node_name = node.metadata.name
+                pod.spec.resource_requests = {TPU_RESOURCE: per_host}
+                env = {
+                    **workload.env,
+                    "TPU_WORKER_ID": str(worker_id),
+                    "TPU_WORKER_HOSTNAMES": hostnames,
+                    "TPU_ACCELERATOR_TYPE": workload.accelerator,
+                    "TPU_TOPOLOGY": workload.topology,
+                    "JAX_COORDINATOR_ADDRESS": f"{coordinator}:8476",
+                }
+                if multi:
+                    env.update({
+                        "MEGASCALE_NUM_SLICES": str(workload.num_slices),
+                        "MEGASCALE_SLICE_ID": str(slice_idx),
+                        "MEGASCALE_COORDINATOR_ADDRESS":
+                            f"{coordinator}:8080",
+                    })
+                pod.spec.env = env
+                pods.append(pod)
+            all_nodes.extend(n.metadata.name for n in members)
+        # all-or-nothing extends to creation: a partial multislice job would
+        # hold TPUs while wedged at init AND block retries via _slice_busy —
+        # on any failure, roll back what was created and let the caller
+        # requeue
+        created = []
+        try:
+            for p in pods:
+                created.append(self._create_pod(p))
+        except Exception:
+            logger.exception("placement of %s failed after %d/%d pods; "
+                             "rolling back", workload.name, len(created),
+                             len(pods))
+            for p in created:
+                try:
+                    self._client.delete_pod(p.metadata.namespace,
+                                            p.metadata.name)
+                except Exception:
+                    logger.warning("rollback: could not delete %s/%s",
+                                   p.metadata.namespace, p.metadata.name)
+            return None
+        return Placement(workload=workload.name, slice_id=chosen[0][0],
+                         node_names=all_nodes,
+                         pods=[p.metadata.name for p in created],
+                         slice_ids=[sid for sid, _ in chosen])
 
     def _create_pod(self, pod: Pod) -> Pod:
         # the abstract Client has no generic create; FakeCluster and real

@@ -434,3 +434,27 @@ def test_operator_watch_mode_reconciles_without_resync(tmp_path):
             stop.set()
             t.join(timeout=15)
         assert rcs == [0]
+
+
+def test_slice_scheduler_places_over_live_http(live):
+    """SliceScheduler placement (pod creation with TPU env) works on the
+    production transport: LiveClient.create_pod -> POST pods."""
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler, TPUWorkload
+    from k8s_operator_libs_tpu.tpu.topology import (
+        GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL, GKE_TOPOLOGY_LABEL)
+
+    cluster, cli = live
+    labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+              GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-x"}
+    for i in range(4):
+        cluster.add_node(f"px-h{i}", labels=labels)
+    placement = SliceScheduler(cli).place(TPUWorkload(
+        name="live-job", accelerator="tpu-v5-lite-podslice", topology="4x4"))
+    assert placement is not None
+    pods = cli.list_pods(namespace="default")
+    assert len(pods) == 4
+    env = {p.metadata.name: p.spec.env for p in pods}
+    assert env["live-job-2"]["TPU_WORKER_ID"] == "2"
+    assert env["live-job-0"]["JAX_COORDINATOR_ADDRESS"] == "live-job-0:8476"
+    assert all(p.spec.resource_requests.get("google.com/tpu") == 4
+               for p in pods)

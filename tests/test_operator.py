@@ -113,3 +113,87 @@ def test_metrics_collect_and_render(cluster, clock, keys):
     text = render_prometheus("drv", metrics)
     assert 'tpu_operator_total_managed_nodes{component="drv"} 1' in text
     assert "# TYPE" in text
+
+
+def _add_slice(cluster, pool, hosts=4, accel="tpu-v5-lite-podslice",
+               topo="4x4"):
+    labels = {GKE_ACCELERATOR_LABEL: accel, GKE_TOPOLOGY_LABEL: topo,
+              GKE_NODEPOOL_LABEL: pool}
+    for i in range(hosts):
+        cluster.add_node(f"{pool}-h{i}", labels=labels)
+
+
+def test_multislice_placement_all_or_nothing(cluster):
+    """num_slices=2 binds two whole slices with MEGASCALE env over DCN, or
+    nothing at all (a partial multislice job would wedge at init)."""
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler
+
+    _add_slice(cluster, "pool-a")
+    sched = SliceScheduler(cluster.client)
+    wl = TPUWorkload(name="ms", accelerator="tpu-v5-lite-podslice",
+                     topology="4x4", num_slices=2)
+    assert sched.place(wl) is None  # only one slice available
+
+    _add_slice(cluster, "pool-b")
+    placement = sched.place(wl)
+    assert placement is not None
+    assert placement.slice_ids == ["pool-a", "pool-b"]
+    assert len(placement.pods) == 8  # 2 slices x 4 hosts
+    pods = cluster.client.direct().list_pods(namespace="default")
+    by_name = {p.metadata.name: p for p in pods}
+    p00 = by_name["ms-0-0"]
+    assert p00.spec.env["MEGASCALE_NUM_SLICES"] == "2"
+    assert p00.spec.env["MEGASCALE_SLICE_ID"] == "0"
+    assert p00.spec.env["JAX_COORDINATOR_ADDRESS"] == "ms-0-0:8476"
+    p13 = by_name["ms-1-3"]
+    assert p13.spec.env["MEGASCALE_SLICE_ID"] == "1"
+    assert p13.spec.env["TPU_WORKER_ID"] == "3"
+    assert (p13.spec.env["MEGASCALE_COORDINATOR_ADDRESS"]
+            == p00.spec.env["MEGASCALE_COORDINATOR_ADDRESS"])
+
+
+def test_single_slice_placement_env_unchanged(cluster):
+    """num_slices=1 keeps the original pod naming and env (no MEGASCALE)."""
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler
+
+    _add_slice(cluster, "pool-a")
+    placement = SliceScheduler(cluster.client).place(
+        TPUWorkload(name="j", accelerator="tpu-v5-lite-podslice",
+                    topology="4x4"))
+    assert placement is not None and placement.slice_ids == ["pool-a"]
+    pods = cluster.client.direct().list_pods(namespace="default")
+    assert sorted(p.metadata.name for p in pods) == [f"j-{i}"
+                                                     for i in range(4)]
+    env = pods[0].spec.env
+    assert "MEGASCALE_NUM_SLICES" not in env
+    assert env["JAX_COORDINATOR_ADDRESS"] == "j-0:8476"
+
+
+def test_multislice_placement_rolls_back_on_failure(cluster):
+    """A mid-list pod-creation failure deletes the already-created pods
+    (otherwise they hold TPUs, block _slice_busy, and wedge retries)."""
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler
+
+    _add_slice(cluster, "pool-a")
+    _add_slice(cluster, "pool-b")
+    sched = SliceScheduler(cluster.client)
+    # occupy the name the 6th pod will want -> create() conflicts mid-list
+    cluster.add_pod("ms-1-1", "pool-b-h1")
+    wl = TPUWorkload(name="ms", accelerator="tpu-v5-lite-podslice",
+                     topology="4x4", num_slices=2)
+    assert sched.place(wl) is None
+    leftover = [p.metadata.name
+                for p in cluster.client.direct().list_pods(
+                    namespace="default")
+                if p.metadata.labels.get("tpu.dev/workload") == "ms"]
+    assert leftover == [], leftover
+
+
+def test_place_rejects_nonpositive_num_slices(cluster):
+    import pytest as _pytest
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler
+
+    with _pytest.raises(ValueError, match="num_slices"):
+        SliceScheduler(cluster.client).place(
+            TPUWorkload(name="z", accelerator="a", topology="4x4",
+                        num_slices=0))
