@@ -202,8 +202,9 @@ class _Handler(BaseHTTPRequestHandler):
         pod = serde.pod_from_json(body)
         pod.metadata.namespace = ns
         try:
-            created = self.cluster.create(pod)
-            self.cluster.flush_cache()
+            # route through the direct client (same create semantics as the
+            # in-process path — one definition of pod creation)
+            created = self.cluster.client.direct().create_pod(pod)
         except ConflictError as exc:
             return self._error(409, "AlreadyExists", str(exc))
         self._send(201, serde.pod_to_json(created))

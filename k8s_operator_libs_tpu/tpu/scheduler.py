@@ -24,7 +24,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional
 
-from ..core.client import Client
+from ..core.client import Client, ConflictError
 from ..core.objects import ObjectMeta, Pod
 from .device_plugin import TPU_RESOURCE, pod_requests_tpu
 from .topology import SliceInfo, chips_per_host, slice_info_for_node
@@ -167,6 +167,18 @@ class SliceScheduler:
         try:
             for p in pods:
                 created.append(self._create_pod(p))
+        except NotImplementedError:
+            raise  # misconfigured client — never a retryable condition
+        except ConflictError:
+            # a name is taken — usually OUR stale pods from a crashed prior
+            # attempt. Delete everything labeled with this workload (covers
+            # both `created` and leftovers) so the next requeue can place
+            # cleanly instead of conflicting forever
+            logger.warning("placement of %s hit a name conflict; cleaning "
+                           "up this workload's pods for a clean retry",
+                           workload.name)
+            self._cleanup_workload_pods(workload)
+            return None
         except Exception:
             logger.exception("placement of %s failed after %d/%d pods; "
                              "rolling back", workload.name, len(created),
@@ -183,6 +195,17 @@ class SliceScheduler:
                          node_names=all_nodes,
                          pods=[p.metadata.name for p in created],
                          slice_ids=[sid for sid, _ in chosen])
+
+    def _cleanup_workload_pods(self, workload: TPUWorkload) -> None:
+        for p in self._client.list_pods(
+                namespace=workload.namespace,
+                label_selector={WORKLOAD_LABEL: workload.name}):
+            try:
+                self._client.delete_pod(p.metadata.namespace,
+                                        p.metadata.name)
+            except Exception:
+                logger.warning("cleanup: could not delete %s/%s",
+                               p.metadata.namespace, p.metadata.name)
 
     def _create_pod(self, pod: Pod) -> Pod:
         # the abstract Client has no generic create; FakeCluster and real
