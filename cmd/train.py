@@ -69,9 +69,27 @@ def build_parallel(cfg, args, optimizer):
                                       dispatch=args.moe_dispatch)
             return (mesh, step,
                     lambda rng: init_ep_state(rng, cfg, mesh, optimizer))
-        if args.parallel not in ("none", "ep"):
-            raise SystemExit(f"--model moe_tiny supports --parallel none|ep, "
-                             f"not {args.parallel}")
+        if args.parallel == "3d" and n > 1:
+            from k8s_operator_libs_tpu.parallel.composed import (
+                init_moe_composed_state, make_moe_composed_train_step)
+            if n % 4:
+                raise SystemExit(f"--parallel 3d needs a multiple of 4 "
+                                 f"devices (stage=2 x tensor=2), have {n}")
+            if cfg.n_layers % 2 or cfg.n_experts % 2:
+                raise SystemExit("moe 3d needs even layers/experts")
+            dp = n // 4
+            micro = 2
+            if args.batch % (dp * micro):
+                raise SystemExit(f"--batch {args.batch} must be divisible "
+                                 f"by data({dp}) x microbatches({micro})")
+            mesh = make_mesh(stage=2, data=dp, fsdp=1, tensor=2)
+            return (mesh,
+                    make_moe_composed_train_step(cfg, mesh, micro, optimizer),
+                    lambda rng: init_moe_composed_state(rng, cfg, mesh,
+                                                        optimizer))
+        if args.parallel not in ("none", "ep", "3d"):
+            raise SystemExit(f"--model moe_tiny supports --parallel "
+                             f"none|ep|3d, not {args.parallel}")
         return (None,
                 make_train_step_from_loss(moe_reference_loss(cfg), optimizer),
                 init_fn)

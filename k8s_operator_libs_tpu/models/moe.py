@@ -203,6 +203,36 @@ def moe_ffn_a2a(h: jax.Array, layer: Params, cfg: MoEConfig,
     return y, aux
 
 
+def moe_block(x: jax.Array, layer: Params, cfg: MoEConfig,
+              positions: jax.Array,
+              experts_slice: Optional[Tuple[int, int]] = None,
+              ep_axis: Optional[str] = None,
+              ffn_fn: Optional[Any] = None) -> Tuple[jax.Array, jax.Array]:
+    """One MoE decoder block (pre-norm attention + routed expert FFN) —
+    shared by :func:`forward` and the composed pp × ep path
+    (parallel/composed.py:make_moe_composed_loss). Returns (x, aux)."""
+    B, T, _ = x.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"])
+    q = rope((h @ layer["wq"]).reshape(B, T, H, Dh), positions,
+             cfg.rope_theta)
+    k = rope((h @ layer["wk"]).reshape(B, T, KV, Dh), positions,
+             cfg.rope_theta)
+    v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = flash_attention(q, k, v, causal=True)
+    x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+    h2 = rms_norm(x, layer["mlp_norm"])
+    if ffn_fn is not None:
+        moe_out, aux = ffn_fn(h2, layer)
+    else:
+        moe_out, aux = moe_ffn(h2, layer, cfg, experts_slice, ep_axis)
+    return x + moe_out, aux
+
+
 def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
             positions: Optional[jax.Array] = None,
             experts_slice: Optional[Tuple[int, int]] = None,
@@ -218,30 +248,14 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
     aux)`` — used by the all-to-all dispatch path (:func:`moe_ffn_a2a`),
     where tokens are batch-sharded and out comes back complete (no psum)."""
     B, T = tokens.shape
-    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     x = params["embed"][tokens]
 
     def block(x, layer):
-        h = rms_norm(x, layer["attn_norm"])
-        q = (h @ layer["wq"]).reshape(B, T, H, Dh)
-        k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
-        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        if KV != H:
-            rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = flash_attention(q, k, v, causal=True)
-        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
-        h2 = rms_norm(x, layer["mlp_norm"])
-        if ffn_fn is not None:
-            moe_out, aux = ffn_fn(h2, layer)
-        else:
-            moe_out, aux = moe_ffn(h2, layer, cfg, experts_slice, ep_axis)
-        return x + moe_out, aux
+        return moe_block(x, layer, cfg, positions,
+                         experts_slice=experts_slice, ep_axis=ep_axis,
+                         ffn_fn=ffn_fn)
 
     block_fn = jax.checkpoint(block) if cfg.remat else block
 

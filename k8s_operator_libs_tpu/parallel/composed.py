@@ -202,6 +202,137 @@ def make_composed_train_step(cfg: LlamaConfig, mesh: Mesh,
         make_composed_loss(cfg, mesh, num_microbatches), optimizer)
 
 
+def moe_composed_param_specs() -> Dict:
+    """Storage specs for pp × ep: layer stacks over "stage", EXPERT stacks
+    additionally sharded on their expert axis over "tensor" (EP, not
+    Megatron — attention and the router stay replicated per device, exactly
+    like :mod:`.expert`'s dense dispatch)."""
+    blocks = {
+        "attn_norm": P("stage", None),
+        "wq": P("stage", None, None), "wk": P("stage", None, None),
+        "wv": P("stage", None, None), "wo": P("stage", None, None),
+        "mlp_norm": P("stage", None),
+        "router": P("stage", None, None),
+        "w_gate": P("stage", "tensor", None, None),
+        "w_up": P("stage", "tensor", None, None),
+        "w_down": P("stage", "tensor", None, None),
+    }
+    return {"embed": P(None, None), "blocks": blocks,
+            "final_norm": P(None), "lm_head": P(None, None)}
+
+
+def make_moe_composed_loss(cfg, mesh: Mesh, num_microbatches: int
+                           ) -> Callable:
+    """Composed MoE: pipeline (stage) × expert parallelism (tensor) × data
+    parallelism in ONE shard_map — ``loss(params, tokens)``, tokens
+    [B, T+1], B divisible by data · num_microbatches.
+
+    Each stage runs its local layers with dense-dispatch local experts and
+    a per-layer psum over "tensor" (models/moe.py:moe_ffn); the Switch aux
+    is accumulated through the GPipe schedule over exactly the real
+    microbatch ticks, psummed over stage (layers) and tensor (experts),
+    and averaged over microbatches. Requires mesh fsdp == seq == 1."""
+    from ..models.moe import moe_block
+    from .pipeline import gpipe_schedule
+
+    S = mesh.shape["stage"]
+    tp = mesh.shape["tensor"]
+    dp = mesh.shape["data"]
+    M = num_microbatches
+    if mesh.shape["fsdp"] != 1 or mesh.shape["seq"] != 1:
+        raise ValueError("moe composed path supports stage x data x tensor "
+                         "meshes (fsdp=seq=1)")
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                         f"{S} stages")
+    if cfg.n_experts % tp:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
+                         f"{tp}-way expert parallelism")
+    local_e = cfg.n_experts // tp
+
+    def block(x, layer, positions):
+        start = jax.lax.axis_index("tensor") * local_e
+        return moe_block(x, layer, cfg, positions,
+                         experts_slice=(start, local_e), ep_axis="tensor")
+
+    def shard_loss(params, inputs, targets):
+        s = jax.lax.axis_index("stage")
+        Bd, T = inputs.shape
+        Bm = Bd // M
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
+        block_fn = jax.checkpoint(block) if cfg.remat else block
+
+        def stage_apply(x):
+            def body(carry, layer):
+                x, aux_tot = carry
+                x, aux = block_fn(x, layer, positions)
+                return (x, aux_tot + aux), None
+            (x, aux), _ = jax.lax.scan(
+                body,
+                (x, jax.lax.pcast(jnp.zeros((), jnp.float32),
+                                  ("stage", "data", "tensor"),
+                                  to="varying")),
+                params["blocks"])
+            return x, aux
+
+        def project_nll(y, mb_t):
+            h = rms_norm(y, params["final_norm"])
+            logits = (h @ params["lm_head"]).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, mb_t[..., None],
+                                        axis=-1)[..., 0]
+
+        total, count, aux_tot = gpipe_schedule(
+            S, M, s, inputs, targets,
+            embed_mb=lambda mb: params["embed"][mb],
+            stage_apply=stage_apply,
+            project_nll=project_nll,
+            init_x=jnp.zeros((Bm, T, cfg.d_model), params["embed"].dtype),
+            varying_axes=("stage", "data"),
+            stage_aux=True,
+            aux_varying_axes=("stage", "data", "tensor"))
+        ce = total / count
+        # aux: sum over stages (layers) and tensor (experts), averaged over
+        # the M microbatches, then pmean over data replicas with the CE
+        aux = jax.lax.psum(aux_tot, ("stage", "tensor")) / M
+        return jax.lax.pmean(ce + cfg.router_aux_coef * aux, "data")
+
+    sharded = jax.shard_map(
+        shard_loss, mesh=mesh,
+        in_specs=(moe_composed_param_specs(), P("data", None),
+                  P("data", None)),
+        out_specs=P())
+
+    def loss(params, tokens):
+        if tokens.shape[0] % (dp * M):
+            raise ValueError(f"batch {tokens.shape[0]} not divisible by "
+                             f"data({dp}) x microbatches({M})")
+        return sharded(params, tokens[:, :-1], tokens[:, 1:])
+
+    return loss
+
+
+def make_moe_composed_train_step(cfg, mesh: Mesh, num_microbatches: int = 4,
+                                 optimizer: Optional[
+                                     optax.GradientTransformation] = None
+                                 ) -> Callable:
+    """Jitted pp × ep (+ dp) MoE ``train_step(state, tokens)``."""
+    return make_train_step_from_loss(
+        make_moe_composed_loss(cfg, mesh, num_microbatches), optimizer)
+
+
+def init_moe_composed_state(rng: jax.Array, cfg, mesh: Mesh,
+                            optimizer: Optional[
+                                optax.GradientTransformation] = None
+                            ) -> TrainState:
+    """TrainState laid out per :func:`moe_composed_param_specs`, committed
+    to the mesh (checkpoint restore re-shards onto the pp × ep layout)."""
+    from ..models.moe import init_params as moe_init
+    return init_train_state(rng, cfg, optimizer, mesh,
+                            pspecs=moe_composed_param_specs(),
+                            params_init=moe_init)
+
+
 def init_composed_state(rng: jax.Array, cfg: LlamaConfig, mesh: Mesh,
                         optimizer: Optional[
                             optax.GradientTransformation] = None

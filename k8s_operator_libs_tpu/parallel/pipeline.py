@@ -41,7 +41,8 @@ AXIS = "stage"
 def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
                    embed_mb: Callable, stage_apply: Callable,
                    project_nll: Callable, init_x,
-                   varying_axes=(AXIS,)) -> Tuple[jax.Array, jax.Array]:
+                   varying_axes=(AXIS,), stage_aux: bool = False,
+                   aux_varying_axes=None):
     """The GPipe tick loop, shared by :func:`make_pp_loss` and the composed
     3-D step (:mod:`.composed`). Runs inside shard_map over the "stage"
     axis. At tick t, stage s holds microbatch (t - s); stage 0 ingests via
@@ -63,33 +64,56 @@ def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
     ``varying_axes`` types the scan carries for shard_map's vma check: the
     axes the activations are device-varying over ("stage" always; callers
     with batch-sharded inputs or fsdp-gathered weights add those axes).
-    Returns (total_nll, token_count), both psummed over "stage"."""
+
+    ``stage_aux=True`` changes the stage_apply contract to
+    ``x -> (y, aux_scalar)`` and accumulates aux over exactly the ticks at
+    which this stage holds a REAL microbatch (t in [s, s+M)) — the MoE
+    load-balance term under pipeline parallelism. ``aux_varying_axes``
+    types the aux carry (it may vary over more axes than the activations,
+    e.g. "tensor" when experts are sharded and aux is still local).
+
+    Returns (total_nll, token_count) psummed over "stage", plus — with
+    stage_aux — the raw accumulated aux (caller psums/normalizes)."""
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     Bm = inputs.shape[0] // M
     s = stage_index
 
+    def run_stage(x):
+        if stage_aux:
+            return stage_apply(x)
+        return stage_apply(x), jnp.zeros((), jnp.float32)
+
     def tick(carry, t):
-        x_cur = carry
+        x_cur, aux_tot = carry
         m_in = jnp.clip(t, 0, M - 1)
         mb = jax.lax.dynamic_slice_in_dim(inputs, m_in * Bm, Bm, axis=0)
         x_cur = jnp.where(s == 0, embed_mb(mb), x_cur)
-        y = stage_apply(x_cur)
+        y, aux = run_stage(x_cur)
+        real = jnp.logical_and(t >= s, t < s + M)
+        aux_tot = aux_tot + jnp.where(real, aux, 0.0)
         x_nxt = jax.lax.ppermute(y, AXIS, fwd_perm)
-        return x_nxt, y
+        return (x_nxt, aux_tot), y
 
     x = jax.lax.pcast(init_x, varying_axes, to="varying")
+    aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                         aux_varying_axes or varying_axes, to="varying")
+    carry = (x, aux0)
     if S > 1:  # warm-up: outputs not yet at the last stage, don't stack
-        x, _ = jax.lax.scan(lambda c, t: (tick(c, t)[0], None), x,
-                            jnp.arange(S - 1))
+        carry, _ = jax.lax.scan(lambda c, t: (tick(c, t)[0], None), carry,
+                                jnp.arange(S - 1))
     # microbatch m leaves the last stage at tick S-1+m; stacked rows are
     # m-major so the window lines up with targets' [M*Bm, T] row order
-    _, ys = jax.lax.scan(tick, x, jnp.arange(S - 1, S + M - 1))
+    (_, aux_tot), ys = jax.lax.scan(tick, carry,
+                                    jnp.arange(S - 1, S + M - 1))
     win = ys.reshape((M * Bm,) + ys.shape[2:])
     nll = project_nll(win, targets[:M * Bm])
     is_last = s == S - 1
     total = jnp.where(is_last, jnp.sum(nll), 0.0)
     count = jnp.where(is_last, nll.size, 0)
-    return jax.lax.psum(total, AXIS), jax.lax.psum(count, AXIS)
+    total, count = jax.lax.psum(total, AXIS), jax.lax.psum(count, AXIS)
+    if stage_aux:
+        return total, count, aux_tot
+    return total, count
 
 
 def pp_param_specs(params) -> Dict:

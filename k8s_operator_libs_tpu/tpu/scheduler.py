@@ -67,8 +67,10 @@ class SliceScheduler:
     def eligible_slices(self, accelerator: str, topology: str
                         ) -> Dict[str, List]:
         """All fully-Ready, schedulable slices matching (accelerator,
-        topology), as {slice_id: [nodes]}."""
-        nodes = self._client.list_nodes()
+        topology), as {slice_id: [nodes]}. Reads are DIRECT (uncached):
+        admission decisions on a stale informer view double-allocate TPUs
+        (a cached list can miss a just-placed workload's pods)."""
+        nodes = self._client.direct().list_nodes()
         by_slice: Dict[str, List] = {}
         info_by_slice: Dict[str, SliceInfo] = {}
         for node in nodes:
@@ -91,8 +93,9 @@ class SliceScheduler:
         return out
 
     def _slice_busy(self, members) -> bool:
+        direct = self._client.direct()
         for node in members:
-            pods = self._client.list_pods(field_node_name=node.metadata.name)
+            pods = direct.list_pods(field_node_name=node.metadata.name)
             if any(pod_requests_tpu(p) and p.status.phase in ("Running", "Pending")
                    for p in pods):
                 return True
@@ -112,6 +115,30 @@ class SliceScheduler:
         if workload.num_slices < 1:
             raise ValueError(f"workload {workload.name}: num_slices must be "
                              f">= 1, got {workload.num_slices}")
+        # idempotence + crash recovery: pods carrying this workload's label
+        # mean either a live placement (full set — leave it alone) or the
+        # debris of a crashed prior attempt (partial set — clean up so the
+        # next tick can place cleanly). NEVER proceed to create over them.
+        from .topology import TPUTopology
+        hosts = max(1, TPUTopology.parse(workload.topology).num_chips
+                    // chips_per_host(workload.accelerator))
+        expected = workload.num_slices * hosts
+        # direct (uncached) read: admission safety must not act on a
+        # stale informer view of this workload's pods
+        existing = self._client.direct().list_pods(
+            namespace=workload.namespace,
+            label_selector={WORKLOAD_LABEL: workload.name})
+        if len(existing) >= expected:
+            logger.info("workload %s already has %d/%d pods; not re-placing",
+                        workload.name, len(existing), expected)
+            return None
+        if existing:
+            logger.warning("workload %s has a partial pod set (%d/%d — "
+                           "crashed prior attempt?); cleaning up for a "
+                           "fresh placement next tick",
+                           workload.name, len(existing), expected)
+            self._cleanup_workload_pods(workload)
+            return None
         slices = self.eligible_slices(workload.accelerator, workload.topology)
         if len(slices) < workload.num_slices:
             logger.info("need %d eligible %s/%s slices for workload %s, "
@@ -170,14 +197,19 @@ class SliceScheduler:
         except NotImplementedError:
             raise  # misconfigured client — never a retryable condition
         except ConflictError:
-            # a name is taken — usually OUR stale pods from a crashed prior
-            # attempt. Delete everything labeled with this workload (covers
-            # both `created` and leftovers) so the next requeue can place
-            # cleanly instead of conflicting forever
-            logger.warning("placement of %s hit a name conflict; cleaning "
-                           "up this workload's pods for a clean retry",
-                           workload.name)
-            self._cleanup_workload_pods(workload)
+            # the entry check saw no labeled pods, so a conflict here is a
+            # race (concurrent placer / foreign pod squatting a name). Roll
+            # back only THIS attempt's intended names — never a blanket
+            # label sweep, which could hit a healthy concurrent placement
+            logger.warning("placement of %s hit a name conflict (race?); "
+                           "rolling back this attempt", workload.name)
+            for p in created:
+                try:
+                    self._client.delete_pod(p.metadata.namespace,
+                                            p.metadata.name)
+                except Exception:
+                    logger.warning("rollback: could not delete %s/%s",
+                                   p.metadata.namespace, p.metadata.name)
             return None
         except Exception:
             logger.exception("placement of %s failed after %d/%d pods; "
@@ -197,7 +229,7 @@ class SliceScheduler:
                          slice_ids=[sid for sid, _ in chosen])
 
     def _cleanup_workload_pods(self, workload: TPUWorkload) -> None:
-        for p in self._client.list_pods(
+        for p in self._client.direct().list_pods(
                 namespace=workload.namespace,
                 label_selector={WORKLOAD_LABEL: workload.name}):
             try:

@@ -197,3 +197,32 @@ def test_place_rejects_nonpositive_num_slices(cluster):
         SliceScheduler(cluster.client).place(
             TPUWorkload(name="z", accelerator="a", topology="4x4",
                         num_slices=0))
+
+
+def test_placement_idempotent_and_partial_cleanup(cluster):
+    """A fully-placed workload is never re-placed (pods untouched); a
+    partial pod set (crashed prior attempt) is cleaned up, then the next
+    tick places cleanly."""
+    from k8s_operator_libs_tpu.tpu.scheduler import (SliceScheduler,
+                                                     WORKLOAD_LABEL)
+
+    _add_slice(cluster, "pool-a")
+    sched = SliceScheduler(cluster.client)
+    wl = TPUWorkload(name="j", accelerator="tpu-v5-lite-podslice",
+                     topology="4x4")
+    placement = sched.place(wl)
+    assert placement is not None
+    before = {p.metadata.uid for p in cluster.client.direct().list_pods(
+        namespace="default")}
+    # full set exists -> place() is a no-op, pods untouched
+    assert sched.place(wl) is None
+    after = {p.metadata.uid for p in cluster.client.direct().list_pods(
+        namespace="default")}
+    assert after == before
+    # partial set (simulate crash: delete 2 of 4) -> cleanup, then re-place
+    for name in (placement.pods[0], placement.pods[1]):
+        cluster.delete("Pod", "default", name)
+    assert sched.place(wl) is None  # cleanup tick
+    assert [p for p in cluster.client.direct().list_pods(namespace="default")
+            if p.metadata.labels.get(WORKLOAD_LABEL) == "j"] == []
+    assert sched.place(wl) is not None  # clean placement
