@@ -458,3 +458,70 @@ def test_slice_scheduler_places_over_live_http(live):
     assert env["live-job-0"]["JAX_COORDINATOR_ADDRESS"] == "live-job-0:8476"
     assert all(p.spec.resource_requests.get("google.com/tpu") == 4
                for p in pods)
+
+
+def test_serde_roundtrips_preserve_fields():
+    """objects -> k8s JSON -> objects is lossless for every mapped field
+    (the wire contract both the live client and the facade depend on)."""
+    from k8s_operator_libs_tpu.core import serde
+    from k8s_operator_libs_tpu.core.objects import (
+        ContainerStatus, DaemonSet, DaemonSetStatus, Job, JobStatus, Node,
+        ObjectMeta, OwnerReference, Pod, PodCondition, Volume)
+
+    node = Node(metadata=ObjectMeta(name="n", labels={"a": "b"},
+                                    annotations={"x": "y"}))
+    node.spec.unschedulable = True
+    n2 = serde.node_from_json(serde.node_to_json(node))
+    assert n2.metadata.labels == {"a": "b"}
+    assert n2.metadata.annotations == {"x": "y"}
+    assert n2.spec.unschedulable and n2.is_ready()
+
+    pod = Pod(metadata=ObjectMeta(
+        name="p", namespace="ns",
+        owner_references=[OwnerReference(kind="DaemonSet", name="d",
+                                         uid="u1")]))
+    pod.spec.node_name = "n"
+    pod.spec.termination_grace_period_seconds = 7
+    pod.spec.volumes = [Volume(name="v", empty_dir=True),
+                        Volume(name="w", empty_dir=False)]
+    pod.spec.resource_requests = {"google.com/tpu": 4}
+    pod.spec.env = {"A": "1"}
+    pod.status.phase = "Succeeded"  # non-default: proves phase round-trips
+    pod.status.container_statuses = [ContainerStatus(name="c", ready=True,
+                                                     restart_count=3)]
+    pod.status.init_container_statuses = [ContainerStatus(name="init",
+                                                          ready=False)]
+    pod.status.conditions = [PodCondition(type="Ready", status="True")]
+    p2 = serde.pod_from_json(serde.pod_to_json(pod))
+    assert p2.metadata.namespace == "ns"
+    assert p2.status.phase == "Succeeded"
+    assert p2.status.init_container_statuses[0].name == "init"
+    assert p2.spec.node_name == "n"
+    assert p2.spec.termination_grace_period_seconds == 7
+    assert [(v.name, v.empty_dir) for v in p2.spec.volumes] == [
+        ("v", True), ("w", False)]
+    assert p2.spec.resource_requests == {"google.com/tpu": 4}
+    assert p2.spec.env == {"A": "1"}
+    assert p2.status.container_statuses[0].restart_count == 3
+    assert p2.is_ready()
+    assert p2.controller_owner().uid == "u1"
+
+    ds = DaemonSet(metadata=ObjectMeta(name="d"), selector={"k": "v"},
+                   status=DaemonSetStatus(desired_number_scheduled=5))
+    d2 = serde.daemonset_from_json(serde.daemonset_to_json(ds))
+    assert d2.selector == {"k": "v"}
+    assert d2.status.desired_number_scheduled == 5
+
+    job = Job(metadata=ObjectMeta(name="j"),
+              status=JobStatus(active=1, succeeded=2, failed=3))
+    j2 = serde.job_from_json(serde.job_to_json(job))
+    assert (j2.status.active, j2.status.succeeded, j2.status.failed) == (1, 2, 3)
+
+    from k8s_operator_libs_tpu.core.objects import ControllerRevision
+    cr = ControllerRevision(
+        metadata=ObjectMeta(name="r", labels={"controller-revision-hash": "v9"}),
+        revision=7)
+    c2 = serde.controller_revision_from_json(
+        serde.controller_revision_to_json(cr))
+    assert c2.revision == 7
+    assert c2.metadata.labels["controller-revision-hash"] == "v9"
