@@ -23,9 +23,12 @@ mesh, one jitted train step, no nesting:
 Axis order matches :mod:`.mesh`: "tensor" innermost (per-block psums ride
 nearest-neighbor ICI), "stage" outermost (boundary activations only).
 
-r1 simplification shared with :mod:`.pipeline`: embed/lm_head are gathered
-in full on every device (storage stays fsdp-sharded); fine at Llama-3-8B
-scale on v5p (≈1 GB bf16), revisit for larger vocab or >8B.
+Embed/lm_head are VOCAB-SHARDED over "tensor" (only their D axis is
+fsdp-gathered): token lookup is a distributed one-hot (owned-rows + psum)
+and the loss is a distributed cross-entropy (pmax/psum logsumexp + psum'd
+target logit), so the full embedding table and the [*, V] logits tensor —
+the largest activation at Llama-3 vocab scale — never materialize on one
+device. (The standalone :mod:`.pipeline` path still replicates them.)
 """
 
 from __future__ import annotations
@@ -48,7 +51,7 @@ def composed_param_specs() -> Dict:
     "fsdp", the Megatron-legal dim over "tensor". These are both the
     shard_map in_specs and (as NamedShardings) the at-rest layout."""
     return {
-        "embed": P(None, "fsdp"),
+        "embed": P("tensor", "fsdp"),     # vocab rows over tp, D over fsdp
         "blocks": {
             "attn_norm": P("stage", None),
             "wq": P("stage", "fsdp", "tensor"),
@@ -61,7 +64,7 @@ def composed_param_specs() -> Dict:
             "w_down": P("stage", "tensor", "fsdp"),
         },
         "final_norm": P(None),
-        "lm_head": P("fsdp", None),
+        "lm_head": P("fsdp", "tensor"),   # vocab cols over tp, D over fsdp
     }
 
 
@@ -79,6 +82,10 @@ def _check_divisibility(cfg: LlamaConfig, mesh: Mesh) -> None:
     if cfg.d_model % fs or cfg.d_ff % fs:
         raise ValueError(f"d_model {cfg.d_model}/d_ff {cfg.d_ff} not "
                          f"divisible by {fs}-way fsdp")
+    if cfg.vocab_size % tp:
+        raise ValueError(f"vocab_size {cfg.vocab_size} not divisible by "
+                         f"{tp}-way tensor parallelism (vocab-sharded "
+                         f"embed/lm_head)")
 
 
 def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
@@ -142,9 +149,28 @@ def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
             "w_up": gather(params["blocks"]["w_up"], 1),
             "w_down": gather(params["blocks"]["w_down"], 2),
         }
-        embed = gather(params["embed"], 1)            # [V, D]
-        lm_head = gather(params["lm_head"], 0)        # [D, V]
+        # embed/lm_head stay VOCAB-SHARDED over "tensor" (only their D axis
+        # is fsdp-gathered): the lookup and the loss are computed
+        # distributed, so the [*, V] logits tensor — the largest activation
+        # at Llama-3 vocab scale — never materializes on one device
+        embed = gather(params["embed"], 1)            # [V/tp, D]
+        lm_head = gather(params["lm_head"], 0)        # [D, V/tp]
         dtype = embed.dtype
+        v_local = embed.shape[0]
+        v_start = jax.lax.axis_index("tensor") * v_local
+
+        def local_idx_and_owned(tok):
+            # partition-boundary arithmetic shared by the embedding lookup
+            # and the loss's target-logit selection
+            idx = tok - v_start
+            owned = jnp.logical_and(idx >= 0, idx < v_local)
+            return jnp.clip(idx, 0, v_local - 1), owned
+
+        def embed_tokens(mb):
+            # one-hot over the LOCAL vocab shard; psum assembles full rows
+            idx, owned = local_idx_and_owned(mb)
+            rows = jnp.where(owned[..., None], embed[idx], 0)
+            return jax.lax.psum(rows, "tensor")
 
         block_fn = jax.checkpoint(tp_block) if cfg.remat else tp_block
 
@@ -155,17 +181,32 @@ def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
             return x
 
         def project_nll(y, mb_t):
+            """Distributed cross-entropy over the vocab-sharded lm_head:
+            nll = logsumexp(full logits) - target logit, assembled from
+            per-shard partials with one pmax and two psums — no full-vocab
+            logits array ever exists."""
             h = rms_norm(y, params["final_norm"])
-            logits = (h @ lm_head).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            return -jnp.take_along_axis(logp, mb_t[..., None],
-                                        axis=-1)[..., 0]
+            logits_l = (h @ lm_head).astype(jnp.float32)   # [B', T, V/tp]
+            # the max is a numerical stabilizer only (cancels in lse - it
+            # re-enters via m + log(se)); stop_gradient both keeps the math
+            # exact and sidesteps pmax's missing differentiation rule
+            m = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits_l, axis=-1)), "tensor")
+            se = jax.lax.psum(
+                jnp.sum(jnp.exp(logits_l - m[..., None]), axis=-1),
+                "tensor")
+            lse = m + jnp.log(se)
+            idx, owned = local_idx_and_owned(mb_t)
+            tl = jnp.take_along_axis(logits_l, idx[..., None],
+                                     axis=-1)[..., 0]
+            target_logit = jax.lax.psum(jnp.where(owned, tl, 0.0), "tensor")
+            return lse - target_logit
 
         # carries are varying over stage (ppermute/axis_index), data (the
         # batch shard), and fsdp (gathered weights keep fsdp vma-typing)
         total, count = gpipe_schedule(
             S, M, s, inputs, targets,
-            embed_mb=lambda mb: embed[mb],
+            embed_mb=embed_tokens,
             stage_apply=stage_apply,
             project_nll=project_nll,
             init_x=jnp.zeros((Bm, T, D), dtype),
