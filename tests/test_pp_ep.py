@@ -196,3 +196,15 @@ def test_ep_a2a_rejects_indivisible_batch(ep_mesh):
                                 cfg.vocab_size)
     with pytest.raises(ValueError, match="not divisible"):
         make_ep_a2a_loss(cfg, ep_mesh)(params, tokens)
+
+
+def test_pp_single_stage_matches_reference():
+    """S=1 degenerate pipeline (warm-up scan skipped, window = all M
+    ticks) must equal the plain loss — pins the gpipe_schedule edge."""
+    mesh1 = make_mesh(stage=1, fsdp=1, devices=jax.devices()[:1])
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                CFG.vocab_size)
+    l_pp = float(jax.jit(make_pp_loss(CFG, mesh1, 4))(params, tokens))
+    l_ref = float(causal_lm_loss(params, tokens, CFG))
+    assert abs(l_pp - l_ref) < 1e-3
