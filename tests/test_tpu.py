@@ -264,3 +264,58 @@ def test_scheduler_skips_partial_slice(cluster):
     assert sched.place(TPUWorkload(name="train",
                                    accelerator="tpu-v5-lite-podslice",
                                    topology="4x4")) is None
+
+
+def test_partial_slice_failure_recovers_as_a_unit(cluster, keys, clock):
+    """SURVEY §7.4 hard part: one host's driver crash-loops after restart →
+    that node goes upgrade-failed, and the HEALTHY hosts hold at the
+    uncordon barrier (no partial slice returns to service). Once the driver
+    heals, the failed node auto-recovers and the slice uncordons together."""
+    ds = cluster.add_daemonset("tpu-device-plugin", namespace=NS,
+                               labels=DRIVER_LABELS, revision_hash="v1")
+    hosts = setup_slice(cluster, "pool-a", 4, ds)
+    cluster.bump_daemonset_revision("tpu-device-plugin", NS, "v2")
+    mgr = ClusterUpgradeStateManager(
+        cluster.client, keys, cluster.recorder, clock,
+        grouper=TPUSliceGrouper(), synchronous=True)
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    sick = hosts[1]
+
+    def states():
+        return {h: cluster.client.direct().get_node(h).metadata.labels.get(
+            keys.state_label, "") for h in hosts}
+
+    # drive until the slice reaches pod-restart; make the sick host's new
+    # driver pod crash-loop when the DaemonSet recreates it
+    for _ in range(40):
+        mgr.apply_state(mgr.build_state(NS, DRIVER_LABELS), policy)
+        for pod in cluster.reconcile_daemonsets():
+            if pod.spec.node_name == sick:
+                cluster.set_pod_status(NS, pod.metadata.name,
+                                       ready=False, restart_count=11)
+        snap = states()
+        if snap[sick] == UpgradeState.FAILED:
+            break
+    assert states()[sick] == UpgradeState.FAILED, states()
+    # healthy hosts must HOLD cordoned at the barrier — never back in
+    # service while a slice member is failed (ICI failure domain)
+    for _ in range(5):
+        mgr.apply_state(mgr.build_state(NS, DRIVER_LABELS), policy)
+        for h in hosts:
+            assert cluster.client.direct().get_node(h).spec.unschedulable, \
+                (h, states())
+        assert UpgradeState.DONE not in states().values()
+    # heal the driver: pod becomes Ready at the new revision
+    for p in cluster.client.direct().list_pods(namespace=NS):
+        if p.spec.node_name == sick:
+            cluster.set_pod_status(NS, p.metadata.name, ready=True,
+                                   restart_count=0)
+    for _ in range(20):
+        mgr.apply_state(mgr.build_state(NS, DRIVER_LABELS), policy)
+        if all(s == UpgradeState.DONE for s in states().values()):
+            break
+    assert all(s == UpgradeState.DONE for s in states().values()), states()
+    assert all(not cluster.client.direct().get_node(h).spec.unschedulable
+               for h in hosts)
