@@ -70,6 +70,21 @@ MAGIC = b"TOKS"
 HEADER_BYTES = 8
 
 
+class _ProducerDied:
+    """Queue sentinel carrying a prefetch-producer exception to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _PrefetchStream:
+    """Handle for one live prefetch thread, so close() can stop it first."""
+
+    def __init__(self, stop: threading.Event, thread: threading.Thread):
+        self.stop = stop
+        self.thread = thread
+
+
 def write_token_file(path: str, tokens: np.ndarray) -> None:
     """Write the loader's format: 'TOKS' + uint32 elem_size header, then raw
     tokens (uint16 when the vocab fits, else int32)."""
@@ -107,6 +122,9 @@ class TokenDataset:
             raise RuntimeError("native loader requested but unavailable")
         self._lib = lib
         self._handle = None
+        self._closed = False
+        self._streams: list = []  # live prefetch streams, for close()
+        self._streams_lock = threading.Lock()
         header_elem = _read_header(path)
         # headered files carry their element size; raw files default to int32
         self._open(elem_size=header_elem or 4,
@@ -128,6 +146,28 @@ class TokenDataset:
             self.num_tokens = int(self._mm.shape[0])
 
     def close(self) -> None:
+        """Stop all prefetch producers FIRST, then free the native handle —
+        a producer mid-``gather`` must never see a freed mmap. Live
+        consumers wake via their timed get and raise instead of hanging.
+        If a producer refuses to stop within the grace period the handle is
+        deliberately LEAKED (never freed under a running gather)."""
+        self._closed = True
+        with self._streams_lock:
+            streams = list(self._streams)
+            self._streams.clear()
+        for stream in streams:
+            stream.stop.set()
+        stuck = []
+        for stream in streams:
+            stream.thread.join(timeout=5.0)
+            if stream.thread.is_alive():
+                stuck.append(stream.thread.name)
+        if stuck:
+            logger.error(
+                "prefetch producers %s still running after close() grace "
+                "period; leaking the mmap handle rather than freeing it "
+                "under them", stuck)
+            return
         if self._lib is not None and self._handle:
             self._lib.tl_close(self._handle)
             self._handle = None
@@ -139,6 +179,8 @@ class TokenDataset:
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         batch = offsets.shape[0]
         out = np.empty((batch, seqlen), dtype=np.int32)
+        if self._closed or (self._lib is not None and self._handle is None):
+            raise ValueError(f"TokenDataset({self.path}) is closed")
         if self._lib is not None:
             rc = self._lib.tl_fill_batch(
                 self._handle,
@@ -171,22 +213,71 @@ class TokenDataset:
     def batches(self, batch: int, seqlen: int, seed: int = 0,
                 prefetch: int = 2,
                 shard: Optional[tuple] = None) -> Iterator[np.ndarray]:
-        """Infinite prefetched batch stream (background thread)."""
+        """Infinite prefetched batch stream (background thread).
+
+        Producer failures propagate: if the producer thread raises (bad
+        offsets, dataset closed under it, ...) the consumer's next
+        ``next()`` raises RuntimeError instead of blocking forever on an
+        empty queue.
+        """
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         stop = threading.Event()
+
+        def _put(item) -> bool:
+            """put() that stays interruptible by stop; True if delivered."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             rng = np.random.default_rng(seed)
             while not stop.is_set():
                 try:
-                    q.put(self.sample(batch, seqlen, rng, shard), timeout=0.5)
-                except queue.Full:
-                    continue
+                    item = self.sample(batch, seqlen, rng, shard)
+                except BaseException as exc:  # surface, don't die silently
+                    _put(_ProducerDied(exc))
+                    return
+                _put(item)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name=f"tokenloader-prefetch-{id(q):x}")
+        stream = _PrefetchStream(stop=stop, thread=t)
+        with self._streams_lock:
+            self._streams.append(stream)
         t.start()
         try:
             while True:
-                yield q.get()
+                try:
+                    item = q.get(timeout=0.5)
+                except queue.Empty:
+                    # never block forever: a stopped stream (close()) or a
+                    # dead producer must surface as an error, not a hang
+                    if stop.is_set():
+                        raise RuntimeError(
+                            "tokenloader stream stopped "
+                            "(TokenDataset.close() during iteration)")
+                    if not t.is_alive():
+                        raise RuntimeError(
+                            "tokenloader prefetch producer exited "
+                            "without a result")
+                    continue
+                if isinstance(item, _ProducerDied):
+                    raise RuntimeError(
+                        "tokenloader prefetch producer died"
+                    ) from item.exc
+                yield item
         finally:
             stop.set()
+            try:  # drain so a producer blocked in put() wakes promptly
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+            with self._streams_lock:
+                if stream in self._streams:
+                    self._streams.remove(stream)

