@@ -67,14 +67,28 @@ def load_components(path: str):
     return comps
 
 
-def build_client(args):
+def build_client(args, components):
+    """The reference's two-client split (upgrade_state.go:127-135): a
+    long-running operator reads through an informer cache (CachedClient)
+    whose ``direct()`` is the raw LiveClient; ``--once`` ticks (Helm hooks,
+    smoke tests) skip the informers — one tick can't amortize them. The
+    Pod/DaemonSet informers are scoped to the component namespaces, never
+    cluster-wide."""
+    from k8s_operator_libs_tpu.core.cachedclient import CachedClient
     from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
                                                        LiveClient,
                                                        LiveEventRecorder)
     kc = (KubeConfig.in_cluster() if args.in_cluster else
           KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
     http = KubeHTTP(kc)
-    return LiveClient(http), LiveEventRecorder(http)
+    client = LiveClient(http)
+    if not args.once and not args.uncached:
+        client = CachedClient(
+            client,
+            namespaces=[c.namespace for c in components],
+            watch_window_seconds=max(args.interval, 5.0))
+        client.start()
+    return client, LiveEventRecorder(http)
 
 
 class MetricsServer:
@@ -150,6 +164,10 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                         "resync fallback)")
     p.add_argument("--once", action="store_true",
                    help="run a single reconcile tick and exit")
+    p.add_argument("--uncached", action="store_true",
+                   help="read straight from the apiserver instead of the "
+                        "informer cache (the cache is on by default for "
+                        "long-running mode; --once is always uncached)")
     p.add_argument("--metrics-port", type=int, default=8080,
                    help="/metrics + /healthz port (0 = ephemeral, "
                         "-1 = disabled)")
@@ -162,7 +180,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
 
     try:
         components = load_components(args.config)
-        client, recorder = build_client(args)
+        client, recorder = build_client(args, components)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -170,7 +188,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     if args.ensure_crds:
         from k8s_operator_libs_tpu.core.liveclient import LiveCRDClient
         from k8s_operator_libs_tpu.crdutil import crdutil
-        n = crdutil.ensure_crds(LiveCRDClient(client.http),
+        n = crdutil.ensure_crds(LiveCRDClient(client.direct().http),
                                 [args.ensure_crds])
         logger.info("bootstrapped %d CRDs", n)
 
@@ -188,34 +206,53 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     if on_ready is not None:
         on_ready(server)
     dirty = threading.Event()  # watch events request an early tick
+
+    def _is_driver_pod(obj) -> bool:
+        labels = obj.metadata.labels or {}
+        return any(all(labels.get(k) == v
+                       for k, v in comp.driver_labels.items())
+                   for comp in components)
+
     if args.watch and not args.once:
-        def watch_loop(source_name, watch_fn):
-            while not stop.is_set():
-                try:
-                    for _etype, _obj in watch_fn(
-                            timeout_seconds=args.interval):
-                        dirty.set()
-                        if stop.is_set():
-                            return
-                except Exception as exc:
-                    logger.warning("%s watch dropped (%s); retrying",
-                                   source_name, exc)
-                    stop.wait(1.0)
-        # nodes drive admission/cordon/uncordon; each component's DRIVER
-        # pods (scoped by namespace + selector — never a cluster-wide pod
-        # watch, which would tick on unrelated workload churn) drive the
-        # driver-restart transitions
-        import functools
-        sources = [("node", client.watch_nodes)]
-        for comp in components:
-            sources.append((
-                f"pod:{comp.name}",
-                functools.partial(client.watch_pods,
-                                  namespace=comp.namespace,
-                                  label_selector=comp.driver_labels)))
-        for name, fn in sources:
-            threading.Thread(target=watch_loop, args=(name, fn),
-                             daemon=True).start()
+        if hasattr(client, "set_event_hook"):
+            # Cached mode: the informers already watch everything the loop
+            # cares about, so the tick trigger rides their post-apply hook —
+            # no duplicate watch streams, and the woken tick is guaranteed
+            # to read a cache that reflects the event. Unrelated workload
+            # pods in the component namespaces don't tick the loop.
+            def on_event(kind, _etype, obj):
+                if kind in ("Node", "DaemonSet") or _is_driver_pod(obj):
+                    dirty.set()
+            client.set_event_hook(on_event)
+        else:
+            # Uncached mode: dedicated watch threads. Nodes drive admission/
+            # cordon/uncordon; each component's DRIVER pods (scoped by
+            # namespace + selector — never a cluster-wide pod watch, which
+            # would tick on unrelated workload churn) drive the
+            # driver-restart transitions.
+            def watch_loop(source_name, watch_fn):
+                while not stop.is_set():
+                    try:
+                        for _etype, _obj in watch_fn(
+                                timeout_seconds=args.interval):
+                            dirty.set()
+                            if stop.is_set():
+                                return
+                    except Exception as exc:
+                        logger.warning("%s watch dropped (%s); retrying",
+                                       source_name, exc)
+                        stop.wait(1.0)
+            import functools
+            sources = [("node", client.watch_nodes)]
+            for comp in components:
+                sources.append((
+                    f"pod:{comp.name}",
+                    functools.partial(client.watch_pods,
+                                      namespace=comp.namespace,
+                                      label_selector=comp.driver_labels)))
+            for name, fn in sources:
+                threading.Thread(target=watch_loop, args=(name, fn),
+                                 daemon=True).start()
     logger.info("managing %s every %.0fs%s",
                 [c.name for c in components], args.interval,
                 f", metrics on :{server.port}" if server else "")
@@ -250,6 +287,8 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     finally:
         if server:
             server.stop()
+        if hasattr(client, "stop"):  # CachedClient informers
+            client.stop()
         for sig, handler in prev_handlers.items():
             signal.signal(sig, handler)
     logger.info("exiting after %d ticks", ticks)

@@ -23,4 +23,5 @@ from .objects import (  # noqa: F401
     PodCondition,
 )
 from .client import Client, EventRecorder, NullRecorder  # noqa: F401
+from .cachedclient import CachedClient  # noqa: F401
 from .fakecluster import FakeCluster, FakeRecorder  # noqa: F401

@@ -1,0 +1,323 @@
+"""Informer-cached client: the production analog of controller-runtime's
+cached ``client.Client``.
+
+The reference pairs a *cached* controller-runtime client with an *uncached*
+clientset (upgrade_state.go:127-135); the staleness this creates is bridged
+by the provider's poll-until-synced barrier
+(node_upgrade_state_provider.go:92-117). Round 1 shipped only the uncached
+:class:`~.liveclient.LiveClient`, so every read was an apiserver GET and the
+barrier degenerated to a single immediately-true poll. This module supplies
+the missing half:
+
+- :class:`CachedClient` wraps any watch-capable client (LiveClient in
+  production, or LiveClient-over-:class:`~.httpapi.FakeAPIServer` in tests).
+- One :class:`_Informer` per kind (Node, Pod, DaemonSet) runs a
+  list-then-watch loop in a background thread: LIST seeds the store, then
+  WATCH events update it. Every watch window ends with a fresh re-LIST
+  before the next watch — the wire protocol here has no resourceVersion
+  resume (and a real 410 Gone demands the same re-list), so the re-list is
+  what bounds staleness after a gap. ``WatchError`` (410 Gone) likewise
+  falls through to the re-list.
+- Reads serve deep copies from the store (mutating a returned object never
+  corrupts the cache). Writes go straight through to the live client and do
+  NOT update the store — visibility arrives via the watch, exactly the lag
+  the cache-sync barrier exists to absorb.
+- ``direct()`` returns the raw uncached client, restoring the reference's
+  two-client split for the drain helper and pod listing
+  (upgrade_state.go:132-135).
+
+ControllerRevisions and Jobs pass through uncached: both are low-frequency
+point reads on the build-state path, and an uncached read is never *staler*
+than a cached one, so correctness is unaffected.
+
+``cache_lag`` injects an artificial delay before each watch event is applied
+to the store — the live-transport analog of FakeCluster's ``cache_lag``,
+used by tests to prove the barrier genuinely polls more than once.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .client import Client, NotFoundError, WatchError
+from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
+
+logger = logging.getLogger(__name__)
+
+_Key = Tuple[str, str]  # (namespace or "", name)
+
+
+def _key(obj) -> _Key:
+    return (obj.metadata.namespace or "", obj.metadata.name)
+
+
+def _not_older(event_rv: str, cached_rv: str) -> bool:
+    """Apply an event only if it is not older than the cached object (the
+    apiserver's RVs are opaque but practically monotonic ints; on parse
+    failure, apply — a full re-list follows every window anyway)."""
+    try:
+        return int(event_rv) >= int(cached_rv)
+    except (TypeError, ValueError):
+        return True
+
+
+def _match_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = obj.metadata.labels or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class _Informer:
+    """List-then-watch loop for one kind, feeding a keyed store."""
+
+    def __init__(self, kind: str,
+                 list_fn: Callable[[], List],
+                 watch_fn: Callable[..., object],
+                 watch_window_seconds: float,
+                 cache_lag: float = 0.0,
+                 event_hook: Optional[Callable] = None):
+        self.kind = kind
+        self._list_fn = list_fn
+        self._watch_fn = watch_fn
+        self._window = watch_window_seconds
+        self._cache_lag = cache_lag
+        self.event_hook = event_hook  # called AFTER an event is applied
+        self._store: Dict[_Key, object] = {}
+        self._lock = threading.Lock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"informer-{kind.lower()}")
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    def wait_synced(self, timeout: float) -> bool:
+        return self._synced.wait(timeout)
+
+    # --------------------------------------------------------------- reads
+
+    def get(self, namespace: str, name: str):
+        with self._lock:
+            obj = self._store.get((namespace or "", name))
+        if obj is None:
+            raise NotFoundError(f"{self.kind} {namespace}/{name} "
+                                "not in informer cache")
+        return copy.deepcopy(obj)
+
+    def snapshot(self) -> List:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]
+
+    # ---------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._relist()
+                self._synced.set()
+                for etype, obj in self._watch_fn(
+                        timeout_seconds=self._window):
+                    if self._stop.is_set():
+                        return
+                    if self._cache_lag:
+                        time.sleep(self._cache_lag)
+                    self._apply(etype, obj)
+                    if self.event_hook is not None:
+                        # post-apply: a reader woken by the hook sees the
+                        # event already reflected in the store
+                        self.event_hook(self.kind, etype, obj)
+                # clean window end: loop → re-list bounds any missed gap
+            except WatchError as exc:
+                logger.info("informer %s: watch expired (%s); re-listing",
+                            self.kind, exc)
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                logger.warning("informer %s: %s; re-listing in 1s",
+                               self.kind, exc)
+                self._stop.wait(1.0)
+
+    def _relist(self) -> None:
+        items = self._list_fn()
+        with self._lock:
+            self._store = {_key(o): o for o in items}
+
+    def _apply(self, etype: str, obj) -> None:
+        key = _key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._store.pop(key, None)
+                return
+            cached = self._store.get(key)
+            if cached is None or _not_older(obj.metadata.resource_version,
+                                            cached.metadata.resource_version):
+                self._store[key] = obj
+
+
+class CachedClient(Client):
+    """Cached reads over informer stores; writes and ``direct()`` hit the
+    wrapped live client. Call :meth:`start` (or use as a context manager)
+    before reading; reads before the initial list raise
+    :class:`RuntimeError`."""
+
+    def __init__(self, live: Client,
+                 namespaces: Optional[List[str]] = None,
+                 watch_window_seconds: float = 30.0,
+                 cache_lag: float = 0.0):
+        """``namespaces`` scopes the Pod and DaemonSet informers: one
+        informer pair per namespace, so a shared cluster's unrelated pods
+        never enter the store (the reference consumer scopes its cache the
+        same way via manager.Options.Namespace). None = cluster-wide."""
+        self._live = live
+        self._started = False
+        self._namespaces = sorted(set(namespaces)) if namespaces else [None]
+        self._informers: List[_Informer] = [
+            _Informer("Node", live.list_nodes, live.watch_nodes,
+                      watch_window_seconds, cache_lag)]
+        for ns in self._namespaces:
+            self._informers.append(_Informer(
+                "Pod",
+                lambda ns=ns: live.list_pods(namespace=ns),
+                lambda ns=ns, **kw: live.watch_pods(namespace=ns, **kw),
+                watch_window_seconds, cache_lag))
+            self._informers.append(_Informer(
+                "DaemonSet",
+                lambda ns=ns: live.list_daemonsets(namespace=ns),
+                lambda ns=ns, **kw: live.watch_daemonsets(namespace=ns,
+                                                          **kw),
+                watch_window_seconds, cache_lag))
+
+    def set_event_hook(self, hook: Optional[Callable]) -> None:
+        """``hook(kind, etype, obj)`` fires after each watch event lands in
+        the store — a reconcile loop woken by it reads a cache that already
+        reflects the event (no wake-before-visible race)."""
+        for inf in self._informers:
+            inf.event_hook = hook
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self, sync_timeout: float = 30.0) -> "CachedClient":
+        """Start informers and block until every cache has listed once
+        (mgr.GetCache().WaitForCacheSync analog)."""
+        for inf in self._informers:
+            inf.start()
+        deadline = time.monotonic() + sync_timeout
+        for inf in self._informers:
+            remaining = deadline - time.monotonic()
+            if not inf.wait_synced(max(remaining, 0.0)):
+                self.stop()
+                raise TimeoutError(
+                    f"informer {inf.kind} failed to sync "
+                    f"within {sync_timeout}s")
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        for inf in self._informers:
+            inf.stop()
+        for inf in self._informers:
+            inf.join(timeout=0.1)  # daemon threads; exit by next window
+
+    def __enter__(self) -> "CachedClient":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _caches(self, kind: str) -> List[_Informer]:
+        if not self._started:
+            raise RuntimeError("CachedClient.start() not called")
+        return [inf for inf in self._informers if inf.kind == kind]
+
+    # ------------------------------------------------------- cached reads
+
+    def get_node(self, name: str) -> Node:
+        return self._caches("Node")[0].get("", name)
+
+    def list_nodes(self, label_selector=None) -> List[Node]:
+        return [n for n in self._caches("Node")[0].snapshot()
+                if _match_labels(n, label_selector)]
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        for inf in self._caches("Pod"):
+            try:
+                return inf.get(namespace, name)
+            except NotFoundError:
+                continue
+        raise NotFoundError(f"Pod {namespace}/{name} not in informer cache")
+
+    def list_pods(self, namespace=None, label_selector=None,
+                  field_node_name=None) -> List[Pod]:
+        pods = [p for inf in self._caches("Pod") for p in inf.snapshot()]
+        if namespace:
+            pods = [p for p in pods if p.metadata.namespace == namespace]
+        if field_node_name:
+            pods = [p for p in pods if p.spec.node_name == field_node_name]
+        return [p for p in pods if _match_labels(p, label_selector)]
+
+    def list_daemonsets(self, namespace=None,
+                        label_selector=None) -> List[DaemonSet]:
+        dss = [d for inf in self._caches("DaemonSet")
+               for d in inf.snapshot()]
+        if namespace:
+            dss = [d for d in dss if d.metadata.namespace == namespace]
+        return [d for d in dss if _match_labels(d, label_selector)]
+
+    # --------------------------------------- uncached passthrough reads
+
+    def list_controller_revisions(self, namespace=None, label_selector=None
+                                  ) -> List[ControllerRevision]:
+        return self._live.list_controller_revisions(namespace, label_selector)
+
+    def get_job(self, namespace: str, name: str) -> Job:
+        return self._live.get_job(namespace, name)
+
+    # ------------------------------------------------------------- writes
+
+    def patch_node_metadata(self, name, labels=None, annotations=None) -> Node:
+        return self._live.patch_node_metadata(name, labels=labels,
+                                              annotations=annotations)
+
+    def patch_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        return self._live.patch_node_unschedulable(name, unschedulable)
+
+    def create_pod(self, pod: Pod) -> Pod:
+        return self._live.create_pod(pod)
+
+    def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        self._live.delete_pod(namespace, name,
+                              grace_period_seconds=grace_period_seconds)
+
+    def evict_pod(self, namespace, name, grace_period_seconds=None) -> None:
+        self._live.evict_pod(namespace, name,
+                             grace_period_seconds=grace_period_seconds)
+
+    # ------------------------------------------------------------ escape
+
+    def watch_nodes(self, *a, **kw):
+        return self._live.watch_nodes(*a, **kw)
+
+    def watch_pods(self, *a, **kw):
+        return self._live.watch_pods(*a, **kw)
+
+    def watch_daemonsets(self, *a, **kw):
+        return self._live.watch_daemonsets(*a, **kw)
+
+    def direct(self) -> Client:
+        """The uncached client (kubernetes.Interface analog) — the drain
+        helper and eviction path read through this, never the cache."""
+        return self._live

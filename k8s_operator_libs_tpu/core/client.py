@@ -27,6 +27,12 @@ class ConflictError(RuntimeError):
     """resourceVersion conflict on update (apierrors.IsConflict analog)."""
 
 
+class WatchError(RuntimeError):
+    """A watch stream delivered an ERROR event (e.g. 410 Gone: the resource
+    version expired). Consumers must re-list and re-establish the watch —
+    the informer cache and cmd/operator.py's watch loop both do."""
+
+
 class Client(abc.ABC):
     """Cached read / write client (controller-runtime client.Client analog)."""
 

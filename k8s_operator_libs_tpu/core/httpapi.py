@@ -152,6 +152,8 @@ class _Handler(BaseHTTPRequestHandler):
         m = re.fullmatch(
             r"/apis/apps/v1(?:/namespaces/([^/]+))?/daemonsets", path)
         if m and method == "GET":
+            if qs.get("watch", ["false"])[0] == "true":
+                return self._watch("DaemonSet", m.group(1), qs)
             return self._list("DaemonSet", m.group(1), qs)
         m = re.fullmatch(
             r"/apis/apps/v1(?:/namespaces/([^/]+))?/controllerrevisions",

@@ -18,10 +18,11 @@ Auth config resolution (client-go loading-rules analog):
 
 Caching note: the reference pairs a *cached* controller-runtime client with
 an *uncached* clientset and bridges staleness with the provider's
-poll-until-synced barrier. This client is uncached (every read hits the
-apiserver) — ``direct()`` returns self, and the barrier degenerates to a
-single immediately-true poll. An informer cache is a later optimization;
-correctness never depends on it.
+poll-until-synced barrier. This client is the uncached half (every read
+hits the apiserver; ``direct()`` returns self). Production long-running
+operators wrap it in :class:`~.cachedclient.CachedClient` — informer-backed
+stores fed by the watch streams below — restoring the reference's
+two-client split so the barrier does real work.
 """
 
 from __future__ import annotations
@@ -41,7 +42,8 @@ from typing import Dict, List, Optional
 import yaml
 
 from . import serde
-from .client import Client, ConflictError, NotFoundError
+from .client import (Client, ConflictError, NotFoundError,
+                     WatchError)  # noqa: F401  (WatchError re-export)
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -193,12 +195,6 @@ class KubeHTTP:
         return json.loads(payload) if payload else {}
 
 
-class WatchError(RuntimeError):
-    """A watch stream delivered an ERROR event (e.g. 410 Gone: the resource
-    version expired). Consumers must re-list and re-establish the watch —
-    cmd/operator.py's watch loop does so by catching and reconnecting."""
-
-
 def _check_watch_error(ev: Dict) -> None:
     if ev.get("type") == "ERROR":
         raise WatchError(str(ev.get("object")))
@@ -275,27 +271,12 @@ class LiveClient(Client):
 
     # ------------------------------------------------------------- watch
 
-    def watch_nodes(self, label_selector=None, timeout_seconds: float = 30.0):
-        """Yield ("ADDED"|"MODIFIED"|"DELETED", Node) until the server ends
-        the watch window (controller-runtime informer analog: consumers
-        loop, reconnecting per window — see cmd/operator.py --watch)."""
-        params = _selector_params(label_selector) or {}
-        params.update({"watch": "true",
-                       # int string: the real apiserver ParseInts this
-                       "timeoutSeconds": str(int(timeout_seconds))})
-        for ev in self._http.stream_lines("/api/v1/nodes", params,
-                                          read_timeout=timeout_seconds + 30):
-            _check_watch_error(ev)
-            yield ev.get("type", ""), serde.node_from_json(
-                ev.get("object") or {})
-
-    def watch_pods(self, namespace: Optional[str] = None,
-                   label_selector=None, timeout_seconds: float = 30.0):
-        """Yield ("ADDED"|"MODIFIED"|"DELETED", Pod) — an operator watches
-        the driver pods it owns as well as nodes (driver-pod recreation is
-        what unblocks pod-restart-required)."""
-        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
-                else "/api/v1/pods")
+    def _watch_stream(self, path: str, from_json,
+                      label_selector=None, timeout_seconds: float = 30.0):
+        """Shared watch protocol: one ("ADDED"|"MODIFIED"|"DELETED", obj)
+        per line until the server ends the window (controller-runtime
+        informer analog: consumers loop, reconnecting per window). ERROR
+        events (410 Gone) raise :class:`WatchError` → consumers re-list."""
         params = _selector_params(label_selector) or {}
         params.update({"watch": "true",
                        # int string: the real apiserver ParseInts this
@@ -303,8 +284,31 @@ class LiveClient(Client):
         for ev in self._http.stream_lines(path, params,
                                           read_timeout=timeout_seconds + 30):
             _check_watch_error(ev)
-            yield ev.get("type", ""), serde.pod_from_json(
-                ev.get("object") or {})
+            yield ev.get("type", ""), from_json(ev.get("object") or {})
+
+    def watch_nodes(self, label_selector=None, timeout_seconds: float = 30.0):
+        return self._watch_stream("/api/v1/nodes", serde.node_from_json,
+                                  label_selector, timeout_seconds)
+
+    def watch_pods(self, namespace: Optional[str] = None,
+                   label_selector=None, timeout_seconds: float = 30.0):
+        """Driver-pod recreation is what unblocks pod-restart-required, so
+        operators watch their pods as well as nodes."""
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        return self._watch_stream(path, serde.pod_from_json,
+                                  label_selector, timeout_seconds)
+
+    def watch_daemonsets(self, namespace: Optional[str] = None,
+                         label_selector=None,
+                         timeout_seconds: float = 30.0):
+        """The informer cache watches driver DaemonSets so revision bumps
+        appear without polling (reference: the controller-runtime cache
+        informs on every GVK it reads — upgrade_state.go:127-130)."""
+        path = (f"/apis/apps/v1/namespaces/{namespace}/daemonsets"
+                if namespace else "/apis/apps/v1/daemonsets")
+        return self._watch_stream(path, serde.daemonset_from_json,
+                                  label_selector, timeout_seconds)
 
     # ------------------------------------------------------------ writes
 
