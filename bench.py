@@ -181,6 +181,17 @@ def model_upgrade_pipeline():
     mgr = ClusterUpgradeStateManager(cluster.client, keys, cluster.recorder,
                                      clock, grouper=TPUSliceGrouper(),
                                      synchronous=True)
+    # count cache-sync barriers: each is a patch + poll-until-visible, the
+    # per-transition cost the combined label+annotation write batches down
+    provider = mgr.node_upgrade_state_provider
+    barrier_count = {"n": 0}
+    orig_wait_many = provider._wait_synced_many
+
+    def counting_wait_many(names, pred):
+        barrier_count["n"] += 1
+        return orig_wait_many(names, pred)
+
+    provider._wait_synced_many = counting_wait_many
     policy = DriverUpgradePolicySpec(
         auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="25%",
         wait_for_completion=WaitForCompletionSpec(pod_selector="job=llama-fsdp"),
@@ -219,7 +230,8 @@ def model_upgrade_pipeline():
             break
     assert uncordon_t is not None, "upgrade never converged"
     return {"slice_unavailable_s": uncordon_t - cordon_t,
-            "pipeline_total_s": uncordon_t}
+            "pipeline_total_s": uncordon_t,
+            "cache_barriers": barrier_count["n"]}
 
 
 def main():

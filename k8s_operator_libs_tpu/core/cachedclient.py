@@ -298,6 +298,9 @@ class CachedClient(Client):
     def create_pod(self, pod: Pod) -> Pod:
         return self._live.create_pod(pod)
 
+    def create_service(self, service):
+        return self._live.create_service(service)
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         self._live.delete_pod(namespace, name,
                               grace_period_seconds=grace_period_seconds)

@@ -487,6 +487,11 @@ class _FakeClient(Client):
         self._c.flush_cache()
         return created
 
+    def create_service(self, service):
+        created = self._c.create(service)
+        self._c.flush_cache()
+        return created
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         self._c.delete("Pod", namespace, name)
 

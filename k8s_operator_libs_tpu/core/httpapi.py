@@ -146,6 +146,9 @@ class _Handler(BaseHTTPRequestHandler):
             r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/eviction", path)
         if m and method == "POST":
             return self._delete_pod(m.group(1), m.group(2), evict=True)
+        m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/services", path)
+        if m and method == "POST":
+            return self._create_service(m.group(1), self._body())
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
         if m and method == "POST":
             return self._record_event(self._body())
@@ -210,6 +213,15 @@ class _Handler(BaseHTTPRequestHandler):
         except ConflictError as exc:
             return self._error(409, "AlreadyExists", str(exc))
         self._send(201, serde.pod_to_json(created))
+
+    def _create_service(self, ns: str, body: Dict) -> None:
+        svc = serde.service_from_json(body)
+        svc.metadata.namespace = ns
+        try:
+            created = self.cluster.client.direct().create_service(svc)
+        except ConflictError as exc:
+            return self._error(409, "AlreadyExists", str(exc))
+        self._send(201, serde.service_to_json(created))
 
     def _delete_pod(self, ns: str, name: str, evict: bool = False) -> None:
         try:

@@ -57,6 +57,20 @@ class KubeConfig:
     client_key_file: Optional[str] = None
     token: Optional[str] = None
     insecure_skip_tls_verify: bool = False
+    # client-go credential-plugin config (kubeconfig user.exec). When set,
+    # KubeHTTP refreshes the token through it — exec tokens expire (GKE:
+    # ~1 h), so a one-shot fetch would start 401ing mid-run.
+    exec_cfg: Optional[Dict] = None
+    token_expiry: Optional[float] = None  # epoch seconds, None = no expiry
+
+    def refresh_exec_token(self) -> None:
+        if self.exec_cfg is not None:
+            self.token, self.token_expiry = _run_exec_plugin(self.exec_cfg)
+
+    def token_expired(self) -> bool:
+        import time as _time
+        return (self.token_expiry is not None
+                and _time.time() >= self.token_expiry - 60.0)  # 60 s slack
 
     @classmethod
     def from_kubeconfig(cls, path: Optional[str] = None,
@@ -76,14 +90,38 @@ class KubeConfig:
         ctx = _named(cfg.get("contexts"), ctx_name, "context")
         cluster = _named(cfg.get("clusters"), ctx["cluster"], "cluster")
         user = _named(cfg.get("users"), ctx["user"], "user")
+        token = user.get("token")
+        cert = _file_or_data(user, "client-certificate")
+        key = _file_or_data(user, "client-key")
+        exec_cfg = None
+        token_expiry = None
+        if token is None and cert is None and "exec" in user:
+            # GKE kubeconfigs authenticate via an exec plugin
+            # (gke-gcloud-auth-plugin): run it and use the returned
+            # ExecCredential token, instead of silently loading no
+            # credentials and failing later with opaque 401s
+            exec_cfg = user["exec"]
+            token, token_expiry = _run_exec_plugin(exec_cfg)
+        server = cluster["server"].rstrip("/")
+        if token is None and cert is None and server.startswith("https"):
+            # http:// servers (kubectl proxy) legitimately need no creds;
+            # an https cluster with none would fail later with opaque 401s
+            raise RuntimeError(
+                f"kubeconfig user {ctx['user']!r} has no usable credentials: "
+                "no client certificate, no static token, and no (working) "
+                "exec plugin. Supported auth: client-certificate[-data] + "
+                "client-key[-data], token, or an exec plugin on PATH "
+                "(e.g. gke-gcloud-auth-plugin).")
         return cls(
-            server=cluster["server"].rstrip("/"),
+            server=server,
             ca_file=_file_or_data(cluster, "certificate-authority"),
-            client_cert_file=_file_or_data(user, "client-certificate"),
-            client_key_file=_file_or_data(user, "client-key"),
-            token=user.get("token"),
+            client_cert_file=cert,
+            client_key_file=key,
+            token=token,
             insecure_skip_tls_verify=bool(
                 cluster.get("insecure-skip-tls-verify", False)),
+            exec_cfg=exec_cfg,
+            token_expiry=token_expiry,
         )
 
     @classmethod
@@ -97,6 +135,56 @@ class KubeConfig:
             token = f.read().strip()
         return cls(server=f"https://{host}:{port}",
                    ca_file=os.path.join(SA_DIR, "ca.crt"), token=token)
+
+
+def _run_exec_plugin(exec_cfg: Dict):
+    """client-go credential-plugin protocol: run the configured command and
+    parse the ExecCredential JSON it prints ({"status": {"token": ...}}).
+    Returns (token, expiration_epoch_or_None). Raises with a clear message
+    when the plugin is missing or misbehaves."""
+    import subprocess
+    cmd = [exec_cfg.get("command", "")]
+    cmd += list(exec_cfg.get("args") or [])
+    env = dict(os.environ)
+    for e in exec_cfg.get("env") or []:
+        env[e.get("name", "")] = e.get("value", "")
+    api_version = exec_cfg.get("apiVersion",
+                               "client.authentication.k8s.io/v1beta1")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": api_version, "kind": "ExecCredential",
+        "spec": {"interactive": False}})
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=60)
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"kubeconfig exec plugin {cmd[0]!r} not found on PATH — install "
+            "it (for GKE: gke-gcloud-auth-plugin) or use cert/token auth")
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(f"kubeconfig exec plugin {cmd[0]!r} timed out")
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"kubeconfig exec plugin {cmd[0]!r} failed (rc={out.returncode}): "
+            f"{out.stderr.strip()[:500]}")
+    try:
+        cred = json.loads(out.stdout)
+        status = cred["status"]
+        token = status["token"]
+    except (ValueError, KeyError, TypeError):
+        raise RuntimeError(
+            f"kubeconfig exec plugin {cmd[0]!r} did not print an "
+            "ExecCredential with status.token")
+    expiry = None
+    ts = status.get("expirationTimestamp")
+    if ts:
+        import calendar
+        import time as _time
+        try:
+            expiry = calendar.timegm(
+                _time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        except ValueError:
+            pass  # unparseable expiry → treat as non-expiring
+    return token, expiry
 
 
 def _named(entries, name, kind) -> Dict:
@@ -149,6 +237,8 @@ class KubeHTTP:
                        params: Optional[Dict[str, str]] = None,
                        data: Optional[bytes] = None
                        ) -> urllib.request.Request:
+        if self.config.token_expired():
+            self.config.refresh_exec_token()
         url = self.config.server + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
@@ -177,21 +267,29 @@ class KubeHTTP:
                 params: Optional[Dict[str, str]] = None,
                 content_type: str = "application/json") -> Dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = self._build_request(method, path, params, data)
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        try:
-            with urllib.request.urlopen(req, context=self._ctx,
-                                        timeout=30) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as exc:
-            detail = exc.read().decode(errors="replace")
-            if exc.code == 404:
-                raise NotFoundError(f"{method} {path}: {detail}") from exc
-            if exc.code == 409:
-                raise ConflictError(f"{method} {path}: {detail}") from exc
-            raise RuntimeError(
-                f"{method} {path}: HTTP {exc.code}: {detail}") from exc
+        for attempt in (0, 1):
+            req = self._build_request(method, path, params, data)
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            try:
+                with urllib.request.urlopen(req, context=self._ctx,
+                                            timeout=30) as resp:
+                    payload = resp.read()
+                break
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode(errors="replace")
+                if (exc.code == 401 and attempt == 0
+                        and self.config.exec_cfg is not None):
+                    # exec token revoked before its stated expiry —
+                    # re-run the plugin once and retry
+                    self.config.refresh_exec_token()
+                    continue
+                if exc.code == 404:
+                    raise NotFoundError(f"{method} {path}: {detail}") from exc
+                if exc.code == 409:
+                    raise ConflictError(f"{method} {path}: {detail}") from exc
+                raise RuntimeError(
+                    f"{method} {path}: HTTP {exc.code}: {detail}") from exc
         return json.loads(payload) if payload else {}
 
 
@@ -336,6 +434,14 @@ class LiveClient(Client):
         return serde.pod_from_json(self._http.request(
             "POST", f"/api/v1/namespaces/{ns}/pods",
             body=serde.pod_to_json(pod)))
+
+    def create_service(self, service):
+        """POST a Service (the scheduler's headless Service for workload-pod
+        DNS: the JAX/MEGASCALE coordinator address resolves via it)."""
+        ns = service.metadata.namespace or "default"
+        return serde.service_from_json(self._http.request(
+            "POST", f"/api/v1/namespaces/{ns}/services",
+            body=serde.service_to_json(service)))
 
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         body = None

@@ -125,6 +125,11 @@ class PodSpec:
     # summed container resource requests, e.g. {"google.com/tpu": 4}
     resource_requests: Dict[str, int] = field(default_factory=dict)
     env: Dict[str, str] = field(default_factory=dict)
+    # hostname + subdomain make the pod DNS-resolvable as
+    # <hostname>.<subdomain> through a headless Service named <subdomain>
+    # (the JAX/MEGASCALE coordinator address must resolve cluster-wide)
+    hostname: str = ""
+    subdomain: str = ""
 
 
 @dataclass
@@ -216,6 +221,38 @@ class Job:
     status: JobStatus = field(default_factory=JobStatus)
 
     kind: str = "Job"
+
+
+# ---------------------------------------------------------------------------
+# Service (headless Services give workload pods stable DNS names — the JAX /
+# MEGASCALE coordinator address must resolve across the cluster)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServicePort:
+    # k8s requires NAMED ports whenever a Service has more than one
+    name: str = ""
+    port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    cluster_ip: str = ""          # "None" == headless
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    kind: str = "Service"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
 
 
 # ---------------------------------------------------------------------------

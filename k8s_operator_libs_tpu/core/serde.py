@@ -23,7 +23,8 @@ from typing import Dict, List, Optional
 from .objects import (ContainerStatus, ControllerRevision, DaemonSet,
                       DaemonSetStatus, Job, JobStatus, Node, NodeCondition,
                       NodeSpec, NodeStatus, ObjectMeta, OwnerReference, Pod,
-                      PodCondition, PodSpec, PodStatus, Volume)
+                      PodCondition, PodSpec, PodStatus, Service, ServicePort,
+                      ServiceSpec, Volume)
 
 RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
 
@@ -122,6 +123,10 @@ def pod_to_json(p: Pod) -> Dict:
         container["env"] = [{"name": k, "value": v}
                             for k, v in p.spec.env.items()]
     spec: Dict = {"nodeName": p.spec.node_name, "containers": [container]}
+    if p.spec.hostname:
+        spec["hostname"] = p.spec.hostname
+    if p.spec.subdomain:
+        spec["subdomain"] = p.spec.subdomain
     if p.spec.termination_grace_period_seconds is not None:
         spec["terminationGracePeriodSeconds"] = (
             p.spec.termination_grace_period_seconds)
@@ -178,6 +183,8 @@ def pod_from_json(j: Dict) -> Pod:
         metadata=meta_from_json(j.get("metadata") or {}),
         spec=PodSpec(
             node_name=spec_j.get("nodeName", ""),
+            hostname=spec_j.get("hostname", ""),
+            subdomain=spec_j.get("subdomain", ""),
             volumes=[Volume(name=v.get("name", ""),
                             empty_dir="emptyDir" in v)
                      for v in spec_j.get("volumes") or []],
@@ -248,6 +255,31 @@ def job_from_json(j: Dict) -> Job:
                status=JobStatus(active=int(s.get("active", 0)),
                                 succeeded=int(s.get("succeeded", 0)),
                                 failed=int(s.get("failed", 0))))
+
+
+def service_to_json(s: Service) -> Dict:
+    spec: Dict = {}
+    if s.spec.cluster_ip:
+        spec["clusterIP"] = s.spec.cluster_ip
+    if s.spec.selector:
+        spec["selector"] = dict(s.spec.selector)
+    if s.spec.ports:
+        spec["ports"] = [{"name": p.name, "port": p.port}
+                         for p in s.spec.ports]
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": meta_to_json(s.metadata), "spec": spec}
+
+
+def service_from_json(j: Dict) -> Service:
+    spec_j = j.get("spec") or {}
+    return Service(
+        metadata=meta_from_json(j.get("metadata") or {}),
+        spec=ServiceSpec(
+            cluster_ip=spec_j.get("clusterIP", ""),
+            selector=dict(spec_j.get("selector") or {}),
+            ports=[ServicePort(name=p.get("name", ""),
+                               port=int(p.get("port", 0)))
+                   for p in spec_j.get("ports") or []]))
 
 
 def list_to_json(kind: str, items: List[Dict]) -> Dict:

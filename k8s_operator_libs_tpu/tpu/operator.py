@@ -71,6 +71,12 @@ class TPUOperator:
     # ---------------------------------------------------------- workloads
 
     def submit(self, workload: TPUWorkload) -> None:
+        """Queue a workload for placement. Validates up front so a malformed
+        workload is rejected at the API boundary instead of poisoning every
+        subsequent reconcile tick."""
+        if workload.num_slices < 1:
+            raise ValueError(f"workload {workload.name}: num_slices must be "
+                             f">= 1, got {workload.num_slices}")
         self._pending.append(workload)
 
     @property
@@ -99,7 +105,16 @@ class TPUOperator:
                 states[comp.name] = None
         still_pending: List[TPUWorkload] = []
         for wl in self._pending:
-            placement = self.scheduler.place(wl)
+            # per-workload isolation: one failing placement must not starve
+            # upgrades or the other workloads (mirrors the per-component
+            # try/except above)
+            try:
+                placement = self.scheduler.place(wl)
+            except Exception:
+                logger.exception("placement of workload %s failed; keeping "
+                                 "it pending", wl.name)
+                still_pending.append(wl)
+                continue
             if placement is None:
                 still_pending.append(wl)
             else:

@@ -117,6 +117,15 @@ class TPUSliceGrouper(NodeGrouper):
             return node.metadata.name
         return f"slice/{info.slice_id}"
 
+    def expected_group_size(self, node: Node) -> Optional[int]:
+        """A multi-host slice's group must contain every host the topology
+        label implies (validate_slice_membership's rule, enforced at
+        admission by the state machine)."""
+        info = slice_info_for_node(node)
+        if info is None or not info.multi_host:
+            return None
+        return info.num_hosts
+
 
 def validate_slice_membership(nodes, expected: Optional[SliceInfo] = None
                               ) -> Dict[str, SliceInfo]:

@@ -73,21 +73,35 @@ class DrainManager:
             clock=self._clock,
         )
 
+        if self._synchronous:
+            # Inline drains run sequentially, so batch the success
+            # transitions into one patch-all + one cache barrier (async mode
+            # needs no batching: per-thread barriers overlap in real time).
+            drained: List[Node] = []
+            for node in config.nodes:
+                if not self._draining.add_if_absent(node.metadata.name):
+                    logger.info("node %s already draining, skipping",
+                                node.metadata.name)
+                    continue
+                log_event(self._recorder, node, "Normal", self._keys.event_reason,
+                          "Scheduling drain of the node")
+                self._drain_one(helper, node, successes=drained)
+            self._provider.change_nodes_state_and_annotations(
+                drained, UpgradeState.POD_RESTART_REQUIRED)
+            return
         for node in config.nodes:
             if not self._draining.add_if_absent(node.metadata.name):
                 logger.info("node %s already draining, skipping", node.metadata.name)
                 continue
             log_event(self._recorder, node, "Normal", self._keys.event_reason,
                       "Scheduling drain of the node")
-            if self._synchronous:
-                self._drain_one(helper, node)
-            else:
-                t = threading.Thread(target=self._drain_one, args=(helper, node),
-                                     daemon=True)
-                self._threads.append(t)
-                t.start()
+            t = threading.Thread(target=self._drain_one, args=(helper, node),
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
 
-    def _drain_one(self, helper: Helper, node: Node) -> None:
+    def _drain_one(self, helper: Helper, node: Node,
+                   successes: Optional[List[Node]] = None) -> None:
         name = node.metadata.name
         try:
             try:
@@ -108,8 +122,11 @@ class DrainManager:
                 return
             log_event(self._recorder, node, "Normal", self._keys.event_reason,
                       "Successfully drained the node")
-            self._provider.change_node_upgrade_state(
-                node, UpgradeState.POD_RESTART_REQUIRED)
+            if successes is not None:
+                successes.append(node)
+            else:
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED)
         finally:
             self._draining.remove(name)
 

@@ -46,6 +46,14 @@ class NodeGrouper:
     def group_key(self, node: Node) -> str:
         raise NotImplementedError
 
+    def expected_group_size(self, node: Node) -> Optional[int]:
+        """How many members the node's group *should* have, when the grouper
+        can know it from out-of-band metadata (a slice topology label), or
+        None when only observed membership defines the group. Admission uses
+        this to refuse partial group views (SURVEY §7.4): acting on fewer
+        hosts than the topology implies would break slice atomicity."""
+        return None
+
 
 class SingleNodeGrouper(NodeGrouper):
     """Reference behavior: every node is its own group."""

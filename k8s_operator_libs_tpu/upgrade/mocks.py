@@ -73,6 +73,38 @@ class MockNodeUpgradeStateProvider(_Recording):
         else:
             node.metadata.annotations[key] = value
 
+    def change_node_state_and_annotations(
+            self, node: Node, new_state: Optional[str] = None,
+            annotations: Optional[Dict[str, str]] = None) -> None:
+        self._record("change_node_state_and_annotations", node.metadata.name,
+                     new_state, dict(annotations or {}))
+        self._apply(node, new_state, annotations)
+
+    def change_nodes_state_and_annotations(
+            self, nodes, new_state: Optional[str] = None,
+            annotations: Optional[Dict[str, str]] = None) -> None:
+        nodes = list(nodes)
+        if not nodes or (new_state is None and not annotations):
+            return
+        self._record("change_nodes_state_and_annotations",
+                     [n.metadata.name for n in nodes], new_state,
+                     dict(annotations or {}))
+        for node in nodes:
+            self._apply(node, new_state, annotations)
+
+    def _apply(self, node: Node, new_state: Optional[str],
+               annotations: Optional[Dict[str, str]]) -> None:
+        if new_state is not None:
+            if new_state:
+                node.metadata.labels[self._keys.state_label] = new_state
+            else:
+                node.metadata.labels.pop(self._keys.state_label, None)
+        for key, value in (annotations or {}).items():
+            if value == "null":
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = value
+
 
 class MockCordonManager(_Recording):
     def cordon(self, node: Node) -> None:

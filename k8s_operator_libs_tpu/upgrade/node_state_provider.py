@@ -7,12 +7,20 @@ reconcile's cached reads. The provider therefore (a) serializes writes per
 node with a KeyedMutex (:43, :60, :78, :145) and (b) after every label or
 annotation patch, polls the cached client until the write is visible —
 the cache-sync barrier (:92-117, :163-197; ≤10 s at 1 s intervals).
+
+One deliberate extension over the reference: writes can be BATCHED — the
+state label and annotations of one node go out as a single strategic-merge
+patch (the reference pays a patch + barrier per field), and a whole state
+bucket's transitions can share one barrier wait in which the per-node cache
+lags overlap instead of serializing (v5p-64: 16 hosts x ~6 in-window
+transitions per rolling upgrade). The visibility contract is unchanged:
+every write is reflected by the cached client before the call returns.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import List, Optional
 
 from ..core.client import Client, EventRecorder
 from ..core.objects import Node
@@ -59,68 +67,110 @@ class NodeUpgradeStateProvider:
         """ChangeNodeUpgradeState (:72-134): patch the state label, then block
         until the cached client reflects it. Setting UNKNOWN ("") removes the
         label. Emits a Normal event on success."""
-        with self._mutex.lock(node.metadata.name):
-            value = new_state if new_state != consts.UpgradeState.UNKNOWN else None
-            self._client.patch_node_metadata(
-                node.metadata.name, labels={self._keys.state_label: value})
-            self._wait_label_synced(node.metadata.name, self._keys.state_label, value)
-            node.metadata.labels = dict(node.metadata.labels)
-            if value is None:
-                node.metadata.labels.pop(self._keys.state_label, None)
-            else:
-                node.metadata.labels[self._keys.state_label] = value
-            log_event(self._recorder, node, "Normal", self._keys.event_reason,
-                      f"Node upgrade state updated to {new_state or 'unknown'}")
-            logger.info("node %s upgrade state -> %r", node.metadata.name, new_state)
+        self.change_nodes_state_and_annotations([node], new_state)
 
-    def change_node_upgrade_annotation(self, node: Node, key: str, value: str) -> None:
+    def change_node_upgrade_annotation(self, node: Node, key: str,
+                                       value: str) -> None:
         """ChangeNodeUpgradeAnnotation (:138-216): set (or, for value "null",
         delete) an annotation with the same cache-sync barrier + event."""
-        with self._mutex.lock(node.metadata.name):
-            patched = None if value == NULL else value
-            self._client.patch_node_metadata(
-                node.metadata.name, annotations={key: patched})
-            self._wait_annotation_synced(node.metadata.name, key, patched)
-            node.metadata.annotations = dict(node.metadata.annotations)
-            if patched is None:
-                node.metadata.annotations.pop(key, None)
-            else:
-                node.metadata.annotations[key] = patched
-            verb = "deleted" if patched is None else f"set to {value}"
-            log_event(self._recorder, node, "Normal", self._keys.event_reason,
-                      f"Node annotation {key} {verb}")
+        self.change_nodes_state_and_annotations([node], None, {key: value})
+
+    def change_node_state_and_annotations(
+            self, node: Node, new_state: Optional[str] = None,
+            annotations: Optional[dict] = None) -> None:
+        """Combined write for one node: state label + annotations in ONE
+        patch with ONE barrier (the reference pays per field)."""
+        self.change_nodes_state_and_annotations([node], new_state, annotations)
+
+    def change_nodes_state_and_annotations(
+            self, nodes: List[Node], new_state: Optional[str] = None,
+            annotations: Optional[dict] = None) -> None:
+        """THE write path. Applies the same state label (``new_state`` None =
+        leave untouched, UNKNOWN = remove) and annotations (value ``NULL`` =
+        delete) to every node: one strategic-merge patch per node, then one
+        barrier wait covering all of them. Per-node Normal events mirror the
+        reference's per-write event trail exactly."""
+        nodes = list(nodes)
+        if not nodes or (new_state is None and not annotations):
+            return
+        label_value: Optional[str] = None
+        labels = None
+        if new_state is not None:
+            label_value = (new_state
+                           if new_state != consts.UpgradeState.UNKNOWN
+                           else None)
+            labels = {self._keys.state_label: label_value}
+        patched_annos = {k: (None if v == NULL else v)
+                         for k, v in (annotations or {}).items()}
+        for node in nodes:
+            with self._mutex.lock(node.metadata.name):
+                self._client.patch_node_metadata(
+                    node.metadata.name, labels=labels,
+                    annotations=patched_annos or None)
+
+        def synced(n: Node) -> bool:
+            if labels is not None and (
+                    n.metadata.labels.get(self._keys.state_label)
+                    != label_value):
+                return False
+            return all(n.metadata.annotations.get(k) == v
+                       for k, v in patched_annos.items())
+
+        self._wait_synced_many({n.metadata.name for n in nodes}, synced)
+
+        for node in nodes:
+            if labels is not None:
+                node.metadata.labels = dict(node.metadata.labels)
+                if label_value is None:
+                    node.metadata.labels.pop(self._keys.state_label, None)
+                else:
+                    node.metadata.labels[self._keys.state_label] = label_value
+                log_event(self._recorder, node, "Normal",
+                          self._keys.event_reason,
+                          f"Node upgrade state updated to {new_state or 'unknown'}")
+                logger.info("node %s upgrade state -> %r",
+                            node.metadata.name, new_state)
+            if patched_annos:
+                node.metadata.annotations = dict(node.metadata.annotations)
+                for k, v in patched_annos.items():
+                    if v is None:
+                        node.metadata.annotations.pop(k, None)
+                        verb = "deleted"
+                    else:
+                        node.metadata.annotations[k] = v
+                        verb = f"set to {v}"
+                    log_event(self._recorder, node, "Normal",
+                              self._keys.event_reason,
+                              f"Node annotation {k} {verb}")
 
     # --------------------------------------------------------------- barrier
 
-    def _wait_label_synced(self, name: str, key: str, value: Optional[str]) -> None:
-        self._wait_synced(name, lambda n: n.metadata.labels.get(key) == value)
-
-    def _wait_annotation_synced(self, name: str, key: str,
-                                value: Optional[str]) -> None:
-        self._wait_synced(name, lambda n: n.metadata.annotations.get(key) == value)
-
-    def _wait_synced(self, name: str, pred) -> None:
-        """Poll-until-visible (:92-117). Raises CacheSyncTimeoutError after
-        sync_timeout — the reference returns an error, failing the current
-        ApplyState pass; the next reconcile retries idempotently.
+    def _wait_synced_many(self, names, pred) -> None:
+        """Poll-until-visible (:92-117) over a set of nodes: the individual
+        writes' cache lags overlap inside one wait. Raises
+        CacheSyncTimeoutError after sync_timeout — the reference returns an
+        error, failing the current ApplyState pass; the next reconcile
+        retries idempotently.
 
         Polling is ADAPTIVE where the reference's is fixed-1 s: start at
         sync_poll/20 and back off x2 to sync_poll. Same contract (bounded by
         sync_timeout, poll-until-visible), far lower added latency — informer
-        caches typically sync in tens of ms, and at slice scale the barrier
-        runs once per node per transition (16-host v5p-64: ~140 barriers per
-        rolling upgrade, so 1 s vs ~0.1 s each is minutes of downtime)."""
+        caches typically sync in tens of ms."""
+        pending = set(names)
         deadline = self._clock.now() + self._sync_timeout
         poll = self._sync_poll / 20.0
-        while True:
-            try:
-                if pred(self._client.get_node(name)):
-                    return
-            except KeyError:
-                pass  # node not in cache yet
+        while pending:
+            for name in list(pending):
+                try:
+                    if pred(self._client.get_node(name)):
+                        pending.discard(name)
+                except KeyError:
+                    pass  # node not in cache yet
+            if not pending:
+                break
             if self._clock.now() >= deadline:
                 raise CacheSyncTimeoutError(
-                    f"cached client did not reflect write to node {name} "
-                    f"within {self._sync_timeout}s")
+                    f"cached client did not reflect write to nodes "
+                    f"{sorted(pending)} within {self._sync_timeout}s")
             self._clock.sleep(poll)
             poll = min(poll * 2.0, self._sync_poll)

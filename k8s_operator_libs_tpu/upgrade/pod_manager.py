@@ -198,31 +198,43 @@ class PodManager:
         spec = config.wait_for_completion_spec
         assert spec is not None
         selector = parse_selector(spec.pod_selector)
+        key = self._keys.wait_for_completion_start_annotation
+        if self._synchronous:
+            # batch the advancing nodes: one patch-all + one cache barrier
+            advancing: List[Node] = []
+            for node in config.nodes:
+                pods = self._client.direct().list_pods(
+                    label_selector=selector, field_node_name=node.metadata.name)
+                if self._check_one(node, pods, spec, defer=True):
+                    advancing.append(node)
+            self._provider.change_nodes_state_and_annotations(
+                advancing, UpgradeState.POD_DELETION_REQUIRED, {key: NULL})
+            return
         threads = []
         for node in config.nodes:
             pods = self._client.direct().list_pods(
                 label_selector=selector, field_node_name=node.metadata.name)
-            if self._synchronous:
-                self._check_one(node, pods, spec)
-            else:
-                worker = threading.Thread(
-                    target=self._check_one, args=(node, pods, spec), daemon=True)
-                threads.append(worker)
-                worker.start()
+            worker = threading.Thread(
+                target=self._check_one, args=(node, pods, spec), daemon=True)
+            threads.append(worker)
+            worker.start()
         for t in threads:
             t.join()
 
     def _check_one(self, node: Node, pods: List[Pod],
-                   spec: WaitForCompletionSpec) -> None:
+                   spec: WaitForCompletionSpec, defer: bool = False) -> bool:
+        """Returns True when the node is ready to advance; with ``defer``
+        the caller performs the (batched) state write."""
         running = any(self.is_pod_running_or_pending(p) for p in pods)
         key = self._keys.wait_for_completion_start_annotation
         if running:
             if spec.timeout_second != 0:
                 self.handle_timeout_on_pod_completions(node, spec.timeout_second)
-            return
-        self._provider.change_node_upgrade_annotation(node, key, NULL)
-        self._provider.change_node_upgrade_state(
-            node, UpgradeState.POD_DELETION_REQUIRED)
+            return False
+        if not defer:
+            self._provider.change_node_state_and_annotations(
+                node, UpgradeState.POD_DELETION_REQUIRED, {key: NULL})
+        return True
 
     def handle_timeout_on_pod_completions(self, node: Node,
                                           timeout_seconds: int) -> None:
@@ -236,9 +248,8 @@ class PodManager:
             return
         start = int(node.metadata.annotations[key])
         if now > start + timeout_seconds:
-            self._provider.change_node_upgrade_state(
-                node, UpgradeState.POD_DELETION_REQUIRED)
-            self._provider.change_node_upgrade_annotation(node, key, NULL)
+            self._provider.change_node_state_and_annotations(
+                node, UpgradeState.POD_DELETION_REQUIRED, {key: NULL})
 
     @staticmethod
     def is_pod_running_or_pending(pod: Pod) -> bool:

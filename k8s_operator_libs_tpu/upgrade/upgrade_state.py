@@ -49,7 +49,7 @@ from .groups import (
 from .node_state_provider import NULL, NodeUpgradeStateProvider
 from .pod_manager import PodDeletionFilter, PodManager, PodManagerConfig
 from .safe_driver_load_manager import SafeDriverLoadManager
-from .util import KeyFactory
+from .util import KeyFactory, log_event
 from .validation_manager import ValidationManager
 
 logger = logging.getLogger(__name__)
@@ -247,6 +247,9 @@ class ClusterUpgradeStateManager:
         """ProcessDoneOrUnknownNodes (:488-550): decide upgrade-required vs
         done per node, from pod-vs-DS revision hash, the upgrade-requested
         annotation, or the safe-load handshake."""
+        require_plain: List[Node] = []
+        require_cordoned: List[Node] = []
+        to_done: List[Node] = []
         for ns in state.bucket(bucket_name):
             is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
             is_requested = self._is_upgrade_requested(ns.node)
@@ -254,16 +257,22 @@ class ClusterUpgradeStateManager:
                 self.safe_driver_load_manager.is_waiting_for_safe_driver_load(ns.node))
             if (not is_synced and not is_orphaned) or waiting_safe_load or is_requested:
                 # Remember pre-upgrade unschedulable state so uncordon can be
-                # skipped at the end (:512-523).
+                # skipped at the end (:512-523); batched with the state label
+                # into one patch + one cache barrier.
                 if ns.node.spec.unschedulable:
-                    self.node_upgrade_state_provider.change_node_upgrade_annotation(
-                        ns.node, self.keys.initial_state_annotation, TRUE_STRING)
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.UPGRADE_REQUIRED)
+                    require_cordoned.append(ns.node)
+                else:
+                    require_plain.append(ns.node)
                 continue
             if bucket_name == UpgradeState.UNKNOWN:
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.DONE)
+                to_done.append(ns.node)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            require_plain, UpgradeState.UPGRADE_REQUIRED)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            require_cordoned, UpgradeState.UPGRADE_REQUIRED,
+            {self.keys.initial_state_annotation: TRUE_STRING})
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            to_done, UpgradeState.DONE)
 
     def process_upgrade_required_nodes(self, state: ClusterUpgradeState,
                                        upgrades_available: int,
@@ -303,6 +312,22 @@ class ClusterUpgradeStateManager:
             # group for this pass.
             if group.any_in((UpgradeState.UNKNOWN,)):
                 continue
+            # Slice completeness (SURVEY §7.4): when the grouper knows the
+            # group's true size from topology metadata, refuse to admit a
+            # partial view — the unseen hosts would be restarted later,
+            # breaking atomicity. The group stays in upgrade-required until
+            # every host is visible.
+            expected = self.grouper.expected_group_size(ns.node)
+            if expected is not None and group.size != expected:
+                logger.warning(
+                    "group %s: observed %d member nodes but topology implies "
+                    "%d hosts — refusing to admit a partial slice view",
+                    group.key, group.size, expected)
+                log_event(
+                    self.recorder, ns.node, "Warning", self.keys.event_reason,
+                    f"Refusing to start upgrade of group {group.key}: only "
+                    f"{group.size} of {expected} member hosts are visible")
+                continue
             members = [m for m, s in zip(group.members, group.member_states)
                        if s == UpgradeState.UPGRADE_REQUIRED]
             if not members:
@@ -329,18 +354,19 @@ class ClusterUpgradeStateManager:
                 admit = (not admitted_this_pass and in_progress == 0
                          and unavailable - cordoned == 0)
             if admit:
-                for m in members:
-                    self.node_upgrade_state_provider.change_node_upgrade_state(
-                        m.node, UpgradeState.CORDON_REQUIRED)
+                self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+                    [m.node for m in members], UpgradeState.CORDON_REQUIRED)
                 upgrades_available -= len(members)
                 admitted_this_pass = True
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """ProcessCordonRequiredNodes (:635-654)."""
+        cordoned: List[Node] = []
         for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
             self.cordon_manager.cordon(ns.node)
-            self.node_upgrade_state_provider.change_node_upgrade_state(
-                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+            cordoned.append(ns.node)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            cordoned, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
 
     def process_wait_for_jobs_required_nodes(
             self, state: ClusterUpgradeState,
@@ -351,9 +377,8 @@ class ClusterUpgradeStateManager:
             next_state = (UpgradeState.POD_DELETION_REQUIRED
                           if self._pod_deletion_enabled
                           else UpgradeState.DRAIN_REQUIRED)
-            for ns in bucket:
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, next_state)
+            self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+                [ns.node for ns in bucket], next_state)
             return
         if not bucket:
             return
@@ -366,9 +391,8 @@ class ClusterUpgradeStateManager:
         """ProcessPodDeletionRequiredNodes (:698-727)."""
         bucket = state.bucket(UpgradeState.POD_DELETION_REQUIRED)
         if not self._pod_deletion_enabled:
-            for ns in bucket:
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.DRAIN_REQUIRED)
+            self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+                [ns.node for ns in bucket], UpgradeState.DRAIN_REQUIRED)
             return
         if not bucket:
             return
@@ -383,9 +407,8 @@ class ClusterUpgradeStateManager:
         restart, not before drain (all members are already cordoned)."""
         bucket = state.bucket(UpgradeState.DRAIN_REQUIRED)
         if drain_spec is None or not drain_spec.enable:
-            for ns in bucket:
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.POD_RESTART_REQUIRED)
+            self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+                [ns.node for ns in bucket], UpgradeState.POD_RESTART_REQUIRED)
             return
         if not bucket:
             return
@@ -399,6 +422,8 @@ class ClusterUpgradeStateManager:
         drained (at or past pod-restart-required) — the new libtpu must come
         up against a quiesced ICI domain."""
         pods_to_restart: List[Pod] = []
+        to_validation: List[Node] = []
+        to_uncordon: List[Node] = []
         for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
             if self.group_policy.atomic:
                 group = groups[self.grouper.group_key(ns.node)]
@@ -417,10 +442,9 @@ class ClusterUpgradeStateManager:
             self.safe_driver_load_manager.unblock_loading(ns.node)
             if self._is_driver_pod_in_sync(ns):
                 if not self._validation_enabled:
-                    self._update_node_to_uncordon_or_done_state(ns.node)
+                    to_uncordon.append(ns.node)
                     continue
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.VALIDATION_REQUIRED)
+                to_validation.append(ns.node)
             else:
                 if not self._is_driver_pod_failing(ns.driver_pod):
                     continue  # still coming up; check next reconcile
@@ -428,6 +452,9 @@ class ClusterUpgradeStateManager:
                             ns.node.metadata.name)
                 self.node_upgrade_state_provider.change_node_upgrade_state(
                     ns.node, UpgradeState.FAILED)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            to_validation, UpgradeState.VALIDATION_REQUIRED)
+        self._update_nodes_to_uncordon_or_done_state(to_uncordon)
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
@@ -452,6 +479,7 @@ class ClusterUpgradeStateManager:
                                         groups: Dict[str, GroupView]) -> None:
         """ProcessUncordonRequiredNodes (:915-934) with the group uncordon
         barrier: an atomic group returns to service as a unit."""
+        uncordoned: List[Node] = []
         for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
             if self.group_policy.atomic:
                 group = groups[self.grouper.group_key(ns.node)]
@@ -461,8 +489,9 @@ class ClusterUpgradeStateManager:
                         ns.node.metadata.name, group.key)
                     continue
             self.cordon_manager.uncordon(ns.node)
-            self.node_upgrade_state_provider.change_node_upgrade_state(
-                ns.node, UpgradeState.DONE)
+            uncordoned.append(ns.node)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            uncordoned, UpgradeState.DONE)
 
     # ------------------------------------------------------------- helpers
 
@@ -510,10 +539,20 @@ class ClusterUpgradeStateManager:
         key = self.keys.initial_state_annotation
         if key in node.metadata.annotations:
             new_state = UpgradeState.DONE
-        self.node_upgrade_state_provider.change_node_upgrade_state(node, new_state)
-        if new_state == UpgradeState.DONE:
-            self.node_upgrade_state_provider.change_node_upgrade_annotation(
-                node, key, NULL)
+        self.node_upgrade_state_provider.change_node_state_and_annotations(
+            node, new_state,
+            {key: NULL} if new_state == UpgradeState.DONE else None)
+
+    def _update_nodes_to_uncordon_or_done_state(self, nodes: List[Node]) -> None:
+        """Batched :meth:`_update_node_to_uncordon_or_done_state`: splits by
+        the initial-state annotation, one patch-all + barrier per split."""
+        key = self.keys.initial_state_annotation
+        to_uncordon = [n for n in nodes if key not in n.metadata.annotations]
+        to_done = [n for n in nodes if key in n.metadata.annotations]
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            to_uncordon, UpgradeState.UNCORDON_REQUIRED)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            to_done, UpgradeState.DONE, {key: NULL})
 
     # ------------------------------------------------------------- counters
 

@@ -76,7 +76,7 @@ class ValidationManager:
             return
         start = int(node.metadata.annotations[key])
         if now > start + self._timeout:
-            self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
+            self._provider.change_node_state_and_annotations(
+                node, UpgradeState.FAILED, {key: NULL})
             log_event(self._recorder, node, "Warning", self._keys.event_reason,
                       "Validation timed out; node moved to upgrade-failed")
-            self._provider.change_node_upgrade_annotation(node, key, NULL)

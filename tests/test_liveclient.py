@@ -455,7 +455,7 @@ def test_slice_scheduler_places_over_live_http(live):
     assert len(pods) == 4
     env = {p.metadata.name: p.spec.env for p in pods}
     assert env["live-job-2"]["TPU_WORKER_ID"] == "2"
-    assert env["live-job-0"]["JAX_COORDINATOR_ADDRESS"] == "live-job-0:8476"
+    assert env["live-job-0"]["JAX_COORDINATOR_ADDRESS"] == "live-job-0.live-job:8476"
     assert all(p.spec.resource_requests.get("google.com/tpu") == 4
                for p in pods)
 
@@ -525,3 +525,56 @@ def test_serde_roundtrips_preserve_fields():
         serde.controller_revision_to_json(cr))
     assert c2.revision == 7
     assert c2.metadata.labels["controller-revision-hash"] == "v9"
+
+
+# ------------------------------------------------------ kubeconfig parsing
+
+
+def _write_kubeconfig(tmp_path, user, name="u"):
+    cfg = {
+        "current-context": "c",
+        "contexts": [{"name": "c", "context": {"cluster": "cl", "user": name}}],
+        "clusters": [{"name": "cl",
+                      "cluster": {"server": "https://example:6443"}}],
+        "users": [{"name": name, "user": user}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_kubeconfig_token_user(tmp_path):
+    kc = KubeConfig.from_kubeconfig(
+        _write_kubeconfig(tmp_path, {"token": "sekret"}))
+    assert kc.token == "sekret"
+    assert kc.server == "https://example:6443"
+
+
+def test_kubeconfig_exec_plugin_token(tmp_path):
+    """GKE-style exec auth: the plugin's ExecCredential token is used."""
+    import json as _json
+    import os as _os
+    import stat
+    plugin = tmp_path / "fake-auth-plugin"
+    plugin.write_text(
+        "#!/bin/sh\n"
+        'echo \'{"apiVersion": "client.authentication.k8s.io/v1beta1",'
+        '"kind": "ExecCredential", "status": {"token": "exec-token-123"}}\'\n')
+    plugin.chmod(plugin.stat().st_mode | stat.S_IEXEC)
+    kc = KubeConfig.from_kubeconfig(_write_kubeconfig(tmp_path, {
+        "exec": {"apiVersion": "client.authentication.k8s.io/v1beta1",
+                 "command": str(plugin), "args": [], "env": []}}))
+    assert kc.token == "exec-token-123"
+
+
+def test_kubeconfig_no_credentials_fails_fast(tmp_path):
+    """A credential-less user must fail at load time with a clear message,
+    not later with an opaque 401 (ADVICE r1)."""
+    with pytest.raises(RuntimeError, match="no usable credentials"):
+        KubeConfig.from_kubeconfig(_write_kubeconfig(tmp_path, {}))
+
+
+def test_kubeconfig_missing_exec_plugin_fails_clearly(tmp_path):
+    with pytest.raises(RuntimeError, match="not found on PATH"):
+        KubeConfig.from_kubeconfig(_write_kubeconfig(tmp_path, {
+            "exec": {"command": "/nonexistent/gke-gcloud-auth-plugin"}}))

@@ -87,7 +87,7 @@ def test_outdated_pod_marks_upgrade_required_in_memory(mocked, keys):
     mgr.process_done_or_unknown_nodes(st, UpgradeState.UNKNOWN)
     # the mock provider mutated the label in memory only
     assert node.metadata.labels[keys.state_label] == UpgradeState.UPGRADE_REQUIRED
-    assert provider.calls_to("change_node_upgrade_state")
+    assert provider.calls_to("change_nodes_state_and_annotations")
 
 
 def test_cordon_failure_propagates(mocked, keys):

@@ -96,6 +96,54 @@ def test_validate_slice_membership_rejects_partial_view(cluster):
     assert infos["pool-a"].num_hosts == 4
 
 
+def test_partial_slice_view_not_admitted(cluster, keys, clock):
+    """Slice-completeness at admission (SURVEY §7.4): a 4-host slice observed
+    as 3 hosts (one host's driver pod not scheduled yet) must never leave
+    upgrade-required; once the 4th host becomes visible the slice is admitted
+    and converges."""
+    ds = cluster.add_daemonset("tpu-device-plugin", namespace=NS,
+                               labels=DRIVER_LABELS, revision_hash="v1")
+    hosts = setup_slice(cluster, "pool-a", 3, ds)  # topology 4x4 → 4 hosts
+    missing = "pool-a-host3"
+    cluster.add_node(missing, labels=tpu_labels("pool-a"))
+    cluster.bump_daemonset_revision("tpu-device-plugin", NS, "v2")
+
+    mgr = ClusterUpgradeStateManager(
+        cluster.client, keys, cluster.recorder, clock,
+        grouper=TPUSliceGrouper(), synchronous=True)
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+
+    def states():
+        return {h: cluster.client.direct().get_node(h).metadata.labels.get(
+            keys.state_label, "") for h in hosts}
+
+    for _ in range(5):
+        state = mgr.build_state(NS, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        cluster.reconcile_daemonsets()
+    assert all(s == UpgradeState.UPGRADE_REQUIRED for s in states().values()), \
+        f"partial slice must hold at upgrade-required: {states()}"
+    assert not any(cluster.client.direct().get_node(h).spec.unschedulable
+                   for h in hosts)
+
+    # the 4th host's plugin pod appears → slice is complete → admitted
+    cluster.add_pod(f"plugin-{missing}", missing, namespace=NS, owner_ds=ds,
+                    revision_hash="v1")
+    for _ in range(60):
+        state = mgr.build_state(NS, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        cluster.reconcile_daemonsets()
+        full = {**states(),
+                missing: cluster.client.direct().get_node(
+                    missing).metadata.labels.get(keys.state_label, "")}
+        if all(s == UpgradeState.DONE for s in full.values()):
+            break
+    else:
+        raise AssertionError(f"slice never converged after completion: {states()}")
+
+
 # ------------------------------------------------- slice-atomic upgrade walk
 
 
