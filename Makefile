@@ -19,8 +19,9 @@ test:
 test-fast:  ## operator-library tests only (skips slow JAX compiles)
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_jax_stack.py
 
-lint:  ## syntax + import sanity over the package (no third-party linters in image)
-	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd bench.py __graft_entry__.py
+lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/B006/E722/F541/F601/F631/F602/W605) + import sanity
+	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py
 	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
 	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \

@@ -168,13 +168,25 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=3e-4)
     args = p.parse_args(argv)
 
+    # under an operator placement, join the multi-host/multislice
+    # jax.distributed cluster described by the pod env BEFORE any backend
+    # use; single-host runs no-op (parallel/distributed.py)
+    from k8s_operator_libs_tpu.parallel.distributed import (
+        maybe_initialize_from_env)
+    maybe_initialize_from_env()
+
     import jax
     import jax.numpy as jnp
 
     from k8s_operator_libs_tpu.data import TokenDataset
     from k8s_operator_libs_tpu.models.llama import LlamaConfig
     from k8s_operator_libs_tpu.parallel.fsdp import default_optimizer
-    from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
+    from k8s_operator_libs_tpu.train.harness import (
+        CheckpointingTrainer, enable_compilation_cache)
+
+    # resumed-after-upgrade processes skip XLA recompilation via the
+    # persistent cache (train/harness.py:enable_compilation_cache)
+    enable_compilation_cache()
 
     cfg = {"tiny": LlamaConfig.tiny, "small": LlamaConfig.small,
            "llama3_8b": LlamaConfig.llama3_8b}.get(args.model)

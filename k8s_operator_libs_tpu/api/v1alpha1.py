@@ -10,7 +10,7 @@ CRD spec (reference docs/automatic-ofed-upgrade.md:11-39); ``from_dict`` /
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Union
 
 IntOrStr = Union[int, str]

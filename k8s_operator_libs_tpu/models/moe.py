@@ -26,8 +26,6 @@ why they share it.
 from __future__ import annotations
 
 import dataclasses
-import math
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax

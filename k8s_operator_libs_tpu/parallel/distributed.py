@@ -1,0 +1,72 @@
+"""Multi-host / multislice JAX initialization from the operator's pod env.
+
+The SliceScheduler places one pod per slice host and injects the JAX
+distributed-init environment (tpu/scheduler.py): ``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES``, ``JAX_COORDINATOR_ADDRESS`` (a DNS name backed by
+the workload's headless Service), and for multislice jobs the ``MEGASCALE_*``
+variables the XLA multislice runtime reads directly. This module is the
+consuming end: call :func:`maybe_initialize_from_env` first thing in the
+workload binary (cmd/train.py does) and the process joins its jax.distributed
+cluster — or no-ops on a single host, so the same entrypoint runs everywhere.
+
+The reference has no analog (its workloads are opaque pods); this is the
+TPU-native glue BASELINE config 5 needs: the operator's placement env and the
+JAX runtime agree on who coordinates, over ICI within a slice and DCN across
+slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def cluster_env(environ=None) -> Optional[dict]:
+    """Parse the scheduler-injected env into jax.distributed.initialize
+    kwargs; None when not running under an operator placement (or on a
+    single-host slice, where distributed init is unnecessary)."""
+    env = os.environ if environ is None else environ
+    hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                 if h]
+    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1"))
+    num_hosts = len(hostnames)
+    # distributed init is needed when the JOB spans >1 process — including
+    # a multislice job whose slices are single-host (1 host x N slices)
+    if num_hosts * num_slices < 2:
+        return None
+    worker_id = env.get("TPU_WORKER_ID")
+    coordinator = env.get("JAX_COORDINATOR_ADDRESS")
+    if worker_id is None or not coordinator:
+        return None
+    process_id = int(worker_id)
+    if num_slices > 1:
+        # multislice: process ids are globally unique = slice_id * hosts
+        # + worker_id; the MEGASCALE_* env itself is consumed by the XLA
+        # runtime, not by us
+        process_id += int(env.get("MEGASCALE_SLICE_ID", "0")) * num_hosts
+    return {
+        "coordinator_address": coordinator,
+        "num_processes": num_hosts * num_slices,
+        "process_id": process_id,
+    }
+
+
+def maybe_initialize_from_env(environ=None, _initialize=None) -> bool:
+    """Join the jax.distributed cluster described by the pod env; returns
+    True when initialization ran. Safe to call unconditionally — single-host
+    runs (no/short TPU_WORKER_HOSTNAMES) return False without touching jax.
+
+    ``_initialize`` is a test seam; defaults to jax.distributed.initialize.
+    """
+    kwargs = cluster_env(environ)
+    if kwargs is None:
+        return False
+    if _initialize is None:
+        import jax
+        _initialize = jax.distributed.initialize
+    logger.info("joining jax.distributed cluster: %s", kwargs)
+    _initialize(**kwargs)
+    return True

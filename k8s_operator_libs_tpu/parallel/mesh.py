@@ -21,7 +21,7 @@ fastest-varying (nearest-neighbor) dimension.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import numpy as np

@@ -15,9 +15,13 @@ see :func:`gpipe_schedule`), all inside ``shard_map``; jax autodiff
 transposes the scans into the backward pipeline (reverse ppermute)
 automatically — no hand-written backward schedule.
 
-Embedding/lm_head/norms are replicated across stages in this r1 design
-(stage 0 embeds, stage S-1 projects + computes the masked loss; the psum in
-the loss and shard_map's transpose give every stage its correct grads).
+Embed/lm_head are VOCAB-SHARDED over "stage" (each stage stores V/S rows —
+the two largest tensors at Llama-3 vocab scale are never replicated):
+each tick's microbatch embedding assembles full rows with one [Bm, T, D]
+psum (live footprint stays per-microbatch), and the loss is a distributed
+cross-entropy (pmax/psum logsumexp + psum'd target logit, back-ported from
+:mod:`.composed`) over the per-stage logit shards — the full-vocab
+``[*, V]`` logits array never materializes. Norms are replicated (tiny).
 
 Bubble fraction is (S-1)/(S-1+M): choose M ≥ 4·S for >80% utilization.
 """
@@ -118,15 +122,16 @@ def gpipe_schedule(S: int, M: int, stage_index, inputs, targets,
 
 def pp_param_specs(params) -> Dict:
     """PartitionSpecs for pipeline parallelism: block stacks sharded over
-    "stage" on the layer axis; everything else replicated (combine with
-    fsdp/tensor specs on other axes for 3-D parallelism in later rounds)."""
+    "stage" on the layer axis; embed/lm_head vocab-sharded over "stage"
+    (combine with fsdp/tensor specs on other axes for 3-D parallelism —
+    see :mod:`.composed`)."""
     blocks = {k: P(AXIS) if v.ndim == 2 else P(AXIS, None, None)
               for k, v in params["blocks"].items()}
     return {
-        "embed": P(None, None),
+        "embed": P(AXIS, None),     # [V, D] vocab axis over stages
         "blocks": blocks,
         "final_norm": P(None),
-        "lm_head": P(None, None),
+        "lm_head": P(None, AXIS),   # [D, V] vocab axis over stages
     }
 
 
@@ -139,6 +144,9 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
     if cfg.n_layers % S:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
                          f"{S} stages")
+    if cfg.vocab_size % S:
+        raise ValueError(f"vocab_size {cfg.vocab_size} not divisible by "
+                         f"{S} stages (embed/lm_head are vocab-sharded)")
 
     def stage_apply(blocks_local, x, positions):
         """Run this stage's local layers over activation x [Bm, T, D]."""
@@ -153,32 +161,73 @@ def make_pp_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
         return x
 
     def shard_loss(params, inputs, targets):
-        # replicated inputs [B, T]; every stage sees the full batch and
-        # selects microbatches by index
+        # replicated token inputs [B, T]; every stage sees the full batch
+        # and selects microbatches by index
+        s = jax.lax.axis_index(AXIS)
         B, T = inputs.shape
         Bm = B // M
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
 
-        def project_nll(y, mb_t):
-            h = rms_norm(y, params["final_norm"])
-            logits = (h @ params["lm_head"]).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            return -jnp.take_along_axis(logp, mb_t[..., None],
-                                        axis=-1)[..., 0]
+        # embed/lm_head are vocab-sharded over "stage": [V/S, D] / [D, V/S]
+        embed = params["embed"]
+        lm_head = params["lm_head"]
+        v_local = embed.shape[0]
+        v_start = s * v_local
+
+        def local_idx_and_owned(tok):
+            idx = tok - v_start
+            owned = jnp.logical_and(idx >= 0, idx < v_local)
+            return jnp.clip(idx, 0, v_local - 1), owned
+
+        def embed_mb(mb):
+            # per-tick distributed lookup: every stage contributes its owned
+            # rows of THIS microbatch and one [Bm, T, D] psum assembles them
+            # (all stages execute it — gpipe_schedule's jnp.where keeps the
+            # result on stage 0 only, but the collective is symmetric).
+            # Embedding per microbatch keeps the live footprint at
+            # [Bm, T, D]; pre-embedding the whole batch would hold M x that
+            # plus a full-batch all-reduce.
+            idx, owned = local_idx_and_owned(mb)
+            return jax.lax.psum(
+                jnp.where(owned[..., None], embed[idx], 0), AXIS)
+
+        def project_nll(win, mb_t):
+            """Distributed CE over the vocab-sharded lm_head (back-ported
+            from composed.py). The stacked window exists only on the last
+            stage — broadcast it, then every stage computes its [.., V/S]
+            logit shard; lse and the target logit assemble via psum, so the
+            full-vocab logits array never exists."""
+            win = jax.lax.psum(
+                jnp.where(s == S - 1, win, jnp.zeros_like(win)), AXIS)
+            h = rms_norm(win, params["final_norm"])
+            logits_l = (h @ lm_head).astype(jnp.float32)   # [B', T, V/S]
+            m = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits_l, axis=-1)), AXIS)
+            se = jax.lax.psum(
+                jnp.sum(jnp.exp(logits_l - m[..., None]), axis=-1), AXIS)
+            lse = m + jnp.log(se)
+            t_idx, t_owned = local_idx_and_owned(mb_t)
+            tl = jnp.take_along_axis(logits_l, t_idx[..., None],
+                                     axis=-1)[..., 0]
+            target_logit = jax.lax.psum(jnp.where(t_owned, tl, 0.0), AXIS)
+            return lse - target_logit
 
         total, count = gpipe_schedule(
-            S, M, jax.lax.axis_index(AXIS), inputs, targets,
-            embed_mb=lambda mb: params["embed"][mb],
+            S, M, s, inputs, targets,
+            embed_mb=embed_mb,
             stage_apply=lambda x: stage_apply(params["blocks"], x, positions),
             project_nll=project_nll,
-            init_x=jnp.zeros((Bm, T, cfg.d_model), params["embed"].dtype))
+            init_x=jnp.zeros((Bm, T, cfg.d_model), embed.dtype))
+        # project_nll's psums make nll identical on every stage, and
+        # gpipe_schedule masks the total to the last stage before its psum —
+        # so total/count is the plain mean over all B*T positions
         return total / count
 
     block_spec = {k: (P(AXIS) if k.endswith("norm") else P(AXIS, None, None))
                   for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
                             "w_gate", "w_up", "w_down")}
-    param_spec = {"embed": P(None, None), "blocks": block_spec,
-                  "final_norm": P(None), "lm_head": P(None, None)}
+    param_spec = {"embed": P(AXIS, None), "blocks": block_spec,
+                  "final_norm": P(None), "lm_head": P(None, AXIS)}
     sharded = jax.shard_map(
         shard_loss, mesh=mesh,
         in_specs=(param_spec, P(None, None), P(None, None)),

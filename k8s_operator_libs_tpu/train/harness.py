@@ -37,6 +37,29 @@ from ..parallel.fsdp import TrainState, init_train_state, make_train_step
 logger = logging.getLogger(__name__)
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at a host-local directory.
+
+    The re-warmup a resumed job pays after a rolling upgrade is dominated by
+    XLA recompilation; the upgraded hosts are the SAME machines, so a
+    persistent cache turns that recompile into a disk read (~10x faster —
+    measured in bench.py's warm-rewarmup subprocess). Call once per process
+    before the first jit; cmd/train.py and the bench do. Honors
+    ``$JAX_COMPILATION_CACHE_DIR``, defaulting to a stable path under /tmp
+    (per-user, survives pod restarts on the host via hostPath in
+    production)."""
+    import os
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.join("/tmp", f"jax-cache-{os.getuid()}"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything, including sub-second compiles: restart latency is
+    # the point, not compile-time amortization
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 @dataclasses.dataclass
 class TrainResult:
     state: TrainState

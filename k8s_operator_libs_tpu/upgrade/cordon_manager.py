@@ -7,7 +7,6 @@ reference delegates to k8s.io/kubectl/pkg/drain (:39-48).
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 from ..core.client import Client
 from ..core.drain import Helper

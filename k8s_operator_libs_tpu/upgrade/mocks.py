@@ -16,7 +16,6 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.objects import Node
-from . import consts
 from .util import KeyFactory
 
 

@@ -1,6 +1,5 @@
 """Input pipeline (native + fallback) and KV-cache generation tests."""
 
-import os
 
 import numpy as np
 import pytest

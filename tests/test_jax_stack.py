@@ -12,11 +12,9 @@ from k8s_operator_libs_tpu.models.llama import (
     LlamaConfig,
     forward,
     init_params,
-    param_count,
 )
 from k8s_operator_libs_tpu.ops.attention import reference_attention
 from k8s_operator_libs_tpu.parallel.fsdp import (
-    causal_lm_loss,
     init_train_state,
     make_train_step,
 )

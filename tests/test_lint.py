@@ -1,0 +1,136 @@
+"""tools/lint.py — the stdlib AST linter standing in for the reference's
+golangci-lint gate (reference .golangci.yaml, Makefile lint target). Each
+check must fire on a minimal offender and stay silent on the clean idioms
+this repo actually uses."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint  # noqa: E402
+
+
+def run_lint(tmp_path, source):
+    f = tmp_path / "case.py"
+    f.write_text(source)
+    return lint.lint_file(f)
+
+
+def codes(findings):
+    return [f.split(": ")[1].split(" ")[0] for f in findings]
+
+
+def test_undefined_name(tmp_path):
+    assert codes(run_lint(tmp_path, "def f():\n    return undefined_thing\n")) \
+        == ["F821"]
+
+
+def test_scope_resolution_no_false_positives(tmp_path):
+    src = '''
+import os
+from typing import Optional
+
+GLOBAL = 1
+
+class C:
+    attr = GLOBAL
+
+    def method(self, x: Optional[int] = None) -> "C":
+        y = os.getcwd()
+        return [y for y in (1, 2) if y]
+
+def outer():
+    z = 1
+    def inner():
+        return z
+    return inner
+
+lam = lambda a: a + GLOBAL
+'''
+    assert run_lint(tmp_path, src) == []
+
+
+def test_unused_import_and_future_exemption(tmp_path):
+    src = "from __future__ import annotations\nimport json\n"
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["F401"] and "json" in found[0]
+
+
+def test_submodule_imports_not_shadowing(tmp_path):
+    src = ("import urllib.error\nimport urllib.request\n"
+           "print(urllib.request, urllib.error)\n")
+    assert run_lint(tmp_path, src) == []
+
+
+def test_annotation_only_use_counts(tmp_path):
+    src = ("from typing import Optional\n"
+           "def f(x: Optional[int]) -> None:\n    return x\n")
+    assert run_lint(tmp_path, src) == []
+
+
+def test_quoted_forward_ref_counts_as_use(tmp_path):
+    src = ("from typing import List\n"
+           "from collections import OrderedDict\n"
+           "def f(x: List[\"OrderedDict\"]):\n    return x\n")
+    assert run_lint(tmp_path, src) == []
+
+
+def test_mutable_default(tmp_path):
+    assert codes(run_lint(tmp_path, "def f(a=[]):\n    return a\n")) == ["B006"]
+
+
+def test_bare_except(tmp_path):
+    src = "try:\n    pass\nexcept:\n    pass\n"
+    assert codes(run_lint(tmp_path, src)) == ["E722"]
+
+
+def test_fstring_without_placeholder(tmp_path):
+    assert codes(run_lint(tmp_path, 'x = f"static"\n')) == ["F541"]
+
+
+def test_format_spec_not_flagged(tmp_path):
+    assert run_lint(tmp_path, 'i = 3\nx = f"n-{i:02d}"\n') == []
+
+
+def test_none_comparison(tmp_path):
+    assert codes(run_lint(tmp_path, "x = 1\ny = x == None\n")) == ["F601"]
+
+
+def test_assert_tuple(tmp_path):
+    assert codes(run_lint(tmp_path, 'assert (1, "msg")\n')) == ["F631"]
+
+
+def test_duplicate_dict_key(tmp_path):
+    assert codes(run_lint(tmp_path, 'd = {"a": 1, "a": 2}\n')) == ["F602"]
+
+
+def test_syntax_error_reported(tmp_path):
+    assert codes(run_lint(tmp_path, "def f(:\n")) == ["E999"]
+
+
+def test_noqa_and_ignore_suppress(tmp_path):
+    src = "import json  # noqa\nimport os  # lint: ignore\n"
+    assert run_lint(tmp_path, src) == []
+
+
+def test_repo_is_clean():
+    """The gate itself: the whole repo lints clean."""
+    out = subprocess.run([sys.executable, str(REPO / "tools" / "lint.py")],
+                         cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_try_import_fallback_not_shadowing(tmp_path):
+    src = ("try:\n    import ujson as json\n"
+           "except ImportError:\n    import json\n"
+           "print(json)\n")
+    assert run_lint(tmp_path, src) == []
+
+
+def test_global_declared_names_trusted(tmp_path):
+    src = ("def f():\n    global registry\n    registry = 1\n"
+           "def g():\n    return registry\n")
+    assert run_lint(tmp_path, src) == []

@@ -4,7 +4,7 @@ managers mocked, state provider mutating labels in memory)."""
 
 import pytest
 
-from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.upgrade.mocks import (
     MockCordonManager,

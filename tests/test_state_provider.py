@@ -10,7 +10,6 @@ from k8s_operator_libs_tpu.upgrade.node_state_provider import (
     CacheSyncTimeoutError,
     NodeUpgradeStateProvider,
 )
-from k8s_operator_libs_tpu.upgrade.util import KeyFactory
 from k8s_operator_libs_tpu.utils.clock import FakeClock
 
 

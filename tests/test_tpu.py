@@ -367,3 +367,48 @@ def test_partial_slice_failure_recovers_as_a_unit(cluster, keys, clock):
     assert all(s == UpgradeState.DONE for s in states().values()), states()
     assert all(not cluster.client.direct().get_node(h).spec.unschedulable
                for h in hosts)
+
+
+# ------------------------------------------- scheduler env -> jax.distributed
+
+
+def test_distributed_init_consumes_scheduler_env(cluster):
+    """The env the SliceScheduler injects must parse into a valid
+    jax.distributed.initialize call — the two ends of the placement
+    contract stay in sync (parallel/distributed.py)."""
+    from k8s_operator_libs_tpu.parallel.distributed import (
+        cluster_env, maybe_initialize_from_env)
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler
+
+    for pool in ("pool-a", "pool-b"):
+        for i in range(4):
+            cluster.add_node(f"{pool}-h{i}", labels=tpu_labels(pool))
+    sched = SliceScheduler(cluster.client)
+    wl = TPUWorkload(name="ms", accelerator="tpu-v5-lite-podslice",
+                     topology="4x4", num_slices=2)
+    assert sched.place(wl) is not None
+    pods = {p.metadata.name: p
+            for p in cluster.client.direct().list_pods(namespace="default")}
+
+    calls = []
+    # slice 1, worker 3 -> globally unique process id 1*4 + 3 = 7
+    env = pods["ms-1-3"].spec.env
+    assert maybe_initialize_from_env(env, _initialize=lambda **kw:
+                                     calls.append(kw))
+    assert calls == [{
+        "coordinator_address": "ms-0-0.ms:8476",
+        "num_processes": 8,
+        "process_id": 7,
+    }]
+    # single-slice worker 0 coordinates
+    sched2 = SliceScheduler(cluster.client)
+    # (pods of ms occupy both pools; parse a synthetic single-slice env)
+    single = {"TPU_WORKER_ID": "0",
+              "TPU_WORKER_HOSTNAMES": "j-0.j,j-1.j,j-2.j,j-3.j",
+              "JAX_COORDINATOR_ADDRESS": "j-0.j:8476"}
+    assert cluster_env(single) == {"coordinator_address": "j-0.j:8476",
+                                   "num_processes": 4, "process_id": 0}
+    # no placement env / single-host slice -> no-op
+    assert cluster_env({}) is None
+    assert not maybe_initialize_from_env(
+        {"TPU_WORKER_HOSTNAMES": "solo"}, _initialize=lambda **kw: 1 / 0)

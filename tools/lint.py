@@ -1,0 +1,492 @@
+#!/usr/bin/env python3
+"""Static analysis over the repo, stdlib-only.
+
+The reference gates CI on golangci-lint with ~50 linters
+(/root/reference/.golangci.yaml, Makefile lint target); this image carries no
+Python linter (no ruff/pyflakes/pylint) and installing one is off-limits, so
+this is a from-scratch `ast`-based checker covering the highest-value subset
+of that surface:
+
+  F821  undefined name (scope-aware: modules, classes, functions,
+        comprehensions, global/nonlocal, builtins)
+  F401  unused import (module scope; `as _`, __init__ re-exports and
+        __all__ entries exempt)
+  F811  import shadowed by another import of the same name
+  B006  mutable default argument (list/dict/set literal)
+  E722  bare `except:`
+  F541  f-string without any placeholders
+  F601  `== None` / `!= None` comparison (use `is`)
+  F631  assert on a non-empty tuple literal (always true)
+  F602  duplicate literal key in a dict display
+  W605  invalid escape sequence in a plain (non-raw) string literal
+
+Usage: python tools/lint.py [paths...]   (default: package + cmd + tests +
+bench.py + __graft_entry__.py). Exit 1 on any finding. A finding can be
+suppressed by appending  `# lint: ignore`  to its line.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+DEFAULT_TARGETS = ["k8s_operator_libs_tpu", "cmd", "tools", "tests",
+                   "bench.py", "__graft_entry__.py"]
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+}
+
+
+class Finding(Tuple[str, int, str, str]):
+    pass
+
+
+def _is_string_annotation_context(node: ast.AST) -> bool:
+    return isinstance(node, (ast.AnnAssign, ast.arg))
+
+
+class Scope:
+    def __init__(self, kind: str, node: Optional[ast.AST],
+                 parent: Optional["Scope"]):
+        self.kind = kind          # module | function | class | comprehension
+        self.node = node
+        self.parent = parent
+        self.bindings: Set[str] = set()
+        self.globals: Set[str] = set()
+        self.nonlocals: Set[str] = set()
+        self.has_star_import = False
+        self.uses_exec = False
+
+    def chain_has_star_or_exec(self) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if s.has_star_import or s.uses_exec:
+                return True
+            s = s.parent
+        return False
+
+
+class Checker(ast.NodeVisitor):
+    """Two passes per scope: bind every name the scope defines, then resolve
+    loads against the lexical chain (class scopes are skipped for lookups
+    from nested functions, like Python itself does)."""
+
+    def __init__(self, path: str, tree: ast.Module, source_lines: List[str]):
+        self.path = path
+        self.lines = source_lines
+        self.findings: List[Tuple[int, str, str]] = []
+        self.module_scope = Scope("module", tree, None)
+        self.import_positions: Dict[str, Tuple[int, str]] = {}
+        self.import_uses: Set[str] = set()
+        self.is_init = path.endswith("__init__.py")
+        self.dunder_all: Set[str] = set()
+
+    # ---------------------------------------------------------- reporting
+
+    def report(self, lineno: int, code: str, msg: str) -> None:
+        if 0 < lineno <= len(self.lines):
+            line = self.lines[lineno - 1]
+            if "# lint: ignore" in line or "# noqa" in line:
+                return
+        self.findings.append((lineno, code, msg))
+
+    # ----------------------------------------------------------- binding
+
+    @staticmethod
+    def _target_names(target: ast.AST) -> List[str]:
+        out = []
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                out.append(n.id)
+        return out
+
+    def bind_scope(self, scope: Scope, body: List[ast.stmt]) -> None:
+        """Collect names bound anywhere in this scope (not nested scopes)."""
+        for stmt in body:
+            self._bind_stmt(scope, stmt)
+
+    def _bind_stmt(self, scope: Scope, node: ast.AST,
+                   in_try: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            scope.bindings.add(node.name)
+            return  # nested scope bodies handled separately
+        if isinstance(node, (ast.Lambda,)):
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                self._bind_import(scope, name, node.lineno,
+                                  alias.asname or alias.name,
+                                  in_try=in_try)
+            return
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                for alias in node.names:
+                    scope.bindings.add(alias.asname or alias.name)
+                return
+            for alias in node.names:
+                if alias.name == "*":
+                    scope.has_star_import = True
+                    continue
+                name = alias.asname or alias.name
+                self._bind_import(scope, name, node.lineno, name,
+                                  in_try=in_try)
+            return
+        if isinstance(node, ast.Global):
+            scope.globals.update(node.names)
+            return
+        if isinstance(node, ast.Nonlocal):
+            scope.nonlocals.update(node.names)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                scope.bindings.update(self._target_names(t))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            scope.bindings.update(self._target_names(node.target))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            scope.bindings.update(self._target_names(node.target))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    scope.bindings.update(
+                        self._target_names(item.optional_vars))
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                scope.bindings.add(node.name)
+        elif isinstance(node, (ast.Match,)):
+            for case in node.cases:
+                for n in ast.walk(case.pattern):
+                    if isinstance(n, (ast.MatchAs, ast.MatchStar)):
+                        if n.name:
+                            scope.bindings.add(n.name)
+                    elif isinstance(n, ast.MatchMapping) and n.rest:
+                        scope.bindings.add(n.rest)
+        elif isinstance(node, (ast.Expr,)) and isinstance(
+                node.value, ast.Call):
+            f = node.value.func
+            if isinstance(f, ast.Name) and f.id in ("exec", "eval"):
+                scope.uses_exec = True
+        elif isinstance(node, ast.Delete):
+            pass  # names stay "bound enough" for our purposes
+        # recurse into compound statements' bodies (same scope); imports
+        # under a Try are fallback patterns (try: import X / except:
+        # import Y) — exempt from F811 shadowing
+        child_in_try = in_try or isinstance(node, ast.Try)
+        for field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            for child in getattr(node, field, []) or []:
+                if isinstance(child, ast.AST):
+                    self._bind_stmt(scope, child, in_try=child_in_try)
+
+    def _bind_import(self, scope: Scope, name: str, lineno: int,
+                     full: str, in_try: bool = False) -> None:
+        if scope is self.module_scope:
+            if (name in self.import_positions and not in_try
+                    and name not in self.import_uses):
+                prev_line, prev_full = self.import_positions[name]
+                # `import urllib.error` + `import urllib.request` both bind
+                # "urllib" — submodule imports are complements, not shadows
+                if "." not in full and "." not in prev_full:
+                    self.report(lineno, "F811",
+                                f"import {name!r} shadows unused import on "
+                                f"line {prev_line}")
+            self.import_positions[name] = (lineno, full)
+        scope.bindings.add(name)
+
+    # ---------------------------------------------------------- resolving
+
+    def resolve(self, scope: Scope, name: str) -> bool:
+        if name in BUILTINS:
+            return True
+        s: Optional[Scope] = scope
+        first = True
+        while s is not None:
+            if name in s.globals:
+                # global-declared names are trusted: `global x; x = 1` in
+                # one function legitimately defines x for the whole module,
+                # and the binding pass cannot see that ordering
+                return True
+            if s.kind == "class" and not first:
+                s = s.parent  # class scope invisible to nested functions
+                first = False
+                continue
+            if name in s.bindings:
+                return True
+            first = False
+            s = s.parent
+        return False
+
+    # --------------------------------------------------------- scope walk
+
+    def check_scope(self, scope: Scope, body: List[ast.stmt],
+                    args: Optional[ast.arguments] = None) -> None:
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)
+                      + ([args.vararg] if args.vararg else [])
+                      + ([args.kwarg] if args.kwarg else [])):
+                scope.bindings.add(a.arg)
+        self.bind_scope(scope, body)
+        for stmt in body:
+            self._walk_expr_container(scope, stmt)
+
+    def _walk_expr_container(self, scope: Scope, node: ast.AST) -> None:
+        """Visit `node` attributing Name loads to `scope`, descending into
+        nested scopes with fresh Scope objects."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._check_defaults_and_decorators(scope, node)
+            sub = Scope("function", node, scope)
+            self.check_scope(sub, node.body, node.args)
+            return
+        if isinstance(node, ast.Lambda):
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                self._walk_expr_container(scope, d)
+            sub = Scope("function", node, scope)
+            sub_args = node.args
+            for a in (list(sub_args.posonlyargs) + list(sub_args.args)
+                      + list(sub_args.kwonlyargs)
+                      + ([sub_args.vararg] if sub_args.vararg else [])
+                      + ([sub_args.kwarg] if sub_args.kwarg else [])):
+                sub.bindings.add(a.arg)
+            self._walk_expr_container(sub, node.body)
+            return
+        if isinstance(node, ast.ClassDef):
+            for d in node.decorator_list + node.bases + [
+                    kw.value for kw in node.keywords]:
+                self._walk_expr_container(scope, d)
+            sub = Scope("class", node, scope)
+            self.check_scope(sub, node.body)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            sub = Scope("comprehension", node, scope)
+            # first iterable evaluates in the ENCLOSING scope
+            gens = node.generators
+            self._walk_expr_container(scope, gens[0].iter)
+            for g in gens:
+                sub.bindings.update(self._target_names(g.target))
+            for i, g in enumerate(gens):
+                if i > 0:
+                    self._walk_expr_container(sub, g.iter)
+                for cond in g.ifs:
+                    self._walk_expr_container(sub, cond)
+            if isinstance(node, ast.DictComp):
+                self._walk_expr_container(sub, node.key)
+                self._walk_expr_container(sub, node.value)
+            else:
+                self._walk_expr_container(sub, node.elt)
+            return
+        if isinstance(node, ast.JoinedStr):
+            # F541 applies to the real f-string, never to a format_spec
+            # (the `{x:02d}` spec is itself a placeholder-less JoinedStr)
+            self._stmt_checks(scope, node)
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._walk_expr_container(scope, v.value)
+                    if v.format_spec is not None:
+                        for fv in v.format_spec.values:
+                            if isinstance(fv, ast.FormattedValue):
+                                self._walk_expr_container(scope, fv.value)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                if node.id in self.import_positions:
+                    self.import_uses.add(node.id)
+                if (not self.resolve(scope, node.id)
+                        and not scope.chain_has_star_or_exec()
+                        and not self._in_annotation):
+                    self.report(node.lineno, "F821",
+                                f"undefined name {node.id!r}")
+            return
+        if (self._in_annotation and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            # quoted forward ref nested inside an annotation, e.g.
+            # List["NodeUpgradeState"] — resolve uses inside it
+            try:
+                inner = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return
+            self._walk_expr_container(scope, inner)
+            return
+        self._stmt_checks(scope, node)
+        if isinstance(node, ast.AnnAssign):
+            # the annotation may be a forward reference (PEP 563): record
+            # name USES (keeps imports "used") but suppress F821 inside
+            self._walk_annotation(scope, node.annotation)
+            if node.value is not None:
+                self._walk_expr_container(scope, node.value)
+            self._walk_expr_container(scope, node.target)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk_expr_container(scope, child)
+
+    _in_annotation = False
+
+    def _walk_annotation(self, scope: Scope, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        prev = self._in_annotation
+        self._in_annotation = True
+        try:
+            # string annotations: parse and resolve uses inside them too
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                try:
+                    inner = ast.parse(node.value, mode="eval").body
+                except SyntaxError:
+                    return
+                self._walk_expr_container(scope, inner)
+                return
+            self._walk_expr_container(scope, node)
+        finally:
+            self._in_annotation = prev
+
+    def _check_defaults_and_decorators(self, scope: Scope,
+                                       node) -> None:
+        for d in node.decorator_list:
+            self._walk_expr_container(scope, d)
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            self._walk_expr_container(scope, d)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.report(d.lineno, "B006",
+                            "mutable default argument "
+                            f"in {node.name}()")
+        # annotations are uses (they keep imports alive) but may be forward
+        # references — resolved with F821 suppressed
+        for a in (list(node.args.posonlyargs) + list(node.args.args)
+                  + list(node.args.kwonlyargs)
+                  + ([node.args.vararg] if node.args.vararg else [])
+                  + ([node.args.kwarg] if node.args.kwarg else [])):
+            self._walk_annotation(scope, a.annotation)
+        self._walk_annotation(scope, node.returns)
+
+    # ------------------------------------------------------ per-node checks
+
+    def _stmt_checks(self, scope: Scope, node: ast.AST) -> None:
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            self.report(node.lineno, "E722", "bare except")
+        if isinstance(node, ast.JoinedStr):
+            if not any(isinstance(v, ast.FormattedValue)
+                       for v in node.values):
+                self.report(node.lineno, "F541",
+                            "f-string without placeholders")
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(comp, ast.Constant)
+                        and comp.value is None):
+                    self.report(node.lineno, "F601",
+                                "comparison to None with ==/!= (use is)")
+        if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple) \
+                and node.test.elts:
+            self.report(node.lineno, "F631",
+                        "assert on a tuple literal is always true")
+        if isinstance(node, ast.Dict):
+            seen: Set = set()
+            for k in node.keys:
+                if isinstance(k, ast.Constant):
+                    try:
+                        if k.value in seen:
+                            self.report(k.lineno, "F602",
+                                        f"duplicate dict key {k.value!r}")
+                        seen.add(k.value)
+                    except TypeError:
+                        pass
+        if isinstance(node, (ast.Global,)):
+            for n in node.names:
+                self.module_scope.bindings.add(n)
+        if isinstance(node, ast.Assign):
+            # collect __all__ for unused-import exemptions
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            self.dunder_all.add(el.value)
+
+    # --------------------------------------------------------------- main
+
+    def run(self) -> List[Tuple[int, str, str]]:
+        tree = self.module_scope.node
+        assert isinstance(tree, ast.Module)
+        self.check_scope(self.module_scope, tree.body)
+        # unused imports: module scope, skipped for __init__.py (re-export
+        # surface), names in __all__, underscore names, and future imports
+        if not self.is_init:
+            for name, (lineno, full) in sorted(self.import_positions.items(),
+                                               key=lambda kv: kv[1][0]):
+                if name in self.import_uses or name in self.dunder_all:
+                    continue
+                if name.startswith("_") or full == "__future__":
+                    continue
+                self.report(lineno, "F401", f"unused import {name!r}")
+        return sorted(self.findings)
+
+
+def _check_escapes(path: str, source: str,
+                   findings: List[Tuple[int, str, str]]) -> None:
+    import re
+    import warnings
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always", SyntaxWarning)
+        try:
+            compile(source, path, "exec")
+        except SyntaxError:
+            return
+    for w in caught:
+        if "invalid escape sequence" in str(w.message):
+            findings.append((w.lineno or 0, "W605", str(w.message)))
+    _ = re
+
+
+def lint_file(path: Path) -> List[str]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: E999 syntax error: {exc.msg}"]
+    checker = Checker(str(path), tree, source.splitlines())
+    findings = checker.run()
+    _check_escapes(str(path), source, findings)
+    lines = source.splitlines()
+    out = []
+    for lineno, code, msg in sorted(findings):
+        if 0 < lineno <= len(lines) and "# lint: ignore" in lines[lineno - 1]:
+            continue
+        out.append(f"{path}:{lineno}: {code} {msg}")
+    return out
+
+
+def main(argv: List[str]) -> int:
+    targets = argv or DEFAULT_TARGETS
+    files: List[Path] = []
+    for t in targets:
+        p = Path(t)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    problems: List[str] = []
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        problems.extend(lint_file(f))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(files)} files, {len(problems)} findings",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
