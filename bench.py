@@ -277,9 +277,7 @@ def measure_mfu(budget_s: float = 150.0):
         return None
     t_start = time.monotonic()
     try:
-        cfg = LlamaConfig(vocab_size=32000, d_model=2048, n_layers=10,
-                          n_heads=16, n_kv_heads=8, d_ff=8192,
-                          max_seq_len=1024, remat=False)
+        cfg = LlamaConfig.bench_mfu()
         B, T = 4, 1024
         params = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16),

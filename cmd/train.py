@@ -108,8 +108,14 @@ def build_parallel(cfg, args, optimizer):
         if args.seq % n:
             raise SystemExit(f"--seq {args.seq} must be divisible by the "
                              f"{n}-way seq mesh")
+        if args.sp_attn == "ulysses" and cfg.n_heads % n:
+            raise SystemExit(f"--sp-attn ulysses needs head count "
+                             f"{cfg.n_heads} divisible by {n} devices "
+                             "(use ring, which has no head limit)")
         mesh = make_mesh(seq=n, fsdp=1)
-        return (mesh, make_sp_train_step(cfg, mesh, optimizer),
+        return (mesh,
+                make_sp_train_step(cfg, mesh, optimizer,
+                                   attn_impl=args.sp_attn),
                 lambda rng: init_train_state(rng, cfg, optimizer, mesh,
                                              pspecs=replicated_specs))
     if args.parallel == "pp" and n > 1:
@@ -161,6 +167,11 @@ def main(argv=None) -> int:
                    choices=["dense", "a2a"],
                    help="EP dispatch: dense (replicated tokens) or "
                         "capacity-based all-to-all")
+    p.add_argument("--sp-attn", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="sequence-parallel attention: ring (K/V ppermute "
+                        "ring, any degree) or ulysses (head<->seq "
+                        "all-to-all; devices must divide head count)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)

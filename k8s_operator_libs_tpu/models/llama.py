@@ -77,12 +77,14 @@ class LlamaConfig:
         return dataclasses.replace(base, **overrides)
 
     @classmethod
-    def bench_1b(cls, **overrides) -> "LlamaConfig":
-        """~1.2B single-chip benchmark shape: d_model 2048 slabs actually
-        tile the 128x128 MXU (the 768-wide `small` slivers cannot — the r1
-        bench topped out near 13% MFU for exactly that reason)."""
-        base = cls(vocab_size=32000, d_model=2048, n_layers=22, n_heads=16,
-                   n_kv_heads=8, d_ff=7168, max_seq_len=2048, remat=True)
+    def bench_mfu(cls, **overrides) -> "LlamaConfig":
+        """~760M single-chip MFU-measurement shape (bench.measure_mfu):
+        d_model 2048 slabs actually tile the 128x128 MXU (the 768-wide
+        `small` slivers cannot — the r1 bench topped out near 13% MFU for
+        exactly that reason); sized so bf16 params + grads + activations
+        fit a v5e's 16 GB HBM without remat."""
+        base = cls(vocab_size=32000, d_model=2048, n_layers=10, n_heads=16,
+                   n_kv_heads=8, d_ff=8192, max_seq_len=1024, remat=False)
         return dataclasses.replace(base, **overrides)
 
 

@@ -34,10 +34,23 @@ from .fsdp import TrainState, default_optimizer
 from .ring_attention import ring_attention
 
 
-def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq"
-                 ) -> Callable:
+def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq",
+                 attn_impl: str = "ring") -> Callable:
     """Returns ``loss(params, tokens)`` with tokens [B, n·Tl + 1] and the
-    model's sequence dim sharded over ``axis_name``."""
+    model's sequence dim sharded over ``axis_name``.
+
+    ``attn_impl`` selects the cross-position scheme: "ring" (K/V chunks hop
+    the ICI ring, no head-count limit) or "ulysses" (two all-to-alls reshard
+    head<->sequence so the unmodified flash kernel sees the full sequence;
+    seq-axis size must divide the head count — see :mod:`.ulysses`)."""
+    if attn_impl == "ring":
+        attn_body = ring_attention
+    elif attn_impl == "ulysses":
+        from .ulysses import ulysses_attention
+        attn_body = ulysses_attention
+    else:
+        raise ValueError(f"unknown attn_impl {attn_impl!r} "
+                         "(expected 'ring' or 'ulysses')")
 
     def shard_loss(params, inputs, targets):
         # inputs/targets: local chunks [B, Tl]
@@ -46,7 +59,7 @@ def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq"
         B, Tl = inputs.shape
         positions = my * Tl + jnp.broadcast_to(
             jnp.arange(Tl, dtype=jnp.int32), (B, Tl))
-        attn = functools.partial(ring_attention, axis_name=axis_name,
+        attn = functools.partial(attn_body, axis_name=axis_name,
                                  causal=True)
         logits = forward(params, inputs, cfg, positions=positions,
                          attn_fn=attn)
@@ -70,12 +83,13 @@ def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq"
 
 def make_sp_train_step(cfg: LlamaConfig, mesh: Mesh,
                        optimizer: Optional[optax.GradientTransformation] = None,
-                       axis_name: str = "seq") -> Callable:
+                       axis_name: str = "seq",
+                       attn_impl: str = "ring") -> Callable:
     """Jitted sequence-parallel ``train_step(state, tokens)`` — params
     replicated over seq (combine with fsdp sharding on other axes via the
     mesh), tokens [B, n·Tl + 1]."""
     optimizer = optimizer or default_optimizer()
-    loss_fn = make_sp_loss(cfg, mesh, axis_name)
+    loss_fn = make_sp_loss(cfg, mesh, axis_name, attn_impl=attn_impl)
 
     def train_step(state: TrainState, tokens: jax.Array
                    ) -> Tuple[TrainState, Dict[str, jax.Array]]:

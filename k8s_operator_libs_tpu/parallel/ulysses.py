@@ -1,0 +1,76 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence resharding.
+
+The second of the two standard long-context schemes (the first, ring
+attention, is :mod:`.ring_attention`): instead of streaming K/V chunks
+around a ring, two ``lax.all_to_all`` collectives reshard the activations so
+attention sees the FULL sequence with a subset of heads —
+
+    [B, T/n, H, Dh]  --a2a(split heads, concat seq)-->  [B, T, H/n, Dh]
+    full-sequence causal attention per local head group (the flash kernel)
+    [B, T, H/n, Dh]  --a2a(split seq, concat heads)-->  [B, T/n, H, Dh]
+
+Trade-offs vs the ring (why both exist):
+
+- Ulysses runs the attention kernel ONCE over the whole sequence — no
+  online-softmax merge loop, so the unmodified Pallas flash kernel applies
+  and short-sequence latency is lower.
+- Comm volume is O(T·d) per device either way, but Ulysses sends it in two
+  dense all-to-alls (good on a fully-connected ICI axis) while the ring's
+  nearest-neighbor hops overlap with compute (better when comm is the
+  bottleneck or the axis spans DCN).
+- Ulysses caps the parallelism degree at the head count (n must divide H);
+  the ring has no such limit.
+
+Per-device bodies run under ``shard_map`` with the sequence dim sharded over
+the mesh's "seq" axis, exactly like the ring — ``make_sp_loss(attn_impl=
+"ulysses")`` in :mod:`.long_context` selects between them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import flash_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "seq", causal: bool = True
+                      ) -> jax.Array:
+    """Per-device body (call under shard_map). q,k,v: local chunks
+    [B, Tl, H, Dh], sequence-sharded over ``axis_name``; requires the axis
+    size to divide H (each device computes H/n full-sequence heads)."""
+    n = jax.lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(f"ulysses needs head count {H} divisible by "
+                         f"seq-axis size {n}")
+    # tiled all_to_all: split the head axis n ways (group i -> device i),
+    # concatenate received chunks along the sequence axis in device order —
+    # contiguous shard_map chunks make that the global sequence order
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, T, H/n, Dh]
+    out = flash_attention(qh, kh, vh, causal=causal)
+    # inverse resharding: split the sequence back n ways, concat heads
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_attention(mesh: Mesh, causal: bool = True,
+                           axis_name: str = "seq"):
+    """shard_map-wrapped Ulysses attention over global [B, T, H, Dh] arrays
+    with T sharded over the mesh's seq axis (mirror of
+    :func:`.ring_attention.make_ring_attention`)."""
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(ulysses_attention, axis_name=axis_name,
+                             causal=causal)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    ))

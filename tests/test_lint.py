@@ -134,3 +134,23 @@ def test_global_declared_names_trusted(tmp_path):
     src = ("def f():\n    global registry\n    registry = 1\n"
            "def g():\n    return registry\n")
     assert run_lint(tmp_path, src) == []
+
+
+def test_used_then_reimported_not_flagged(tmp_path):
+    src = "import os\nprint(os.getcwd())\nimport os\nprint(os.sep)\n"
+    assert run_lint(tmp_path, src) == []
+
+
+def test_unused_reimport_flagged(tmp_path):
+    src = "import os\nimport os\nprint(os.sep)\n"
+    assert codes(run_lint(tmp_path, src)) == ["F811"]
+
+
+def test_none_comparison_left_side(tmp_path):
+    assert codes(run_lint(tmp_path, "x = 1\ny = None == x\n")) == ["F601"]
+
+
+def test_w605_respects_noqa(tmp_path):
+    flagged = run_lint(tmp_path, 'p = "\\d+"\n')
+    assert codes(flagged) == ["W605"]
+    assert run_lint(tmp_path, 'p = "\\d+"  # noqa\n') == []

@@ -622,3 +622,85 @@ def test_cordoned_node_consumes_throttle_budget(cluster, keys, clock):
     reconcile(mgr, policy)  # admission
     cordon_required = states(cluster, keys, 8).count(UpgradeState.CORDON_REQUIRED)
     assert cordon_required <= 1
+
+
+# ------------------------------------------- error / nil-input edge specs
+
+
+def test_apply_state_rejects_none_state(cluster, keys, clock):
+    """Reference: 'should fail on nil currentState'
+    (upgrade_state_test.go:133)."""
+    mgr = make_manager(cluster, keys, clock)
+    with pytest.raises(ValueError, match="currentState"):
+        mgr.apply_state(None, DEFAULT_POLICY)
+
+
+def test_apply_state_none_policy_is_noop(cluster, keys, clock):
+    """Reference: 'should not fail on nil upgradePolicy'
+    (upgrade_state_test.go:136) — and nothing transitions."""
+    setup_fleet(cluster, 2, revision="rev-2", pod_revision="rev-1")
+    mgr = make_manager(cluster, keys, clock)
+    state = mgr.build_state(NS, DRIVER_LABELS)
+    mgr.apply_state(state, None)  # must not raise
+    assert states(cluster, keys, 2) == [""] * 2
+
+
+def test_drain_manager_error_fails_the_pass(cluster, keys, clock):
+    """Reference: 'should fail if drain manager returns an error'
+    (upgrade_state_test.go:707) — the error surfaces out of apply_state
+    (next reconcile retries idempotently from cluster state)."""
+    setup_fleet(cluster, 1, revision="rev-2", pod_revision="rev-1")
+    mgr = make_manager(cluster, keys, clock)
+    cluster.client.patch_node_metadata(
+        "node0", labels={keys.state_label: UpgradeState.DRAIN_REQUIRED})
+    cluster.flush_cache()
+
+    def broken(config):
+        raise RuntimeError("drain scheduling failed")
+
+    mgr.drain_manager.schedule_nodes_drain = broken
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True))
+    state = mgr.build_state(NS, DRIVER_LABELS)
+    with pytest.raises(RuntimeError, match="drain scheduling failed"):
+        mgr.apply_state(state, policy)
+    # state unchanged -> the next pass retries the drain
+    assert node_state(cluster, keys, "node0") == UpgradeState.DRAIN_REQUIRED
+
+
+def test_pod_restart_skips_already_terminating_pod(cluster, keys, clock):
+    """Reference: 'should not restart pod if ... already terminating'
+    (upgrade_state_test.go:732, :773-781): an outdated driver pod with a
+    deletionTimestamp is NOT deleted again."""
+    setup_fleet(cluster, 1, revision="rev-2", pod_revision="rev-1")
+    cluster.client.patch_node_metadata(
+        "node0", labels={keys.state_label: UpgradeState.POD_RESTART_REQUIRED})
+    pod = cluster.get("Pod", NS, "driver-node0")
+    pod.metadata.deletion_timestamp = 1234.5
+    cluster.update(pod)
+    cluster.flush_cache()
+    mgr = make_manager(cluster, keys, clock)
+
+    deleted = []
+    mgr.pod_manager.schedule_pods_restart = lambda pods: deleted.extend(
+        p.metadata.name for p in pods)
+    reconcile(mgr, DEFAULT_POLICY)
+    assert deleted == []
+    assert node_state(cluster, keys, "node0") == UpgradeState.POD_RESTART_REQUIRED
+
+
+def test_failed_orphaned_pod_stays_failed(cluster, keys, clock):
+    """Reference: 'should not move to UncordonRequired ... UpgradeFailed and
+    Orphaned Pod' (upgrade_state_test.go:1212): auto-recovery needs an
+    in-sync DaemonSet pod; an orphan can never be in sync."""
+    setup_fleet(cluster, 1)
+    cluster.add_node("lone")
+    cluster.add_pod("orphan", "lone", namespace=NS, labels=DRIVER_LABELS,
+                    phase="Running", ready=True)
+    cluster.client.patch_node_metadata(
+        "lone", labels={keys.state_label: UpgradeState.FAILED})
+    cluster.flush_cache()
+    mgr = make_manager(cluster, keys, clock)
+    reconcile(mgr, DEFAULT_POLICY)
+    assert node_state(cluster, keys, "lone") == UpgradeState.FAILED

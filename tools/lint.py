@@ -42,14 +42,6 @@ BUILTINS = set(dir(builtins)) | {
 }
 
 
-class Finding(Tuple[str, int, str, str]):
-    pass
-
-
-def _is_string_annotation_context(node: ast.AST) -> bool:
-    return isinstance(node, (ast.AnnAssign, ast.arg))
-
-
 class Scope:
     def __init__(self, kind: str, node: Optional[ast.AST],
                  parent: Optional["Scope"]):
@@ -83,6 +75,10 @@ class Checker(ast.NodeVisitor):
         self.module_scope = Scope("module", tree, None)
         self.import_positions: Dict[str, Tuple[int, str]] = {}
         self.import_uses: Set[str] = set()
+        # every module-scope import event, for F811 (resolved after the
+        # walk, when use positions are known)
+        self.import_events: List[Tuple[int, str, str, bool]] = []
+        self.name_use_lines: Dict[str, List[int]] = {}
         self.is_init = path.endswith("__init__.py")
         self.dunder_all: Set[str] = set()
 
@@ -190,17 +186,32 @@ class Checker(ast.NodeVisitor):
     def _bind_import(self, scope: Scope, name: str, lineno: int,
                      full: str, in_try: bool = False) -> None:
         if scope is self.module_scope:
-            if (name in self.import_positions and not in_try
-                    and name not in self.import_uses):
-                prev_line, prev_full = self.import_positions[name]
-                # `import urllib.error` + `import urllib.request` both bind
-                # "urllib" — submodule imports are complements, not shadows
-                if "." not in full and "." not in prev_full:
-                    self.report(lineno, "F811",
-                                f"import {name!r} shadows unused import on "
-                                f"line {prev_line}")
+            self.import_events.append((lineno, name, full, in_try))
             self.import_positions[name] = (lineno, full)
         scope.bindings.add(name)
+
+    def _check_import_shadowing(self) -> None:
+        """F811: a module-scope import redefines an earlier import of the
+        same name with NO use in between. Resolved after the walk (use
+        positions are unknown during binding). Submodule imports
+        (`import urllib.error` + `import urllib.request`) complement each
+        other, and try/except fallback imports are exempt."""
+        by_name: Dict[str, List[Tuple[int, str, bool]]] = {}
+        for lineno, name, full, in_try in sorted(self.import_events):
+            by_name.setdefault(name, []).append((lineno, full, in_try))
+        for name, events in by_name.items():
+            uses = self.name_use_lines.get(name, [])
+            for (prev_line, prev_full, prev_try), (line, full, is_try) in zip(
+                    events, events[1:]):
+                if prev_try or is_try:
+                    continue
+                if "." in full or "." in prev_full:
+                    continue
+                if any(prev_line < u < line for u in uses):
+                    continue
+                self.report(line, "F811",
+                            f"import {name!r} shadows unused import on "
+                            f"line {prev_line}")
 
     # ---------------------------------------------------------- resolving
 
@@ -302,6 +313,8 @@ class Checker(ast.NodeVisitor):
             if isinstance(node.ctx, ast.Load):
                 if node.id in self.import_positions:
                     self.import_uses.add(node.id)
+                    self.name_use_lines.setdefault(node.id, []).append(
+                        node.lineno)
                 if (not self.resolve(scope, node.id)
                         and not scope.chain_has_star_or_exec()
                         and not self._in_annotation):
@@ -381,10 +394,11 @@ class Checker(ast.NodeVisitor):
                 self.report(node.lineno, "F541",
                             "f-string without placeholders")
         if isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if (isinstance(op, (ast.Eq, ast.NotEq))
-                        and isinstance(comp, ast.Constant)
-                        and comp.value is None):
+            operands = [node.left] + list(node.comparators)
+            for i, op in enumerate(node.ops):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and any(
+                        isinstance(side, ast.Constant) and side.value is None
+                        for side in (operands[i], operands[i + 1])):
                     self.report(node.lineno, "F601",
                                 "comparison to None with ==/!= (use is)")
         if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple) \
@@ -420,6 +434,7 @@ class Checker(ast.NodeVisitor):
         tree = self.module_scope.node
         assert isinstance(tree, ast.Module)
         self.check_scope(self.module_scope, tree.body)
+        self._check_import_shadowing()
         # unused imports: module scope, skipped for __init__.py (re-export
         # surface), names in __all__, underscore names, and future imports
         if not self.is_init:
@@ -435,7 +450,6 @@ class Checker(ast.NodeVisitor):
 
 def _check_escapes(path: str, source: str,
                    findings: List[Tuple[int, str, str]]) -> None:
-    import re
     import warnings
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always", SyntaxWarning)
@@ -446,7 +460,6 @@ def _check_escapes(path: str, source: str,
     for w in caught:
         if "invalid escape sequence" in str(w.message):
             findings.append((w.lineno or 0, "W605", str(w.message)))
-    _ = re
 
 
 def lint_file(path: Path) -> List[str]:
@@ -461,8 +474,12 @@ def lint_file(path: Path) -> List[str]:
     lines = source.splitlines()
     out = []
     for lineno, code, msg in sorted(findings):
-        if 0 < lineno <= len(lines) and "# lint: ignore" in lines[lineno - 1]:
-            continue
+        if 0 < lineno <= len(lines):
+            line = lines[lineno - 1]
+            # same suppression contract for every code, including W605
+            # findings appended outside Checker.report
+            if "# lint: ignore" in line or "# noqa" in line:
+                continue
         out.append(f"{path}:{lineno}: {code} {msg}")
     return out
 
