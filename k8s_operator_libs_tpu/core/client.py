@@ -23,6 +23,12 @@ class NotFoundError(KeyError):
     """Object does not exist (apierrors.IsNotFound analog)."""
 
 
+class TooManyRequestsError(RuntimeError):
+    """HTTP 429 from the eviction subresource: a PodDisruptionBudget is
+    blocking the eviction right now. kubectl drain retries these until its
+    timeout; so does our drain Helper."""
+
+
 class ConflictError(RuntimeError):
     """resourceVersion conflict on update (apierrors.IsConflict analog)."""
 

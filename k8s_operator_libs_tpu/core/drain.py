@@ -14,7 +14,7 @@ import dataclasses
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from .client import Client, NotFoundError
+from .client import Client, NotFoundError, TooManyRequestsError
 from .objects import Pod
 
 # An AdditionalFilter: pod -> (delete?, reason). Matches kubectl drain's
@@ -90,19 +90,38 @@ class Helper:
 
     def delete_or_evict_pods(self, pods: List[Pod]) -> None:
         client = self.client.direct()
-        for pod in pods:
-            try:
-                if self.use_eviction:
-                    client.evict_pod(pod.metadata.namespace, pod.metadata.name,
-                                     self.grace_period_seconds)
-                else:
-                    client.delete_pod(pod.metadata.namespace, pod.metadata.name,
-                                      self.grace_period_seconds)
-            except NotFoundError:
-                pass
         # kubectl drain treats Timeout==0 as "no timeout"
         no_timeout = self.timeout_seconds <= 0
         deadline = self.clock.now() + self.timeout_seconds
+        pending = list(pods)
+        while pending:
+            still_blocked: List[Pod] = []
+            for pod in pending:
+                try:
+                    if self.use_eviction:
+                        client.evict_pod(pod.metadata.namespace,
+                                         pod.metadata.name,
+                                         self.grace_period_seconds)
+                    else:
+                        client.delete_pod(pod.metadata.namespace,
+                                          pod.metadata.name,
+                                          self.grace_period_seconds)
+                except NotFoundError:
+                    pass
+                except TooManyRequestsError:
+                    # a PodDisruptionBudget blocks this eviction right now;
+                    # kubectl drain retries every 5 s until its timeout —
+                    # same here
+                    still_blocked.append(pod)
+            if not still_blocked:
+                break
+            if not no_timeout and self.clock.now() >= deadline:
+                raise DrainError(
+                    f"global timeout reached with evictions still blocked "
+                    f"by disruption budgets: "
+                    f"{[p.metadata.name for p in still_blocked]}")
+            self.clock.sleep(5.0)
+            pending = still_blocked
         for pod in pods:
             while True:
                 try:

@@ -28,7 +28,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from .client import Client, ConflictError, EventRecorder, NotFoundError, make_event
+from .client import (Client, ConflictError, EventRecorder, NotFoundError,
+                     TooManyRequestsError, make_event)
 from .objects import (
     ContainerStatus,
     ControllerRevision,
@@ -96,6 +97,8 @@ class FakeCluster:
         self._cache: Dict[Key, object] = {}
         self._crds: Dict[str, dict] = {}
         self._watchers: List["queue.Queue"] = []
+        # PDB simulation: {(ns, name): remaining 429s} — see block_eviction
+        self._eviction_blocks: Dict[Tuple[str, str], int] = {}
         self.recorder = FakeRecorder()
         self.client: Client = _FakeClient(self, cached=True)
 
@@ -314,6 +317,21 @@ class FakeCluster:
         self.flush_cache()
         return created
 
+    def block_eviction(self, namespace: str, name: str, times: int = 1) -> None:
+        """Simulate a PodDisruptionBudget: the next ``times`` eviction
+        attempts for this pod get HTTP 429 (the apiserver's PDB response);
+        kubectl drain — and our Helper — retry until their timeout."""
+        with self._lock:
+            self._eviction_blocks[(namespace, name)] = times
+
+    def consume_eviction_block(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            left = self._eviction_blocks.get((namespace, name), 0)
+            if left <= 0:
+                return False
+            self._eviction_blocks[(namespace, name)] = left - 1
+            return True
+
     def set_pod_status(self, namespace: str, name: str, phase: Optional[str] = None,
                        ready: Optional[bool] = None,
                        restart_count: Optional[int] = None) -> Pod:
@@ -496,6 +514,13 @@ class _FakeClient(Client):
         self._c.delete("Pod", namespace, name)
 
     def evict_pod(self, namespace, name, grace_period_seconds=None) -> None:
-        # No PDBs in the fake; eviction degrades to delete, like the drain
-        # helper's fallback path.
+        # PDB simulation: registered blocks return 429 (block_eviction);
+        # otherwise eviction degrades to delete (no kubelet in the fake).
+        # Missing pods 404 BEFORE the PDB check, like a real apiserver —
+        # a pod deleted out-of-band must not read as "still blocked".
+        self._c.get("Pod", namespace, name)  # raises NotFoundError
+        if self._c.consume_eviction_block(namespace, name):
+            raise TooManyRequestsError(
+                f"Cannot evict pod {namespace}/{name}: disruption budget "
+                "would be violated")
         self._c.delete("Pod", namespace, name)

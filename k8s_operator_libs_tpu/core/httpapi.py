@@ -225,6 +225,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _delete_pod(self, ns: str, name: str, evict: bool = False) -> None:
         try:
+            self.cluster.get("Pod", ns, name)
+        except KeyError:
+            # a real apiserver 404s a missing pod before consulting PDBs
+            return self._error(404, "NotFound", f"pod {ns}/{name} not found")
+        if evict and self.cluster.consume_eviction_block(ns, name):
+            # the apiserver's PDB response to a blocked eviction
+            return self._error(429, "TooManyRequests",
+                               f"Cannot evict pod {ns}/{name}: disruption "
+                               "budget would be violated")
+        try:
             self.cluster.delete("Pod", ns, name)
         except KeyError:
             return self._error(404, "NotFound", f"pod {ns}/{name} not found")

@@ -42,7 +42,7 @@ from typing import Dict, List, Optional
 import yaml
 
 from . import serde
-from .client import (Client, ConflictError, NotFoundError,
+from .client import (Client, ConflictError, NotFoundError, TooManyRequestsError,
                      WatchError)  # noqa: F401  (WatchError re-export)
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
 
@@ -288,6 +288,10 @@ class KubeHTTP:
                     raise NotFoundError(f"{method} {path}: {detail}") from exc
                 if exc.code == 409:
                     raise ConflictError(f"{method} {path}: {detail}") from exc
+                if exc.code == 429:
+                    # PDB-blocked eviction; drain retries until timeout
+                    raise TooManyRequestsError(
+                        f"{method} {path}: {detail}") from exc
                 raise RuntimeError(
                     f"{method} {path}: HTTP {exc.code}: {detail}") from exc
         return json.loads(payload) if payload else {}

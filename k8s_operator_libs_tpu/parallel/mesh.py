@@ -14,30 +14,43 @@ let XLA's SPMD partitioner insert the collectives, profile, iterate. Axes:
 - ``seq``   — sequence/context parallelism for long-context training (ring
   attention over ICI neighbors; see :mod:`.ring_attention`).
 
-Device order from ``jax.devices()`` already follows the physical torus on
-TPU, so axis order (data, fsdp, seq, tensor) puts ``tensor`` on the
-fastest-varying (nearest-neighbor) dimension.
+Mesh→hardware assignment is PHYSICAL by default: on real TPU slices,
+``jax.experimental.mesh_utils.create_device_mesh`` lays the logical axes
+onto the ICI torus so the innermost (most bandwidth-hungry) axes get
+nearest-neighbor links and wraparound is exploited — a plain
+``jax.devices()`` reshape can silently put a tensor-parallel all-reduce
+across the slowest dimension. Falls back to the reshape where the topology
+is unknown (virtual CPU meshes, odd factorizations).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+logger = logging.getLogger(__name__)
+
 AXES = ("stage", "data", "fsdp", "seq", "tensor")
 
 
 def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
-              tensor: int = 1, stage: int = 1, devices=None) -> Mesh:
+              tensor: int = 1, stage: int = 1, devices=None,
+              physical: bool = True) -> Mesh:
     """Build a (stage, data, fsdp, seq, tensor) mesh. ``fsdp=None`` absorbs
     all remaining devices (the common pure-FSDP case, e.g. Llama-3-8B on a
     v5p-64: fsdp=64). ``stage`` is the pipeline-parallel axis (outermost:
     stages exchange only boundary activations, the least ICI-hungry
     traffic); ``tensor`` is innermost (per-block all-reduces ride
-    nearest-neighbor links)."""
+    nearest-neighbor links).
+
+    ``physical=True`` (default) asks mesh_utils for a topology-aware
+    device assignment on real TPU hardware; the logical shape and axis
+    names are identical either way, so shardings and checkpoints are
+    unaffected."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if fsdp is None:
@@ -48,6 +61,17 @@ def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
     shape = (stage, data, fsdp, seq, tensor)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh {shape} needs {np.prod(shape)} devices, have {n}")
+    if physical and n > 1 and devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True)
+            return Mesh(dev_array, AXES)
+        except Exception as exc:
+            # loud: the reshape fallback can put the tensor axis on the
+            # slowest ICI dimension — a silent step-time regression
+            logger.warning("physical mesh assignment unavailable (%s); "
+                           "falling back to device-order reshape", exc)
     return Mesh(np.asarray(devices).reshape(shape), AXES)
 
 

@@ -240,3 +240,19 @@ def test_periodic_checkpoints_keep_latest(rng, tmp_path):
     trainer.run(state, batches(batch=8), num_steps=6)
     trainer.close()
     assert trainer.latest_step == 6
+
+
+def test_make_mesh_uses_each_device_once_any_assignment():
+    """Physical (mesh_utils) or reshape assignment must both yield the same
+    logical shape/axis names with every device exactly once — shardings and
+    checkpoints cannot tell them apart."""
+    from k8s_operator_libs_tpu.parallel.mesh import AXES, make_mesh
+
+    for kwargs in ({"fsdp": 4, "tensor": 2},
+                   {"stage": 2, "fsdp": 2, "tensor": 2},
+                   {"data": 2, "fsdp": 2, "seq": 2}):
+        for physical in (True, False):
+            mesh = make_mesh(**kwargs, physical=physical)
+            assert mesh.axis_names == AXES
+            ids = sorted(d.id for d in mesh.devices.flat)
+            assert ids == sorted(d.id for d in jax.devices())

@@ -647,3 +647,20 @@ def test_null_annotation_patch_deletes_like_real_apiserver(live):
     assert "a" not in n.metadata.annotations
     assert n.metadata.annotations["b"] == "2"
     assert "zz" not in n.metadata.annotations
+
+
+def test_eviction_429_maps_to_too_many_requests(live):
+    """The apiserver's PDB response (HTTP 429 on the eviction subresource)
+    must surface as TooManyRequestsError so the drain helper retries."""
+    from k8s_operator_libs_tpu.core.client import TooManyRequestsError
+
+    cluster, cli = live
+    cluster.add_node("n0")
+    cluster.add_pod("workload", "n0")
+    cluster.block_eviction("default", "workload", times=1)
+    with pytest.raises(TooManyRequestsError, match="disruption budget"):
+        cli.evict_pod("default", "workload")
+    # budget consumed -> the retry succeeds
+    cli.evict_pod("default", "workload")
+    assert not [p for p in cluster.client.direct().list_pods()
+                if p.metadata.name == "workload"]

@@ -281,3 +281,119 @@ def test_safe_load_unblock(cluster, provider, keys):
     assert keys.safe_load_annotation not in anno
     # no-op when absent
     mgr.unblock_loading(node)
+
+
+# --------------------------------------- reference manager edge specs
+
+
+def test_drain_empty_node_list_is_noop(cluster, provider, keys, clock):
+    """Reference: 'should not fail on empty node list'
+    (drain_manager_test.go)."""
+    mgr = make_drain_manager(cluster, provider, keys, clock)
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True), nodes=[]))  # must not raise
+
+
+def test_drain_nil_spec_is_an_error(cluster, provider, keys, clock):
+    """Reference: 'should return error on nil drain spec'."""
+    cluster.add_node("n0")
+    node = cluster.client.direct().get_node("n0")
+    mgr = make_drain_manager(cluster, provider, keys, clock)
+    with pytest.raises(ValueError, match="drain spec"):
+        mgr.schedule_nodes_drain(DrainConfiguration(spec=None, nodes=[node]))
+
+
+def test_drain_disabled_spec_skips(cluster, provider, keys, clock):
+    """Reference: 'should skip drain if drain is disabled in the spec' — no
+    cordon, no state change."""
+    cluster.add_node("n0")
+    node = cluster.client.direct().get_node("n0")
+    mgr = make_drain_manager(cluster, provider, keys, clock)
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=False), nodes=[node]))
+    n = cluster.client.direct().get_node("n0")
+    assert not n.spec.unschedulable
+    assert keys.state_label not in n.metadata.labels
+
+
+def test_pods_restart_empty_input_is_noop(cluster, provider, keys, clock):
+    """Reference: 'should not fail on empty input' (pod_manager_test.go)."""
+    mgr = PodManager(cluster.client, provider, keys, None, cluster.recorder,
+                     clock, synchronous=True)
+    mgr.schedule_pods_restart([])  # must not raise
+
+
+def test_validation_empty_selector_trivially_done(cluster, provider, keys, clock):
+    """Reference: 'should return no error if podSelector is empty'."""
+    cluster.add_node("n0")
+    node = cluster.client.direct().get_node("n0")
+    mgr = ValidationManager(cluster.client, provider, keys, "",
+                            cluster.recorder, clock)
+    assert mgr.validate(node) is True
+
+
+def test_validation_pod_readiness_matrix(cluster, provider, keys, clock):
+    """Reference Validate() matrix: no pods -> False; Running+Ready -> True;
+    Running not Ready -> False; not Running -> False."""
+    cluster.add_node("n0")
+    node = cluster.client.direct().get_node("n0")
+    mgr = ValidationManager(cluster.client, provider, keys, "app=validator",
+                            cluster.recorder, clock)
+    assert mgr.validate(node) is False  # no validation pods at all
+    cluster.add_pod("val", "n0", labels={"app": "validator"},
+                    phase="Running", ready=True)
+    assert mgr.validate(node) is True
+    cluster.set_pod_status("default", "val", ready=False)
+    assert mgr.validate(node) is False
+    cluster.set_pod_status("default", "val", phase="Pending", ready=True)
+    assert mgr.validate(node) is False
+
+
+def test_drain_retries_pdb_blocked_eviction_until_unblocked(
+        cluster, provider, keys, clock):
+    """kubectl drain parity: an eviction the apiserver 429s (PDB would be
+    violated) is retried every 5 s until it goes through — the drain
+    completes once the budget allows."""
+    cluster.add_node("n0")
+    cluster.add_pod("workload", "n0")
+    cluster.block_eviction("default", "workload", times=3)
+    node = cluster.client.direct().get_node("n0")
+    mgr = make_drain_manager(cluster, provider, keys, clock)
+    t0 = clock.now()
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True, force=True, timeout_second=300),
+        nodes=[node]))
+    # 3 blocked attempts -> 3 x 5s retry sleeps, then the eviction lands
+    assert clock.now() - t0 >= 15.0
+    assert not [p for p in cluster.client.direct().list_pods()
+                if p.metadata.name == "workload"]
+    assert state_of(cluster, keys, "n0") == UpgradeState.POD_RESTART_REQUIRED
+
+
+def test_drain_pdb_blocked_past_timeout_fails_node(
+        cluster, provider, keys, clock):
+    cluster.add_node("n0")
+    cluster.add_pod("workload", "n0")
+    cluster.block_eviction("default", "workload", times=10_000)
+    node = cluster.client.direct().get_node("n0")
+    mgr = make_drain_manager(cluster, provider, keys, clock)
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        nodes=[node]))
+    # drain failure -> upgrade-failed (reference drain_manager.go:122-128)
+    assert state_of(cluster, keys, "n0") == UpgradeState.FAILED
+    assert [p for p in cluster.client.direct().list_pods()
+            if p.metadata.name == "workload"]
+
+
+def test_evicting_missing_pod_is_404_not_blocked(cluster):
+    """A pod deleted out-of-band must 404 even with a registered eviction
+    block (real apiserver ordering) — the drain helper's NotFoundError
+    pass-through marks it done instead of retrying until timeout."""
+    from k8s_operator_libs_tpu.core.client import NotFoundError
+    cluster.add_node("n0")
+    cluster.add_pod("gone", "n0")
+    cluster.block_eviction("default", "gone", times=10_000)
+    cluster.delete("Pod", "default", "gone")
+    with pytest.raises(NotFoundError):
+        cluster.client.direct().evict_pod("default", "gone")
