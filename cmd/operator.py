@@ -87,7 +87,11 @@ def build_client(args, components):
             client,
             namespaces=[c.namespace for c in components],
             watch_window_seconds=max(args.interval, 5.0))
-        client.start()
+        if not args.leader_elect:
+            client.start()
+        # with --leader-elect the informers start on first leadership win:
+        # permanent standbys must not hold watch streams for caches nobody
+        # reads (controller-runtime starts caches after winning, too)
     return client, LiveEventRecorder(http)
 
 
@@ -173,6 +177,15 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                         "-1 = disabled)")
     p.add_argument("--ensure-crds", default=None, metavar="DIR",
                    help="apply CRDs from DIR before the first tick")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="coordinate HA replicas through a "
+                        "coordination.k8s.io Lease: only the holder "
+                        "reconciles (client-go-style acquire/renew/CAS)")
+    p.add_argument("--leader-elect-lease", default="tpu-operator",
+                   metavar="NAME", help="Lease name (namespace = the first "
+                                        "component's namespace)")
+    p.add_argument("--leader-elect-identity", default=None,
+                   help="candidate identity (default: hostname-pid)")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -194,6 +207,23 @@ def main(argv=None, stop=None, on_ready=None) -> int:
 
     operator = TPUOperator(client, components, recorder=recorder)
     stop = stop or threading.Event()
+    elector = None
+    cache_started = [not args.leader_elect]  # see build_client
+    if args.leader_elect and not args.once:
+        import os
+        import socket
+        from k8s_operator_libs_tpu.core.leaderelection import LeaderElector
+        identity = (args.leader_elect_identity
+                    or f"{socket.gethostname()}-{os.getpid()}")
+        elector = LeaderElector(client, args.leader_elect_lease,
+                                components[0].namespace, identity)
+        # renewal runs on its own thread so a reconcile longer than the
+        # lease duration (e.g. a drain waiting out PDB retries) cannot let
+        # the lease lapse mid-tick
+        elector.run_background(stop)
+        logger.info("leader election on (lease %s/%s, identity %s)",
+                    components[0].namespace, args.leader_elect_lease,
+                    identity)
     prev_handlers = {}
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -261,6 +291,19 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     try:
         while not stop.is_set():
             t0 = time.monotonic()
+            if elector is not None and not elector.is_leader:
+                # standby replica: stay healthy (probes must not restart a
+                # hot spare) but do not reconcile
+                if server:
+                    server.snapshot["healthy"] = True
+                stop.wait(min(args.interval, elector.retry_period))
+                continue
+            if not cache_started[0]:
+                # first leadership win: start the informers now (standbys
+                # never held watch streams)
+                if hasattr(client, "start"):
+                    client.start()
+                cache_started[0] = True
             states = operator.reconcile()
             ticks += 1
             last_ok = all(s is not None for s in states.values())
@@ -285,6 +328,10 @@ def main(argv=None, stop=None, on_ready=None) -> int:
             else:
                 stop.wait(remaining)
     finally:
+        if elector is not None:
+            # clean shutdown: release so the successor doesn't wait out the
+            # full lease duration
+            elector.release()
         if server:
             server.stop()
         if hasattr(client, "stop"):  # CachedClient informers

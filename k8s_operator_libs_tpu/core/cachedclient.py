@@ -301,6 +301,16 @@ class CachedClient(Client):
     def create_service(self, service):
         return self._live.create_service(service)
 
+    # leases bypass the cache entirely: leader election must see fresh state
+    def get_lease(self, namespace, name):
+        return self._live.get_lease(namespace, name)
+
+    def create_lease(self, lease):
+        return self._live.create_lease(lease)
+
+    def update_lease(self, lease):
+        return self._live.update_lease(lease)
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         self._live.delete_pod(namespace, name,
                               grace_period_seconds=grace_period_seconds)

@@ -510,6 +510,20 @@ class _FakeClient(Client):
         self._c.flush_cache()
         return created
 
+    # leases are never cached: leader election must read fresh state
+    def get_lease(self, namespace, name):
+        return self._c.get("Lease", namespace, name)
+
+    def create_lease(self, lease):
+        created = self._c.create(lease)
+        self._c.flush_cache()
+        return created
+
+    def update_lease(self, lease):
+        updated = self._c.update(lease)
+        self._c.flush_cache()
+        return updated
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         self._c.delete("Pod", namespace, name)
 

@@ -149,6 +149,18 @@ class _Handler(BaseHTTPRequestHandler):
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/services", path)
         if m and method == "POST":
             return self._create_service(m.group(1), self._body())
+        m = re.fullmatch(
+            r"/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases",
+            path)
+        if m and method == "POST":
+            return self._create_lease(m.group(1), self._body())
+        m = re.fullmatch(
+            r"/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases/([^/]+)",
+            path)
+        if m and method == "GET":
+            return self._get_lease(m.group(1), m.group(2))
+        if m and method == "PUT":
+            return self._update_lease(m.group(1), m.group(2), self._body())
         m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/events", path)
         if m and method == "POST":
             return self._record_event(self._body())
@@ -213,6 +225,36 @@ class _Handler(BaseHTTPRequestHandler):
         except ConflictError as exc:
             return self._error(409, "AlreadyExists", str(exc))
         self._send(201, serde.pod_to_json(created))
+
+    def _get_lease(self, ns: str, name: str) -> None:
+        try:
+            lease = self.cluster.client.direct().get_lease(ns, name)
+        except KeyError:
+            return self._error(404, "NotFound",
+                               f"lease {ns}/{name} not found")
+        self._send(200, serde.lease_to_json(lease))
+
+    def _create_lease(self, ns: str, body: Dict) -> None:
+        lease = serde.lease_from_json(body)
+        lease.metadata.namespace = ns
+        try:
+            created = self.cluster.client.direct().create_lease(lease)
+        except ConflictError as exc:
+            return self._error(409, "AlreadyExists", str(exc))
+        self._send(201, serde.lease_to_json(created))
+
+    def _update_lease(self, ns: str, name: str, body: Dict) -> None:
+        lease = serde.lease_from_json(body)
+        lease.metadata.namespace = ns
+        lease.metadata.name = name
+        try:
+            updated = self.cluster.client.direct().update_lease(lease)
+        except ConflictError as exc:
+            return self._error(409, "Conflict", str(exc))
+        except KeyError:
+            return self._error(404, "NotFound",
+                               f"lease {ns}/{name} not found")
+        self._send(200, serde.lease_to_json(updated))
 
     def _create_service(self, ns: str, body: Dict) -> None:
         svc = serde.service_from_json(body)

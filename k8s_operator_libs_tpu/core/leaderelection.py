@@ -1,0 +1,179 @@
+"""Lease-based leader election for HA operator deployments.
+
+The reference library ships none of this — its consumers (GPU / Network
+Operator) inherit controller-runtime's leaderelection when they build their
+manager. Our deployable binary (cmd/operator.py) has no controller-runtime,
+so this module implements the same protocol against a
+``coordination.k8s.io/v1`` Lease:
+
+- a candidate acquires the lease by CREATE (404 path) or by CAS UPDATE when
+  the recorded ``renewTime`` is older than ``leaseDurationSeconds`` (holder
+  crashed / stopped renewing);
+- the holder re-PUTs ``renewTime`` every ``retry_period``; the apiserver's
+  resourceVersion conflict detection makes every transition a
+  compare-and-swap — two candidates racing the same takeover get exactly one
+  winner (the loser's PUT 409s);
+- losing the lease (e.g. an apiserver partition longer than the lease
+  duration) is detected on the next tick and reported, so the caller stops
+  acting as leader BEFORE a new holder starts.
+
+Defaults follow client-go: lease 15 s, retry 2 s.
+
+Usage: run :meth:`run_background` so renewal is NOT coupled to the
+reconcile cadence (a reconcile longer than the lease duration — a drain
+waiting out PDB retries — must not let the lease lapse mid-tick; client-go
+renews on a background goroutine for the same reason), then gate work on
+:attr:`is_leader`:
+
+    elector = LeaderElector(client, "tpu-operator", "kube-system", identity)
+    elector.run_background(stop_event)
+    while running:
+        if elector.is_leader:
+            operator.reconcile()
+        clock.sleep(interval)
+
+The non-blocking :meth:`tick` remains for single-threaded loops whose
+iteration time is far below the lease duration.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..utils.clock import Clock, RealClock
+from .client import ConflictError, NotFoundError
+from .objects import Lease, LeaseSpec, ObjectMeta
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_LEASE_DURATION_S = 15.0
+DEFAULT_RETRY_PERIOD_S = 2.0
+
+
+class LeaderElector:
+    def __init__(self, client, lease_name: str, namespace: str,
+                 identity: str,
+                 lease_duration_s: float = DEFAULT_LEASE_DURATION_S,
+                 retry_period_s: float = DEFAULT_RETRY_PERIOD_S,
+                 clock: Optional[Clock] = None):
+        self._client = client
+        self._name = lease_name
+        self._ns = namespace
+        self.identity = identity
+        self._duration = lease_duration_s
+        self.retry_period = retry_period_s
+        self._clock = clock or RealClock()
+        self._is_leader = False
+        self._last_attempt: float = -1e18
+
+    @property
+    def is_leader(self) -> bool:
+        """Last observed leadership state (updated by :meth:`tick`)."""
+        return self._is_leader
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self) -> bool:
+        """Acquire-or-renew, rate-limited to ``retry_period``; returns
+        whether this process is the leader RIGHT NOW. Call every loop
+        iteration — cheap between attempts."""
+        now = self._clock.now()
+        if now - self._last_attempt < self.retry_period:
+            return self._is_leader
+        self._last_attempt = now
+        was = self._is_leader
+        self._is_leader = self._try_acquire_or_renew()
+        if self._is_leader and not was:
+            logger.info("%s became leader of %s/%s", self.identity,
+                        self._ns, self._name)
+        elif was and not self._is_leader:
+            logger.warning("%s LOST leadership of %s/%s", self.identity,
+                           self._ns, self._name)
+        return self._is_leader
+
+    def run_background(self, stop_event: threading.Event) -> threading.Thread:
+        """Renew/acquire on a daemon thread every ``retry_period`` until
+        ``stop_event`` fires — leadership stays alive through reconciles
+        longer than the lease duration. The caller gates work on
+        :attr:`is_leader` (a plain bool read)."""
+        def loop():
+            while not stop_event.is_set():
+                try:
+                    self.tick()
+                except Exception:
+                    # transport hiccup: log and keep trying; leadership
+                    # lapses naturally if the outage outlives the lease
+                    logger.exception("leader-election tick failed")
+                    self._is_leader = False
+                stop_event.wait(self.retry_period)
+        t = threading.Thread(target=loop, name="leader-elector", daemon=True)
+        t.start()
+        return t
+
+    def release(self) -> None:
+        """Voluntarily drop the lease on clean shutdown so the successor
+        doesn't wait out the full lease duration (client-go's
+        ReleaseOnCancel). Never raises — shutdown must complete even when
+        the apiserver is unreachable (the lease then simply expires)."""
+        if not self._is_leader:
+            return
+        try:
+            lease = self._client.get_lease(self._ns, self._name)
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = None
+                self._client.update_lease(lease)
+        except Exception as exc:
+            logger.warning("could not release lease %s/%s (%s); it will "
+                           "expire on its own", self._ns, self._name, exc)
+        self._is_leader = False
+
+    # ------------------------------------------------------------- internals
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self._clock.now()
+        try:
+            lease = self._client.get_lease(self._ns, self._name)
+        except NotFoundError:
+            lease = Lease(
+                metadata=ObjectMeta(name=self._name, namespace=self._ns),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self._duration),
+                    acquire_time=now, renew_time=now))
+            try:
+                self._client.create_lease(lease)
+                return True
+            except ConflictError:
+                return False  # raced another candidate; retry next tick
+
+        if lease.spec.holder_identity == self.identity:
+            # renew: keep resourceVersion so a hijack (another holder took
+            # over while we were partitioned) 409s instead of clobbering
+            lease.spec.renew_time = now
+            try:
+                self._client.update_lease(lease)
+                return True
+            except (ConflictError, NotFoundError):
+                return False
+
+        # client-go semantics: expiry is judged against the CANDIDATE'S
+        # configured LeaseDuration, not the record's integer field (which
+        # is informational — and truncates sub-second test durations to 0)
+        expired = (not lease.spec.holder_identity
+                   or lease.spec.renew_time is None
+                   or now - lease.spec.renew_time > self._duration)
+        if not expired:
+            return False
+        # takeover: CAS on the observed resourceVersion
+        lease.spec.holder_identity = self.identity
+        lease.spec.acquire_time = now
+        lease.spec.renew_time = now
+        lease.spec.lease_transitions += 1
+        try:
+            self._client.update_lease(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False  # someone else won the race

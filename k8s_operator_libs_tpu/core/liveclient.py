@@ -447,6 +447,28 @@ class LiveClient(Client):
             "POST", f"/api/v1/namespaces/{ns}/services",
             body=serde.service_to_json(service)))
 
+    # ------------------------------------------------ leases (leader election)
+
+    _LEASES = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+    def get_lease(self, namespace, name):
+        return serde.lease_from_json(self._http.request(
+            "GET", self._LEASES.format(ns=namespace) + f"/{name}"))
+
+    def create_lease(self, lease):
+        ns = lease.metadata.namespace or "default"
+        return serde.lease_from_json(self._http.request(
+            "POST", self._LEASES.format(ns=ns),
+            body=serde.lease_to_json(lease)))
+
+    def update_lease(self, lease):
+        """PUT with the lease's resourceVersion — a stale version 409s,
+        which is the compare-and-swap leader election depends on."""
+        ns = lease.metadata.namespace or "default"
+        return serde.lease_from_json(self._http.request(
+            "PUT", self._LEASES.format(ns=ns) + f"/{lease.metadata.name}",
+            body=serde.lease_to_json(lease)))
+
     def delete_pod(self, namespace, name, grace_period_seconds=None) -> None:
         body = None
         if grace_period_seconds is not None:

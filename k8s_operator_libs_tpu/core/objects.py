@@ -256,6 +256,34 @@ class Service:
 
 
 # ---------------------------------------------------------------------------
+# Lease (coordination.k8s.io/v1) — leader election for HA operator
+# deployments (the reference's consumers get this from controller-runtime;
+# our deployable binary implements it against this object)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None   # epoch seconds
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+    kind: str = "Lease"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
 # Event
 # ---------------------------------------------------------------------------
 

@@ -21,10 +21,10 @@ import time
 from typing import Dict, List, Optional
 
 from .objects import (ContainerStatus, ControllerRevision, DaemonSet,
-                      DaemonSetStatus, Job, JobStatus, Node, NodeCondition,
-                      NodeSpec, NodeStatus, ObjectMeta, OwnerReference, Pod,
-                      PodCondition, PodSpec, PodStatus, Service, ServicePort,
-                      ServiceSpec, Volume)
+                      DaemonSetStatus, Job, JobStatus, Lease, LeaseSpec, Node,
+                      NodeCondition, NodeSpec, NodeStatus, ObjectMeta,
+                      OwnerReference, Pod, PodCondition, PodSpec, PodStatus,
+                      Service, ServicePort, ServiceSpec, Volume)
 
 RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
 
@@ -280,6 +280,35 @@ def service_from_json(j: Dict) -> Service:
             ports=[ServicePort(name=p.get("name", ""),
                                port=int(p.get("port", 0)))
                    for p in spec_j.get("ports") or []]))
+
+
+def lease_to_json(lease: Lease) -> Dict:
+    spec: Dict = {
+        "holderIdentity": lease.spec.holder_identity,
+        "leaseDurationSeconds": lease.spec.lease_duration_seconds,
+        "leaseTransitions": lease.spec.lease_transitions,
+    }
+    if lease.spec.acquire_time is not None:
+        spec["acquireTime"] = _ts_to_rfc3339(lease.spec.acquire_time)
+    if lease.spec.renew_time is not None:
+        spec["renewTime"] = _ts_to_rfc3339(lease.spec.renew_time)
+    return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta_to_json(lease.metadata), "spec": spec}
+
+
+def lease_from_json(j: Dict) -> Lease:
+    spec_j = j.get("spec") or {}
+    # every LeaseSpec field is an optional pointer in the real API —
+    # explicit JSON nulls (another client's released lease) are legal
+    return Lease(
+        metadata=meta_from_json(j.get("metadata") or {}),
+        spec=LeaseSpec(
+            holder_identity=spec_j.get("holderIdentity") or "",
+            lease_duration_seconds=int(
+                spec_j.get("leaseDurationSeconds") or 15),
+            acquire_time=_rfc3339_to_ts(spec_j.get("acquireTime")),
+            renew_time=_rfc3339_to_ts(spec_j.get("renewTime")),
+            lease_transitions=int(spec_j.get("leaseTransitions") or 0)))
 
 
 def list_to_json(kind: str, items: List[Dict]) -> Dict:

@@ -664,3 +664,66 @@ def test_eviction_429_maps_to_too_many_requests(live):
     cli.evict_pod("default", "workload")
     assert not [p for p in cluster.client.direct().list_pods()
                 if p.metadata.name == "workload"]
+
+
+def test_operator_binary_leader_election(tmp_path):
+    """Two --leader-elect instances against one apiserver: exactly one
+    reconciles (the standby stays healthy but idle); when the leader stops
+    it releases the lease and the standby takes over without waiting out
+    the lease duration."""
+    import threading
+    import time
+
+    op = _load_cli("operator")
+    cluster = FakeCluster()
+    _seed(cluster)
+    srv = FakeAPIServer(cluster).start()
+    kc, cfg = _write_operator_env(tmp_path, srv.base_url)
+
+    stops = [threading.Event(), threading.Event()]
+    rcs = [[], []]
+    threads = []
+    for i in (0, 1):
+        threads.append(threading.Thread(target=lambda i=i: rcs[i].append(
+            op.main(["--config", str(cfg), "--kubeconfig", str(kc),
+                     "--interval", "0.1", "--metrics-port", "-1",
+                     "--uncached", "--leader-elect",
+                     "--leader-elect-identity", f"inst-{i}"],
+                    stop=stops[i]))))
+    try:
+        threads[0].start()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                lease = cluster.client.direct().get_lease("tpu", "tpu-operator")
+                if lease.spec.holder_identity == "inst-0":
+                    break
+            except KeyError:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("inst-0 never acquired the lease")
+        threads[1].start()
+        time.sleep(1.0)  # standby must NOT steal a live lease
+        lease = cluster.client.direct().get_lease("tpu", "tpu-operator")
+        assert lease.spec.holder_identity == "inst-0"
+        # leader exits cleanly -> releases -> standby takes over quickly
+        stops[0].set()
+        threads[0].join(20)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            lease = cluster.client.direct().get_lease("tpu", "tpu-operator")
+            if lease.spec.holder_identity == "inst-1":
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"standby never took over (holder "
+                f"{lease.spec.holder_identity!r})")
+    finally:
+        for s in stops:
+            s.set()
+        for t in threads:
+            t.join(20)
+        srv.stop()
+    assert rcs[0] == [0] and rcs[1] == [0]
