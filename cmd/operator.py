@@ -208,7 +208,11 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     operator = TPUOperator(client, components, recorder=recorder)
     stop = stop or threading.Event()
     elector = None
-    cache_started = [not args.leader_elect]  # see build_client
+    cache_started = not args.leader_elect  # see build_client
+    if args.leader_elect and args.once:
+        logger.warning("--leader-elect is ignored with --once: a one-shot "
+                       "tick cannot hold a lease; it may interleave with a "
+                       "running HA leader")
     if args.leader_elect and not args.once:
         import os
         import socket
@@ -217,10 +221,19 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                     or f"{socket.gethostname()}-{os.getpid()}")
         elector = LeaderElector(client, args.leader_elect_lease,
                                 components[0].namespace, identity)
+
+        def on_lost():
+            # client-go's OnStoppedLeading: an in-flight reconcile cannot
+            # be aborted, so stop the process — the supervisor restarts it
+            # as a standby and the new leader proceeds alone
+            logger.error("leadership lost; stopping so the new leader "
+                         "reconciles alone")
+            stop.set()
+
         # renewal runs on its own thread so a reconcile longer than the
         # lease duration (e.g. a drain waiting out PDB retries) cannot let
         # the lease lapse mid-tick
-        elector.run_background(stop)
+        elector.run_background(stop, on_lost=on_lost)
         logger.info("leader election on (lease %s/%s, identity %s)",
                     components[0].namespace, args.leader_elect_lease,
                     identity)
@@ -298,12 +311,12 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                     server.snapshot["healthy"] = True
                 stop.wait(min(args.interval, elector.retry_period))
                 continue
-            if not cache_started[0]:
+            if not cache_started:
                 # first leadership win: start the informers now (standbys
                 # never held watch streams)
                 if hasattr(client, "start"):
                     client.start()
-                cache_started[0] = True
+                cache_started = True
             states = operator.reconcile()
             ticks += 1
             last_ok = all(s is not None for s in states.values())

@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""tpu-operator status: day-2 visibility into a rolling driver upgrade.
+
+Prints one row per managed node — upgrade state label, schedulability,
+slice membership, driver-pod revision vs the DaemonSet's — plus a summary
+line per component, straight from the cluster (the same reads the state
+machine makes; no controller required to be running).
+
+    python cmd/status.py --kubeconfig ~/.kube/config \
+        --component libtpu --namespace kube-system --selector app=libtpu
+
+Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
+while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
+gates and scripts can wait on it.
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState  # noqa: E402
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory, parse_selector  # noqa: E402
+from k8s_operator_libs_tpu.tpu.topology import slice_info_for_node  # noqa: E402
+
+IN_FLIGHT_RC = 3
+FAILED_RC = 4
+
+
+def build_client(args):
+    from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
+                                                       LiveClient)
+    kc = (KubeConfig.in_cluster() if args.in_cluster else
+          KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
+    return LiveClient(KubeHTTP(kc))
+
+
+def collect_status(client, component: str, namespace: str, selector):
+    """Join driver pods with their nodes, like BuildState does."""
+    keys = KeyFactory(component)
+    daemonsets = {d.metadata.uid: d for d in client.list_daemonsets(
+        namespace=namespace, label_selector=selector)}
+    ds_hash = {}
+    for ds in daemonsets.values():
+        revs = [r for r in client.list_controller_revisions(namespace=namespace)
+                if any(o.uid == ds.metadata.uid
+                       for o in r.metadata.owner_references)]
+        if revs:
+            latest = max(revs, key=lambda r: r.revision)
+            ds_hash[ds.metadata.uid] = latest.metadata.labels.get(
+                "controller-revision-hash", "?")
+    rows = []
+    for pod in client.list_pods(namespace=namespace, label_selector=selector):
+        if not pod.spec.node_name:
+            continue
+        node = client.get_node(pod.spec.node_name)
+        owner = pod.metadata.owner_references[0].uid \
+            if pod.metadata.owner_references else None
+        info = slice_info_for_node(node)
+        pod_rev = pod.metadata.labels.get("controller-revision-hash", "?")
+        want_rev = ds_hash.get(owner, "?") if owner else "(orphan)"
+        rows.append({
+            "node": node.metadata.name,
+            "state": node.metadata.labels.get(keys.state_label, "") or "unknown",
+            "schedulable": not node.spec.unschedulable,
+            "slice": (info.slice_id if info is not None and info.multi_host
+                      else "-"),
+            "pod_revision": pod_rev,
+            "target_revision": want_rev,
+            "in_sync": pod_rev == want_rev,
+        })
+    return sorted(rows, key=lambda r: r["node"])
+
+
+def render_table(component: str, rows) -> str:
+    headers = ("NODE", "STATE", "SCHED", "SLICE", "REVISION")
+    table = [(r["node"], r["state"], "yes" if r["schedulable"] else "no",
+              r["slice"],
+              r["pod_revision"] + ("" if r["in_sync"]
+                                   else f" -> {r['target_revision']}"))
+             for r in rows]
+    widths = [max(len(h), *(len(t[i]) for t in table)) if table else len(h)
+              for i, h in enumerate(headers)]
+    lines = [f"component: {component}"]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    done = sum(1 for r in rows if r["state"] == UpgradeState.DONE)
+    failed = sum(1 for r in rows if r["state"] == UpgradeState.FAILED)
+    in_flight = sum(1 for r in rows if r["state"] not in
+                    ("unknown", UpgradeState.DONE, UpgradeState.FAILED))
+    lines.append(f"{len(rows)} nodes: {done} done, {in_flight} in flight, "
+                 f"{failed} failed")
+    return "\n".join(lines)
+
+
+def main(argv=None, client=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--component", action="append", required=True,
+                   help="managed component name (repeatable)")
+    p.add_argument("--namespace", default="kube-system")
+    p.add_argument("--selector", default=None,
+                   help='driver-pod label selector, "k=v,k2=v2"')
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--context", default=None)
+    p.add_argument("--in-cluster", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+    if client is None:
+        client = build_client(args)
+    selector = parse_selector(args.selector) if args.selector else None
+
+    rc = 0
+    out = {}
+    for comp in args.component:
+        rows = collect_status(client, comp, args.namespace,
+                              selector or {"app": comp})
+        out[comp] = rows
+        if any(r["state"] == UpgradeState.FAILED for r in rows):
+            rc = max(rc, FAILED_RC)
+        elif any(r["state"] not in ("unknown", UpgradeState.DONE)
+                 for r in rows):
+            rc = max(rc, IN_FLIGHT_RC)
+        if not args.as_json:
+            print(render_table(comp, rows))
+            print()
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

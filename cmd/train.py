@@ -177,6 +177,11 @@ def main(argv=None) -> int:
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--ckpt-interval", type=int, default=50)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a JAX/XLA profiler trace of the steady-state "
+                        "steps into DIR (open with TensorBoard or Perfetto); "
+                        "the capture starts after the first step so compile "
+                        "time does not drown the timeline")
     args = p.parse_args(argv)
 
     # under an operator placement, join the multi-host/multislice
@@ -225,14 +230,29 @@ def main(argv=None) -> int:
         for arr in ds.batches(args.batch, args.seq + 1):
             yield jnp.asarray(arr)
 
+    profiling = {"on": False}
+
     def on_step(step, metrics):
+        if args.profile and not profiling["on"] and step > start_step:
+            # first step (compile) is done; trace the steady state
+            jax.profiler.start_trace(args.profile)
+            profiling["on"] = True
         if step % 10 == 0:
             print(f"step {step} loss {float(metrics['loss']):.4f}",
                   flush=True)
 
-    result = trainer.run(state, batches(), num_steps=args.steps - start_step,
-                         drain_signal=lambda: draining["flag"],
-                         on_step=on_step)
+    try:
+        result = trainer.run(state, batches(),
+                             num_steps=args.steps - start_step,
+                             drain_signal=lambda: draining["flag"],
+                             on_step=on_step)
+    finally:
+        # flush the trace even when a step raises — a crash is exactly when
+        # the profile is wanted (and a dangling active trace breaks any
+        # later start_trace in this process)
+        if profiling["on"]:
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {args.profile}")
     trainer.close()
     ds.close()
     if result.preempted:

@@ -67,6 +67,9 @@ class LeaderElector:
         self._clock = clock or RealClock()
         self._is_leader = False
         self._last_attempt: float = -1e18
+        self._bg_stop = threading.Event()
+        self._bg_thread: Optional[threading.Thread] = None
+        self._on_lost = None
 
     @property
     def is_leader(self) -> bool:
@@ -91,32 +94,54 @@ class LeaderElector:
         elif was and not self._is_leader:
             logger.warning("%s LOST leadership of %s/%s", self.identity,
                            self._ns, self._name)
+            if self._on_lost is not None:
+                self._on_lost()
         return self._is_leader
 
-    def run_background(self, stop_event: threading.Event) -> threading.Thread:
+    def run_background(self, stop_event: threading.Event,
+                       on_lost=None) -> threading.Thread:
         """Renew/acquire on a daemon thread every ``retry_period`` until
-        ``stop_event`` fires — leadership stays alive through reconciles
-        longer than the lease duration. The caller gates work on
-        :attr:`is_leader` (a plain bool read)."""
+        ``stop_event`` (or :meth:`release`) fires — leadership stays alive
+        through reconciles longer than the lease duration. The caller gates
+        work on :attr:`is_leader` (a plain bool read).
+
+        ``on_lost`` fires when held leadership lapses (renewals failed
+        longer than the lease). There is no way to abort a reconcile already
+        in flight, so callers should treat it like client-go's
+        OnStoppedLeading: stop the process and let the supervisor restart it
+        as a standby."""
+        self._on_lost = on_lost
+
         def loop():
-            while not stop_event.is_set():
+            while not (stop_event.is_set() or self._bg_stop.is_set()):
                 try:
                     self.tick()
                 except Exception:
                     # transport hiccup: log and keep trying; leadership
                     # lapses naturally if the outage outlives the lease
                     logger.exception("leader-election tick failed")
+                    was = self._is_leader
                     self._is_leader = False
-                stop_event.wait(self.retry_period)
+                    if was and self._on_lost is not None:
+                        self._on_lost()
+                self._bg_stop.wait(self.retry_period)
         t = threading.Thread(target=loop, name="leader-elector", daemon=True)
+        self._bg_thread = t
         t.start()
         return t
 
     def release(self) -> None:
         """Voluntarily drop the lease on clean shutdown so the successor
         doesn't wait out the full lease duration (client-go's
-        ReleaseOnCancel). Never raises — shutdown must complete even when
-        the apiserver is unreachable (the lease then simply expires)."""
+        ReleaseOnCancel). Stops and joins the background renew thread first
+        — otherwise an in-flight renew PUT can beat the release (409) or a
+        zombie thread can re-acquire the lease it just gave up. Never
+        raises — shutdown must complete even when the apiserver is
+        unreachable (the lease then simply expires)."""
+        self._bg_stop.set()
+        if self._bg_thread is not None:
+            self._bg_thread.join(timeout=max(5.0, self.retry_period * 3))
+            self._bg_thread = None
         if not self._is_leader:
             return
         try:

@@ -20,7 +20,7 @@ import threading
 from typing import Callable, List, Optional
 
 from ..api.v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
-from ..core.client import Client, EventRecorder
+from ..core.client import Client, EventRecorder, NotFoundError
 from ..core.drain import Helper
 from ..core.objects import DaemonSet, Node, Pod
 from ..utils.clock import Clock, RealClock
@@ -177,12 +177,18 @@ class PodManager:
     def schedule_pods_restart(self, pods: List[Pod]) -> None:
         """SchedulePodsRestart (:236-254): plain delete of each outdated
         driver pod; the DaemonSet controller recreates it at the new
-        template."""
+        template. A pod already gone counts as restarted (deliberate
+        deviation from the reference's plain Delete: the cached snapshot
+        can trail a delete the previous operator incarnation issued before
+        crashing, and re-failing the pass on NotFound just burns a
+        reconcile — the desired state is achieved either way)."""
         client = self._client.direct()
         for pod in pods:
             logger.info("deleting driver pod %s", pod.metadata.name)
             try:
                 client.delete_pod(pod.metadata.namespace, pod.metadata.name)
+            except NotFoundError:
+                logger.info("driver pod %s already gone", pod.metadata.name)
             except Exception as exc:
                 log_event(self._recorder, pod, "Warning", self._keys.event_reason,
                           f"Failed to restart driver pod {exc}")

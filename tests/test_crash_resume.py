@@ -1,0 +1,175 @@
+"""Crash-resume fuzz: ApplyState's idempotency contract under fire.
+
+The reference documents — but never fuzzes — that ApplyState is stateless
+and idempotent: "if an error occurs ... the next reconciliation will
+continue the work" (upgrade_state.go:68-72), because all state lives in
+node labels/annotations behind the visible-before-return cache barrier.
+
+These tests enforce it mechanically: a wrapper client crashes the operator
+after a pseudo-random number of write operations (sometimes before the
+write lands, sometimes after — both real crash shapes), a FRESH manager
+(the restarted operator) resumes from cluster state, and the fleet must
+still converge with every invariant intact:
+
+- all nodes reach upgrade-done at the new revision, uncordoned;
+- slice atomicity is never violated mid-crash (no slice member uncordoned
+  and serving while another member is down);
+- no write is ever lost or double-applied in a way that wedges the
+  pipeline (bounded number of incarnations to converge).
+"""
+
+import random
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.tpu.topology import (
+    GKE_ACCELERATOR_LABEL,
+    GKE_NODEPOOL_LABEL,
+    GKE_TOPOLOGY_LABEL,
+    TPUSliceGrouper,
+)
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManager
+
+NS = "kube-system"
+DRIVER_LABELS = {"app": "libtpu"}
+
+WRITE_METHODS = ("patch_node_metadata", "patch_node_unschedulable",
+                 "delete_pod", "evict_pod")
+
+
+class OperatorCrash(RuntimeError):
+    pass
+
+
+class CrashingClient:
+    """Delegates to the real client; raises OperatorCrash when the shared
+    write budget runs out. ``post_write`` crashes AFTER the write landed
+    (the harsher shape: the restarted operator sees the effect of a write
+    the crashed one never observed)."""
+
+    def __init__(self, inner, budget):
+        self._inner = inner
+        self._budget = budget  # dict: {"left": int, "post": bool}
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in WRITE_METHODS:
+            return attr
+
+        def crashing(*args, **kwargs):
+            b = self._budget
+            if b["left"] <= 0:
+                if b["post"]:
+                    attr(*args, **kwargs)
+                raise OperatorCrash(f"injected crash at {name}")
+            b["left"] -= 1
+            return attr(*args, **kwargs)
+
+        return crashing
+
+    def direct(self):
+        return CrashingClient(self._inner.direct(), self._budget)
+
+
+def drive_until_converged(cluster, keys, clock, node_names, rng,
+                          grouper=None, max_incarnations=300):
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    incarnations = 0
+    while incarnations < max_incarnations:
+        incarnations += 1
+        budget = {"left": rng.randrange(0, 12), "post": bool(rng.getrandbits(1))}
+        client = CrashingClient(cluster.client, budget)
+        mgr = ClusterUpgradeStateManager(
+            client, keys, cluster.recorder, clock, grouper=grouper,
+            synchronous=True)
+        try:
+            for _ in range(100):
+                state = mgr.build_state(NS, DRIVER_LABELS)
+                mgr.apply_state(state, policy)
+                cluster.reconcile_daemonsets()
+                check_slice_invariant(cluster, keys, node_names)
+                if fleet_done(cluster, keys, node_names):
+                    return incarnations
+        except OperatorCrash:
+            check_slice_invariant(cluster, keys, node_names)
+            continue  # operator restarts with a fresh manager
+    raise AssertionError(
+        f"fleet never converged in {max_incarnations} incarnations: "
+        f"{fleet_states(cluster, keys, node_names)}")
+
+
+def fleet_states(cluster, keys, names):
+    return {n: (cluster.client.direct().get_node(n).metadata.labels.get(
+                    keys.state_label, ""),
+                cluster.client.direct().get_node(n).spec.unschedulable)
+            for n in names}
+
+
+def fleet_done(cluster, keys, names):
+    snap = fleet_states(cluster, keys, names)
+    return all(s == UpgradeState.DONE and not u for s, u in snap.values())
+
+
+def check_slice_invariant(cluster, keys, names):
+    """No slice member may be serving (uncordoned) while another member is
+    mid-upgrade past the drain point — an ICI domain is one failure unit."""
+    snap = fleet_states(cluster, keys, names)
+    down_states = (UpgradeState.DRAIN_REQUIRED,
+                   UpgradeState.POD_RESTART_REQUIRED,
+                   UpgradeState.VALIDATION_REQUIRED)
+    any_down = any(s in down_states for s, _ in snap.values())
+    if any_down:
+        for name, (s, unsched) in snap.items():
+            in_progress = s in UpgradeState.IN_PROGRESS
+            assert not (in_progress and not unsched and s in down_states), \
+                f"slice member {name} serving while slice is down: {snap}"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_plain_fleet_converges_through_crashes(cluster, keys, clock, seed):
+    """BASELINE config-2 shape: 4 independent nodes, operator crashing at
+    random write counts, always converges."""
+    ds = cluster.add_daemonset("libtpu", namespace=NS, labels=DRIVER_LABELS,
+                               revision_hash="v1")
+    names = []
+    for i in range(4):
+        name = f"node{i}"
+        cluster.add_node(name)
+        cluster.add_pod(f"libtpu-{name}", name, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+        names.append(name)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    rng = random.Random(seed)
+    drive_until_converged(cluster, keys, clock, names, rng)
+    pods = cluster.client.direct().list_pods(namespace=NS)
+    assert sorted(p.metadata.labels["controller-revision-hash"]
+                  for p in pods) == ["v2"] * 4
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_slice_fleet_converges_through_crashes(cluster, keys, clock, seed):
+    """A 4-host slice (atomic group): crashes may land between any two
+    member writes, yet the slice-atomicity invariant holds at every
+    interruption point and the slice still converges."""
+    slice_labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                    GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-a"}
+    ds = cluster.add_daemonset("libtpu", namespace=NS, labels=DRIVER_LABELS,
+                               revision_hash="v1")
+    names = []
+    for i in range(4):
+        name = f"pool-a-h{i}"
+        cluster.add_node(name, labels=slice_labels)
+        cluster.add_pod(f"libtpu-{name}", name, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+        names.append(name)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    rng = random.Random(seed)
+    drive_until_converged(cluster, keys, clock, names, rng,
+                          grouper=TPUSliceGrouper())
+    pods = cluster.client.direct().list_pods(namespace=NS)
+    assert sorted(p.metadata.labels["controller-revision-hash"]
+                  for p in pods) == ["v2"] * 4

@@ -173,3 +173,55 @@ def test_lease_serde_tolerates_explicit_nulls():
     assert lease.spec.lease_duration_seconds == 15
     assert lease.spec.lease_transitions == 0
     assert lease.spec.renew_time is None
+
+
+def test_release_joins_renew_thread_no_zombie_reacquire():
+    """release() must stop + join the background renew thread BEFORE
+    releasing — otherwise an in-flight renew beats the release, or the
+    zombie thread re-acquires the lease it just gave up."""
+    import threading
+    import time
+
+    cluster = FakeCluster()
+    stop = threading.Event()  # NOT set: simulates an exception-path exit
+    a = LeaderElector(cluster.client, "l", "ns", "a",
+                      lease_duration_s=0.4, retry_period_s=0.02)
+    a.run_background(stop)
+    deadline = time.time() + 5
+    while not a.is_leader and time.time() < deadline:
+        time.sleep(0.01)
+    assert a.is_leader
+    a.release()
+    lease = cluster.client.direct().get_lease("ns", "l")
+    assert lease.spec.holder_identity == ""
+    # the renew thread is gone: the lease stays released
+    time.sleep(0.2)
+    lease = cluster.client.direct().get_lease("ns", "l")
+    assert lease.spec.holder_identity == ""
+
+
+def test_on_lost_fires_when_lease_hijacked():
+    """Leadership silently lost (e.g. renewals failed past the lease
+    duration and another holder took over) must invoke on_lost — the
+    operator uses it to stop, like client-go's OnStoppedLeading."""
+    import threading
+    import time
+
+    cluster = FakeCluster()
+    stop = threading.Event()
+    lost = threading.Event()
+    a = LeaderElector(cluster.client, "l", "ns", "a",
+                      lease_duration_s=0.3, retry_period_s=0.02)
+    a.run_background(stop, on_lost=lost.set)
+    deadline = time.time() + 5
+    while not a.is_leader and time.time() < deadline:
+        time.sleep(0.01)
+    assert a.is_leader
+    # hijack: another holder rewrites the lease (apiserver-side takeover)
+    lease = cluster.client.direct().get_lease("ns", "l")
+    lease.spec.holder_identity = "b"
+    lease.spec.renew_time = time.time() + 3600
+    cluster.client.direct().update_lease(lease)
+    assert lost.wait(5.0), "on_lost never fired"
+    assert not a.is_leader
+    stop.set()

@@ -727,3 +727,45 @@ def test_operator_binary_leader_election(tmp_path):
             t.join(20)
         srv.stop()
     assert rcs[0] == [0] and rcs[1] == [0]
+
+
+def test_status_cli_reports_table_and_exit_codes(tmp_path, capsys):
+    """cmd/status.py: per-node table + exit codes scripts can gate on
+    (0 done, 3 in flight, 4 failed), over the live HTTP transport."""
+    status = _load_cli("status")
+
+    cluster = FakeCluster()
+    ds = _seed(cluster)
+    with FakeAPIServer(cluster) as srv:
+        kc_path = tmp_path / "kc"
+        kc_path.write_text(yaml.safe_dump({
+            "current-context": "c",
+            "contexts": [{"name": "c",
+                          "context": {"cluster": "cl", "user": "u"}}],
+            "clusters": [{"name": "cl", "cluster": {"server": srv.base_url}}],
+            "users": [{"name": "u", "user": {}}],
+        }))
+        argv = ["--component", "libtpu", "--namespace", "tpu",
+                "--selector", "app=d", "--kubeconfig", str(kc_path)]
+        # all unknown, in sync -> rc 0
+        assert status.main(argv) == 0
+        out = capsys.readouterr().out
+        assert "n0" in out and "unknown" in out and "2 nodes" in out
+        # one node mid-upgrade -> rc 3, revision mismatch rendered
+        from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+        keys = KeyFactory("libtpu")
+        cluster.bump_daemonset_revision("libtpu", "tpu", "v2")
+        cluster.client.direct().patch_node_metadata(
+            "n0", labels={keys.state_label: "drain-required"})
+        cluster.flush_cache()
+        assert status.main(argv) == 3
+        out = capsys.readouterr().out
+        assert "drain-required" in out and "v1 -> v2" in out
+        # failed -> rc 4; --json output parses
+        cluster.client.direct().patch_node_metadata(
+            "n0", labels={keys.state_label: "upgrade-failed"})
+        cluster.flush_cache()
+        assert status.main(argv + ["--json"]) == 4
+        import json as _json
+        data = _json.loads(capsys.readouterr().out)
+        assert data["libtpu"][0]["state"] == "upgrade-failed"
