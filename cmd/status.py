@@ -38,23 +38,33 @@ def build_client(args):
 
 def collect_status(client, component: str, namespace: str, selector):
     """Join driver pods with their nodes, like BuildState does."""
+    from k8s_operator_libs_tpu.core.client import NotFoundError
+    from k8s_operator_libs_tpu.upgrade.pod_manager import (
+        daemonset_revision_hash)
+
     keys = KeyFactory(component)
     daemonsets = {d.metadata.uid: d for d in client.list_daemonsets(
         namespace=namespace, label_selector=selector)}
+    revisions = client.list_controller_revisions(namespace=namespace)
     ds_hash = {}
     for ds in daemonsets.values():
-        revs = [r for r in client.list_controller_revisions(namespace=namespace)
-                if any(o.uid == ds.metadata.uid
-                       for o in r.metadata.owner_references)]
-        if revs:
-            latest = max(revs, key=lambda r: r.revision)
-            ds_hash[ds.metadata.uid] = latest.metadata.labels.get(
-                "controller-revision-hash", "?")
+        try:
+            ds_hash[ds.metadata.uid] = daemonset_revision_hash(
+                client, ds, revisions=revisions)
+        except ValueError:
+            pass  # rendered as "?" below
     rows = []
     for pod in client.list_pods(namespace=namespace, label_selector=selector):
         if not pod.spec.node_name:
             continue
-        node = client.get_node(pod.spec.node_name)
+        try:
+            node = client.get_node(pod.spec.node_name)
+        except NotFoundError:
+            # node deleted mid-scale-down while its driver pod terminates;
+            # report rather than crash (exit codes must stay 0/3/4)
+            print(f"warning: pod {pod.metadata.name} references missing "
+                  f"node {pod.spec.node_name}; skipping", file=sys.stderr)
+            continue
         owner = pod.metadata.owner_references[0].uid \
             if pod.metadata.owner_references else None
         info = slice_info_for_node(node)

@@ -227,7 +227,11 @@ def main(argv=None) -> int:
     ds = TokenDataset(args.data)
 
     def batches():
-        for arr in ds.batches(args.batch, args.seq + 1):
+        # start_step: a resumed job continues the exact data stream at its
+        # restored step (counter-based sampling) instead of replaying the
+        # beginning
+        for arr in ds.batches(args.batch, args.seq + 1,
+                              start_step=start_step):
             yield jnp.asarray(arr)
 
     profiling = {"on": False}

@@ -67,6 +67,7 @@ class LeaderElector:
         self._clock = clock or RealClock()
         self._is_leader = False
         self._last_attempt: float = -1e18
+        self._last_renew_ok: float = -1e18
         self._bg_stop = threading.Event()
         self._bg_thread: Optional[threading.Thread] = None
         self._on_lost = None
@@ -88,6 +89,8 @@ class LeaderElector:
         self._last_attempt = now
         was = self._is_leader
         self._is_leader = self._try_acquire_or_renew()
+        if self._is_leader:
+            self._last_renew_ok = now
         if self._is_leader and not was:
             logger.info("%s became leader of %s/%s", self.identity,
                         self._ns, self._name)
@@ -117,13 +120,19 @@ class LeaderElector:
                 try:
                     self.tick()
                 except Exception:
-                    # transport hiccup: log and keep trying; leadership
-                    # lapses naturally if the outage outlives the lease
+                    # transport hiccup (apiserver blip, rolling restart):
+                    # KEEP leadership while the lease we hold is still
+                    # alive — the apiserver record still names us, so no
+                    # standby can take over anyway. Only when the outage
+                    # outlives the lease duration has leadership truly
+                    # lapsed (client-go's renew-deadline semantics).
                     logger.exception("leader-election tick failed")
-                    was = self._is_leader
-                    self._is_leader = False
-                    if was and self._on_lost is not None:
-                        self._on_lost()
+                    lapsed = (self._clock.now() - self._last_renew_ok
+                              > self._duration)
+                    if self._is_leader and lapsed:
+                        self._is_leader = False
+                        if self._on_lost is not None:
+                            self._on_lost()
                 self._bg_stop.wait(self.retry_period)
         t = threading.Thread(target=loop, name="leader-elector", daemon=True)
         self._bg_thread = t

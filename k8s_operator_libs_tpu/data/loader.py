@@ -210,10 +210,25 @@ class TokenDataset:
             offsets = np.clip(offsets, 0, hi)
         return self.gather(offsets, seqlen)
 
+    def sample_at(self, batch: int, seqlen: int, seed: int, step: int,
+                  shard: Optional[tuple] = None) -> np.ndarray:
+        """Counter-based sampling: batch ``step`` of stream ``seed`` is a
+        PURE FUNCTION of (seed, step) — a job resuming from a checkpoint at
+        step k continues the exact data stream at batch k instead of
+        replaying batches 0..k-1 (a sequential-RNG stream restarts from
+        state 0 on every resume)."""
+        rng = np.random.default_rng([seed, step])
+        return self.sample(batch, seqlen, rng, shard)
+
     def batches(self, batch: int, seqlen: int, seed: int = 0,
                 prefetch: int = 2,
-                shard: Optional[tuple] = None) -> Iterator[np.ndarray]:
+                shard: Optional[tuple] = None,
+                start_step: int = 0) -> Iterator[np.ndarray]:
         """Infinite prefetched batch stream (background thread).
+
+        Batch i is ``sample_at(..., step=start_step + i)``, so a resumed
+        job passes its restored step as ``start_step`` and the stream
+        continues exactly where the crashed/drained job left off.
 
         Producer failures propagate: if the producer thread raises (bad
         offsets, dataset closed under it, ...) the consumer's next
@@ -234,13 +249,14 @@ class TokenDataset:
             return False
 
         def producer():
-            rng = np.random.default_rng(seed)
+            step = start_step
             while not stop.is_set():
                 try:
-                    item = self.sample(batch, seqlen, rng, shard)
+                    item = self.sample_at(batch, seqlen, seed, step, shard)
                 except BaseException as exc:  # surface, don't die silently
                     _put(_ProducerDied(exc))
                     return
+                step += 1
                 _put(item)
 
         t = threading.Thread(target=producer, daemon=True,

@@ -38,6 +38,24 @@ PodDeletionFilter = Callable[[Pod], bool]
 REVISION_HASH_LABEL = "controller-revision-hash"
 
 
+def daemonset_revision_hash(client, ds: DaemonSet, revisions=None) -> str:
+    """Latest template hash of a DaemonSet = hash label of its owned
+    ControllerRevision with the highest revision (pod_manager.go:95-121).
+    ``revisions`` lets callers resolving many DaemonSets reuse ONE
+    namespace LIST (cmd/status.py) instead of one per DaemonSet."""
+    if revisions is None:
+        revisions = client.list_controller_revisions(
+            namespace=ds.metadata.namespace)
+    revs = [r for r in revisions
+            if any(o.uid == ds.metadata.uid
+                   for o in r.metadata.owner_references)]
+    if not revs:
+        raise ValueError(f"no ControllerRevisions for DaemonSet "
+                         f"{ds.metadata.name}")
+    latest = max(revs, key=lambda r: r.revision)
+    return latest.metadata.labels[REVISION_HASH_LABEL]
+
+
 @dataclasses.dataclass
 class PodManagerConfig:
     """PodManagerConfig (pod_manager.go:63-68)."""
@@ -78,13 +96,7 @@ class PodManager:
     def get_daemonset_controller_revision_hash(self, ds: DaemonSet) -> str:
         """Latest template hash = hash label of the owned ControllerRevision
         with the highest revision (pod_manager.go:95-121)."""
-        revs = [r for r in self._client.direct().list_controller_revisions(
-                    namespace=ds.metadata.namespace)
-                if any(o.uid == ds.metadata.uid for o in r.metadata.owner_references)]
-        if not revs:
-            raise ValueError(f"no ControllerRevisions for DaemonSet {ds.metadata.name}")
-        latest = max(revs, key=lambda r: r.revision)
-        return latest.metadata.labels[REVISION_HASH_LABEL]
+        return daemonset_revision_hash(self._client.direct(), ds)
 
     # ------------------------------------------------------------ eviction
 

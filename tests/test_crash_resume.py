@@ -91,11 +91,13 @@ def drive_until_converged(cluster, keys, clock, node_names, rng,
                 state = mgr.build_state(NS, DRIVER_LABELS)
                 mgr.apply_state(state, policy)
                 cluster.reconcile_daemonsets()
-                check_slice_invariant(cluster, keys, node_names)
+                check_slice_invariant(cluster, keys, node_names,
+                                      atomic=grouper is not None)
                 if fleet_done(cluster, keys, node_names):
                     return incarnations
         except OperatorCrash:
-            check_slice_invariant(cluster, keys, node_names)
+            check_slice_invariant(cluster, keys, node_names,
+                                  atomic=grouper is not None)
             continue  # operator restarts with a fresh manager
     raise AssertionError(
         f"fleet never converged in {max_incarnations} incarnations: "
@@ -114,19 +116,34 @@ def fleet_done(cluster, keys, names):
     return all(s == UpgradeState.DONE and not u for s, u in snap.values())
 
 
-def check_slice_invariant(cluster, keys, names):
-    """No slice member may be serving (uncordoned) while another member is
-    mid-upgrade past the drain point — an ICI domain is one failure unit."""
+DOWN_STATES = (UpgradeState.DRAIN_REQUIRED,
+               UpgradeState.POD_RESTART_REQUIRED,
+               UpgradeState.VALIDATION_REQUIRED)
+
+
+def check_node_invariant(cluster, keys, names):
+    """A node at/past the drain point must itself be cordoned (applies to
+    grouped and ungrouped fleets alike)."""
     snap = fleet_states(cluster, keys, names)
-    down_states = (UpgradeState.DRAIN_REQUIRED,
-                   UpgradeState.POD_RESTART_REQUIRED,
-                   UpgradeState.VALIDATION_REQUIRED)
-    any_down = any(s in down_states for s, _ in snap.values())
+    for name, (s, unsched) in snap.items():
+        assert not (s in DOWN_STATES and not unsched), \
+            f"node {name} in {s} but schedulable: {snap}"
+    return snap
+
+
+def check_slice_invariant(cluster, keys, names, atomic):
+    """CROSS-MEMBER atomicity: the instant ANY slice member is at/past the
+    drain point (its driver/ICI is going down), EVERY member must be out of
+    service (cordoned) — a member left schedulable would take placements on
+    a broken ICI domain."""
+    snap = check_node_invariant(cluster, keys, names)
+    if not atomic:
+        return
+    any_down = any(s in DOWN_STATES for s, _ in snap.values())
     if any_down:
         for name, (s, unsched) in snap.items():
-            in_progress = s in UpgradeState.IN_PROGRESS
-            assert not (in_progress and not unsched and s in down_states), \
-                f"slice member {name} serving while slice is down: {snap}"
+            assert unsched, (f"slice member {name} ({s}) serving while "
+                             f"another member is down: {snap}")
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])

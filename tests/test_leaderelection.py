@@ -225,3 +225,44 @@ def test_on_lost_fires_when_lease_hijacked():
     assert lost.wait(5.0), "on_lost never fired"
     assert not a.is_leader
     stop.set()
+
+
+def test_transient_renew_error_does_not_drop_leadership():
+    """An apiserver blip shorter than the lease must NOT fire on_lost or
+    drop leadership: the apiserver record still names this holder, so no
+    standby can take over anyway (client-go renew-deadline semantics)."""
+    import threading
+    import time
+
+    cluster = FakeCluster()
+    stop = threading.Event()
+    lost = threading.Event()
+    a = LeaderElector(cluster.client, "l", "ns", "a",
+                      lease_duration_s=2.0, retry_period_s=0.02)
+    # flaky transport: get_lease raises transiently after acquisition
+    real_get = cluster.client.get_lease
+    flaky = {"on": False}
+
+    class FlakyClient:
+        def __getattr__(self, name):
+            if name == "get_lease" and flaky["on"]:
+                raise RuntimeError("GET leases: HTTP 500 (apiserver blip)")
+            return getattr(cluster.client, name)
+
+    a._client = FlakyClient()
+    a.run_background(stop, on_lost=lost.set)
+    try:
+        deadline = time.time() + 5
+        while not a.is_leader and time.time() < deadline:
+            time.sleep(0.01)
+        assert a.is_leader
+        flaky["on"] = True
+        time.sleep(0.5)  # several failed renew attempts, lease still alive
+        assert a.is_leader, "transient blip dropped leadership"
+        assert not lost.is_set()
+        flaky["on"] = False
+        time.sleep(0.2)
+        assert a.is_leader  # recovered seamlessly
+        assert real_get("ns", "l").spec.holder_identity == "a"
+    finally:
+        stop.set()
