@@ -257,7 +257,7 @@ trainer.close()
     return None
 
 
-def measure_mfu(budget_s: float = 150.0):
+def measure_mfu():
     """Dedicated MFU measurement on an MXU-sized model.
 
     The downtime workload model stays at the r1 125M shape (768-wide slivers
@@ -317,6 +317,48 @@ def measure_mfu(budget_s: float = 150.0):
         }
     except Exception as exc:  # OOM / tunnel stall must not sink the bench
         print(json.dumps({"warning": f"mfu measurement failed: {exc}"}),
+              file=sys.stderr)
+        return None
+
+
+def measure_decode():
+    """KV-cache decode throughput on the attached chip: the inference-side
+    datapoint (single-chip greedy decode on the 125M workload model, batch 8
+    — decode is cache/weight-bandwidth-bound, so tokens/s is the figure of
+    merit). Returns None on failure rather than sinking the bench."""
+    import jax
+    import jax.numpy as jnp
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+    if jax.default_backend() != "tpu":
+        return None
+    t_start = time.monotonic()
+    try:
+        cfg = LlamaConfig.small(max_seq_len=512, n_heads=6, n_kv_heads=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        B, Tp, new = 8, 64, 128
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (B, Tp), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        fn = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new))
+        out = fn(params, prompt)
+        jax.block_until_ready(out)
+        int(out[0, -1])  # scalar readback: actual completion
+        reps = 3
+        t0 = time.monotonic()
+        for _ in range(reps):
+            out = fn(params, prompt)
+        jax.block_until_ready(out)
+        int(out[0, -1])
+        dt = (time.monotonic() - t0) / reps
+        return {
+            "decode_tokens_per_s": B * new / dt,
+            "decode_batch": B,
+            "decode_new_tokens": new,
+            "decode_measure_s": time.monotonic() - t_start,
+        }
+    except Exception as exc:
+        print(json.dumps({"warning": f"decode measurement failed: {exc}"}),
               file=sys.stderr)
         return None
 
@@ -413,6 +455,7 @@ def main():
     _healthcheck()
     workload = measure_workload()
     mfu = measure_mfu() or {}
+    decode = measure_decode() or {}
     pipeline = model_upgrade_pipeline()
 
     # the resumed job re-warms from the persistent compilation cache
@@ -440,7 +483,7 @@ def main():
         "tflops": round(mfu.get("mfu_tflops", workload["tflops"]), 2),
         "tokens_per_s": round(workload["tokens_per_s"], 1),
     }
-    detail = {**workload, **mfu, **pipeline,
+    detail = {**workload, **mfu, **decode, **pipeline,
               "baseline_downtime_s": round(baseline_downtime, 2)}
     print(json.dumps(detail), file=sys.stderr)
     print(json.dumps(result))

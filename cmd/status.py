@@ -51,8 +51,8 @@ def collect_status(client, component: str, namespace: str, selector):
         try:
             ds_hash[ds.metadata.uid] = daemonset_revision_hash(
                 client, ds, revisions=revisions)
-        except ValueError:
-            pass  # rendered as "?" below
+        except (ValueError, KeyError):
+            pass  # no revisions / unlabeled revision — rendered as "?"
     rows = []
     for pod in client.list_pods(namespace=namespace, label_selector=selector):
         if not pod.spec.node_name:

@@ -127,8 +127,16 @@ class LeaderElector:
                     # outlives the lease duration has leadership truly
                     # lapsed (client-go's renew-deadline semantics).
                     logger.exception("leader-election tick failed")
+                    # demote at a renew DEADLINE strictly inside the lease
+                    # (client-go: renewDeadline < leaseDuration): a standby
+                    # acquires only after the full lease, so the margin —
+                    # two retry periods, covering our own polling lag —
+                    # guarantees the old holder has stepped down first;
+                    # equal thresholds would allow a dual-leader window
+                    deadline = max(self.retry_period,
+                                   self._duration - 2 * self.retry_period)
                     lapsed = (self._clock.now() - self._last_renew_ok
-                              > self._duration)
+                              > deadline)
                     if self._is_leader and lapsed:
                         self._is_leader = False
                         if self._on_lost is not None:

@@ -154,3 +154,20 @@ def test_w605_respects_noqa(tmp_path):
     flagged = run_lint(tmp_path, 'p = "\\d+"\n')
     assert codes(flagged) == ["W605"]
     assert run_lint(tmp_path, 'p = "\\d+"  # noqa\n') == []
+
+
+def test_state_diagram_svg_is_current(tmp_path):
+    """The checked-in state-diagram SVG must match what the generator
+    emits from the live state list — regenerate after pipeline changes
+    (the reference's PNG went stale; ours cannot). Generates to a temp
+    path so the checked-in file is never touched, even on failure."""
+    svg = REPO / "docs" / "images" / "driver-upgrade-state-diagram.svg"
+    fresh = tmp_path / "diagram.svg"
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_state_diagram.py"),
+         str(fresh)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert svg.read_text() == fresh.read_text(), (
+        "docs/images/driver-upgrade-state-diagram.svg is stale; run "
+        "python tools/gen_state_diagram.py and commit the result")

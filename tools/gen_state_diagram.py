@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import sys
 from pathlib import Path
+from typing import Optional
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
@@ -77,7 +78,7 @@ def arrow(x1, y1, x2, y2):
             f'stroke-width="1.5" marker-end="url(#arr)"/>')
 
 
-def main() -> None:
+def main(out_path: Optional[str] = None) -> None:
     parts = [
         f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" height="{H}" '
         f'viewBox="0 0 {W} {H}" font-family="Helvetica,Arial,sans-serif">',
@@ -152,11 +153,12 @@ def main() -> None:
         'stroke-dasharray="5,4" marker-end="url(#arr)"/>')
     parts.append("</svg>")
 
-    out = REPO / "docs" / "images" / "driver-upgrade-state-diagram.svg"
+    out = (Path(out_path) if out_path
+           else REPO / "docs" / "images" / "driver-upgrade-state-diagram.svg")
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text("".join(parts) + "\n")
     print(f"wrote {out}")
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv[1] if len(sys.argv) > 1 else None)
