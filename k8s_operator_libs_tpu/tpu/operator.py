@@ -58,11 +58,17 @@ class TPUOperator:
         # one state manager per component — instance-scoped keys make this
         # possible in one process (unlike the reference's DriverName global)
         self.managers: Dict[str, ClusterUpgradeStateManager] = {}
+        all_keys = {comp.name: KeyFactory(comp.name) for comp in components}
         for comp in components:
+            # sibling_keys: the other components on the same nodes — the
+            # state machine coordinates admission attribution and uncordon
+            # deferral across them (see upgrade_state.py SIBLING_BLOCKING)
             mgr = ClusterUpgradeStateManager(
-                client, KeyFactory(comp.name), recorder,
+                client, all_keys[comp.name], recorder,
                 clock or RealClock(), grouper=TPUSliceGrouper(),
-                group_policy=group_policy, synchronous=synchronous)
+                group_policy=group_policy, synchronous=synchronous,
+                sibling_keys=[k for name, k in all_keys.items()
+                              if name != comp.name])
             if comp.policy.pod_deletion is not None:
                 # delete exactly the pods holding TPU chips before drain
                 mgr.with_pod_deletion_enabled(tpu_workload_deletion_filter)

@@ -56,6 +56,14 @@ logger = logging.getLogger(__name__)
 
 TRUE_STRING = "true"
 
+# Sibling states that still require the node out of service: everything in
+# progress EXCEPT uncordon-required — a sibling merely waiting to uncordon
+# must not block ours, or two finished components deadlock each other.
+# FAILED stays blocking: a node whose other driver is broken must not
+# return to service.
+SIBLING_BLOCKING = tuple(s for s in UpgradeState.IN_PROGRESS
+                         if s != UpgradeState.UNCORDON_REQUIRED)
+
 
 @dataclasses.dataclass
 class NodeUpgradeState:
@@ -103,7 +111,8 @@ class ClusterUpgradeStateManager:
                  drain_manager: Optional[DrainManager] = None,
                  pod_manager: Optional[PodManager] = None,
                  validation_manager: Optional[ValidationManager] = None,
-                 safe_load_manager: Optional[SafeDriverLoadManager] = None):
+                 safe_load_manager: Optional[SafeDriverLoadManager] = None,
+                 sibling_keys: Optional[List[KeyFactory]] = None):
         self.client = client
         self.keys = keys
         self.recorder = recorder
@@ -125,6 +134,20 @@ class ClusterUpgradeStateManager:
             self.node_upgrade_state_provider, keys)
         self._pod_deletion_enabled = False
         self._validation_enabled = False
+        # Multi-component coordination (no reference analog — the
+        # DriverName global forbids it there, and two INDEPENDENT
+        # reference operators managing different drivers can deadlock or
+        # uncordon each other's nodes). ``sibling_keys`` names the OTHER
+        # components managed on the same nodes; the machine then (a) does
+        # not blame a cordon the sibling caused on the administrator at
+        # admission (no initial-unschedulable annotation — both components
+        # recording each other's cordon and skipping uncordon forever is
+        # the deadlock), and (b) holds its own uncordon while a sibling
+        # still needs the node down (uncordoning under a sibling's drain
+        # would put a node back in service mid-upgrade). TPUOperator wires
+        # this from its component list; the default (None) preserves exact
+        # reference behavior.
+        self._sibling_keys = list(sibling_keys or [])
 
     # ------------------------------------------------------ builder options
 
@@ -258,8 +281,12 @@ class ClusterUpgradeStateManager:
             if (not is_synced and not is_orphaned) or waiting_safe_load or is_requested:
                 # Remember pre-upgrade unschedulable state so uncordon can be
                 # skipped at the end (:512-523); batched with the state label
-                # into one patch + one cache barrier.
-                if ns.node.spec.unschedulable:
+                # into one patch + one cache barrier. A cordon attributable
+                # to a sibling component's in-flight upgrade is TRANSIENT —
+                # recording it would make this component skip uncordon too
+                # (mutual-skip deadlock when both see each other's cordon).
+                if (ns.node.spec.unschedulable
+                        and not self._sibling_caused_cordon(ns.node)):
                     require_cordoned.append(ns.node)
                 else:
                     require_plain.append(ns.node)
@@ -488,6 +515,12 @@ class ClusterUpgradeStateManager:
                         "node %s waiting at group uncordon barrier (group %s)",
                         ns.node.metadata.name, group.key)
                     continue
+            if self._sibling_needs_node_down(ns.node):
+                # another managed component still needs this node out of
+                # service; retry next pass once its pipeline finishes
+                logger.info("node %s uncordon deferred: sibling component "
+                            "mid-upgrade", ns.node.metadata.name)
+                continue
             self.cordon_manager.uncordon(ns.node)
             uncordoned.append(ns.node)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
@@ -503,6 +536,25 @@ class ClusterUpgradeStateManager:
         ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
             ns.driver_daemonset)
         return pod_hash == ds_hash, False
+
+    def _sibling_needs_node_down(self, node: Node) -> bool:
+        """True while ANOTHER managed component's pipeline still requires
+        this node out of service (uncordon gate)."""
+        return any(node.metadata.labels.get(k.state_label) in SIBLING_BLOCKING
+                   for k in self._sibling_keys)
+
+    def _sibling_caused_cordon(self, node: Node) -> bool:
+        """Admission attribution: the node's cordon is the SIBLING'S doing —
+        sibling mid-pipeline AND the sibling did NOT itself record the
+        cordon as pre-existing. If the sibling carries its own
+        initial-unschedulable annotation, the cordon predates the sibling's
+        upgrade too (an administrator's), and this component must record it
+        as well or the admin's maintenance cordon would be removed when the
+        pipelines finish."""
+        return any(
+            node.metadata.labels.get(k.state_label) in SIBLING_BLOCKING
+            and k.initial_state_annotation not in node.metadata.annotations
+            for k in self._sibling_keys)
 
     def _is_upgrade_requested(self, node: Node) -> bool:
         return (node.metadata.annotations.get(

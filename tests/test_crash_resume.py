@@ -30,7 +30,10 @@ from k8s_operator_libs_tpu.tpu.topology import (
     TPUSliceGrouper,
 )
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
-from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManager
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
 
 NS = "kube-system"
 DRIVER_LABELS = {"app": "libtpu"}
@@ -88,7 +91,14 @@ def drive_until_converged(cluster, keys, clock, node_names, rng,
             synchronous=True)
         try:
             for _ in range(100):
-                state = mgr.build_state(NS, DRIVER_LABELS)
+                try:
+                    state = mgr.build_state(NS, DRIVER_LABELS)
+                except BuildStateError:
+                    # crash left deleted driver pods the DS controller has
+                    # not recreated yet; BuildState refuses the partial
+                    # snapshot BY DESIGN — play the controller and retry
+                    cluster.reconcile_daemonsets()
+                    continue
                 mgr.apply_state(state, policy)
                 cluster.reconcile_daemonsets()
                 check_slice_invariant(cluster, keys, node_names,
@@ -190,3 +200,134 @@ def test_slice_fleet_converges_through_crashes(cluster, keys, clock, seed):
     pods = cluster.client.direct().list_pods(namespace=NS)
     assert sorted(p.metadata.labels["controller-revision-hash"]
                   for p in pods) == ["v2"] * 4
+
+
+def test_two_component_fleet_converges_through_crashes(cluster, keys, clock):
+    """Two components (libtpu + device-plugin) on the same nodes, operator
+    crashing at random write counts across BOTH state machines: each
+    component's label namespace stays consistent and both converge."""
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+    ds_a = cluster.add_daemonset("libtpu", namespace=NS,
+                                 labels={"app": "libtpu"}, revision_hash="v1")
+    ds_b = cluster.add_daemonset("plugin", namespace=NS,
+                                 labels={"app": "plugin"}, revision_hash="v1")
+    names = []
+    for i in range(3):
+        name = f"node{i}"
+        cluster.add_node(name)
+        cluster.add_pod(f"libtpu-{name}", name, namespace=NS, owner_ds=ds_a,
+                        revision_hash="v1")
+        cluster.add_pod(f"plugin-{name}", name, namespace=NS, owner_ds=ds_b,
+                        revision_hash="v1")
+        names.append(name)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    cluster.bump_daemonset_revision("plugin", NS, "v2")
+
+    comps = [("libtpu", {"app": "libtpu"}, KeyFactory("libtpu")),
+             ("plugin", {"app": "plugin"}, KeyFactory("plugin"))]
+
+    def siblings_of(own_key):
+        return [k for _, _, k in comps if k is not own_key]
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    rng = random.Random(42)
+
+    def all_done():
+        return all(fleet_done(cluster, k, names) for _, _, k in comps)
+
+    for incarnation in range(300):
+        budget = {"left": rng.randrange(0, 16),
+                  "post": bool(rng.getrandbits(1))}
+        client = CrashingClient(cluster.client, budget)
+        mgrs = [(labels, ClusterUpgradeStateManager(
+                    client, k, cluster.recorder, clock, synchronous=True,
+                    sibling_keys=siblings_of(k)))
+                for _, labels, k in comps]
+        try:
+            for _ in range(100):
+                for labels, mgr in mgrs:
+                    try:
+                        state = mgr.build_state(NS, labels)
+                    except BuildStateError:
+                        continue  # partial snapshot; DS controller below
+                    mgr.apply_state(state, policy)
+                cluster.reconcile_daemonsets()
+                for _, _, k in comps:
+                    check_node_invariant(cluster, k, names)
+                if all_done():
+                    break
+            if all_done():
+                break
+        except OperatorCrash:
+            for _, _, k in comps:
+                check_node_invariant(cluster, k, names)
+            continue
+    else:
+        raise AssertionError("two-component fleet never converged")
+    for _, labels, _ in comps:
+        pods = cluster.client.direct().list_pods(namespace=NS,
+                                                 label_selector=labels)
+        assert sorted(p.metadata.labels["controller-revision-hash"]
+                      for p in pods) == ["v2"] * 3
+    for name in names:
+        assert not cluster.client.direct().get_node(name).spec.unschedulable
+
+
+def test_admin_cordon_survives_staggered_two_component_upgrade(cluster, clock):
+    """An administrator's maintenance cordon must survive even when the two
+    components' upgrades are STAGGERED: the second component sees the first
+    mid-pipeline on an already-cordoned node, but the first's own
+    initial-unschedulable annotation proves the cordon predates both — so
+    the second records it too and neither uncordons."""
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+    ka, kb = KeyFactory("libtpu"), KeyFactory("plugin")
+    ds_a = cluster.add_daemonset("libtpu", namespace=NS,
+                                 labels={"app": "libtpu"}, revision_hash="v1")
+    ds_b = cluster.add_daemonset("plugin", namespace=NS,
+                                 labels={"app": "plugin"}, revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("libtpu-n0", "n0", namespace=NS, owner_ds=ds_a,
+                    revision_hash="v1")
+    cluster.add_pod("plugin-n0", "n0", namespace=NS, owner_ds=ds_b,
+                    revision_hash="v1")
+    # admin cordons the node for maintenance BEFORE any upgrade
+    cluster.client.direct().patch_node_unschedulable("n0", True)
+    cluster.flush_cache()
+
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    mgr_a = ClusterUpgradeStateManager(
+        cluster.client, ka, cluster.recorder, clock, synchronous=True,
+        sibling_keys=[kb])
+    mgr_b = ClusterUpgradeStateManager(
+        cluster.client, kb, cluster.recorder, clock, synchronous=True,
+        sibling_keys=[ka])
+
+    # stagger: only libtpu is bumped first and advances mid-pipeline
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    for _ in range(3):
+        mgr_a.apply_state(mgr_a.build_state(NS, {"app": "libtpu"}), policy)
+        cluster.reconcile_daemonsets()
+    # THEN the plugin is bumped while libtpu holds the node cordoned
+    cluster.bump_daemonset_revision("plugin", NS, "v2")
+    for _ in range(40):
+        mgr_a.apply_state(mgr_a.build_state(NS, {"app": "libtpu"}), policy)
+        mgr_b.apply_state(mgr_b.build_state(NS, {"app": "plugin"}), policy)
+        cluster.reconcile_daemonsets()
+        n = cluster.client.direct().get_node("n0")
+        sa = n.metadata.labels.get(ka.state_label, "")
+        sb = n.metadata.labels.get(kb.state_label, "")
+        if sa == sb == UpgradeState.DONE:
+            break
+    else:
+        raise AssertionError(f"never converged: {sa!r} {sb!r}")
+    n = cluster.client.direct().get_node("n0")
+    assert n.spec.unschedulable, \
+        "admin's maintenance cordon was removed by a staggered upgrade"
+    pods = cluster.client.direct().list_pods(namespace=NS)
+    assert sorted(p.metadata.labels["controller-revision-hash"]
+                  for p in pods) == ["v2"] * 2

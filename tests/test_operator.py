@@ -139,6 +139,8 @@ def test_two_component_concurrent_upgrade_same_slice(cluster, clock):
     keys_a = KeyFactory("libtpu")
     keys_b = KeyFactory("tpu-device-plugin")
 
+    down_states = ("drain-required", "pod-restart-required",
+                   "validation-required", "upgrade-failed")
     uncordon_count = {h: 0 for h in hosts}
     prev_unsched = {h: False for h in hosts}
     converged = False
@@ -155,6 +157,11 @@ def test_two_component_concurrent_upgrade_same_slice(cluster, clock):
             # both components' state labels live side by side on the node
             sa = n.metadata.labels.get(keys_a.state_label, "")
             sb = n.metadata.labels.get(keys_b.state_label, "")
+            # CROSS-COMPONENT invariant: neither component's uncordon may
+            # put the node in service while the OTHER is past its drain
+            # point (sibling_in_progress gate in upgrade_state.py)
+            assert not ((sa in down_states or sb in down_states)
+                        and not n.spec.unschedulable), (h, sa, sb)
             if not (sa == sb == "upgrade-done"):
                 done = False
         if done:
