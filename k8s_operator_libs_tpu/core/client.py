@@ -39,6 +39,11 @@ class WatchError(RuntimeError):
     the informer cache and cmd/operator.py's watch loop both do."""
 
 
+class ExpiredError(WatchError):
+    """410 Gone: the requested resourceVersion predates the server's replay
+    window. The only recovery is a fresh LIST (informer re-list path)."""
+
+
 class Client(abc.ABC):
     """Cached read / write client (controller-runtime client.Client analog)."""
 

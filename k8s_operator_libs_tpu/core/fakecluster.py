@@ -28,7 +28,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from .client import (Client, ConflictError, EventRecorder, NotFoundError,
+from .client import (Client, ConflictError, EventRecorder, ExpiredError,
+                     NotFoundError,
                      TooManyRequestsError, make_event)
 from .objects import (
     ContainerStatus,
@@ -97,6 +98,14 @@ class FakeCluster:
         self._cache: Dict[Key, object] = {}
         self._crds: Dict[str, dict] = {}
         self._watchers: List["queue.Queue"] = []
+        # watch replay: bounded event history (rv, etype, kind, obj) so a
+        # client can resume from a resourceVersion instead of re-listing
+        # (controller-runtime informer protocol); RVs at/below
+        # _history_floor have been compacted away → 410 Gone on resume
+        self._history: List[Tuple[int, str, str, object]] = []
+        self._history_floor = 0
+        self._history_limit = 4096
+        self._last_rv = 0
         # PDB simulation: {(ns, name): remaining 429s} — see block_eviction
         self._eviction_blocks: Dict[Tuple[str, str], int] = {}
         self.recorder = FakeRecorder()
@@ -119,13 +128,46 @@ class FakeCluster:
                 self._watchers.remove(q)
 
     def _notify(self, event_type: str, kind: str, obj) -> None:
+        try:
+            rv = int(obj.metadata.resource_version)
+        except (TypeError, ValueError):
+            rv = self._last_rv
+        self._history.append((rv, event_type, kind, deep_copy(obj)))
+        if len(self._history) > self._history_limit:
+            dropped = self._history[:-self._history_limit]
+            self._history = self._history[-self._history_limit:]
+            self._history_floor = dropped[-1][0]
         for q in list(self._watchers):
             q.put((event_type, kind, deep_copy(obj)))
+
+    def current_rv(self) -> str:
+        """The collection resourceVersion a LIST response reports."""
+        with self._lock:
+            return str(self._last_rv)
+
+    def events_since(self, rv: str) -> List[Tuple[str, str, object]]:
+        """Replay events with resourceVersion strictly greater than ``rv``
+        (the watch resume protocol). Raises :class:`ExpiredError` when the
+        requested version predates the history window — the real
+        apiserver's 410 Gone."""
+        try:
+            floor = int(rv)
+        except (TypeError, ValueError):
+            raise ExpiredError(f"invalid resourceVersion {rv!r}")
+        with self._lock:
+            if floor < self._history_floor:
+                raise ExpiredError(
+                    f"too old resource version: {floor} "
+                    f"({self._history_floor})")
+            return [(etype, kind, deep_copy(obj))
+                    for erv, etype, kind, obj in self._history
+                    if erv > floor]
 
     # ------------------------------------------------------------------ store
 
     def _bump(self, obj) -> None:
-        obj.metadata.resource_version = str(next(self._version))
+        self._last_rv = next(self._version)
+        obj.metadata.resource_version = str(self._last_rv)
 
     def _publish(self, key: Key, obj: Optional[object]) -> None:
         """Queue the new state for the cached view after cache_lag."""
@@ -190,6 +232,9 @@ class FakeCluster:
             gone = self._store[key]
             del self._store[key]
             self._publish(key, None)
+            # the real apiserver's DELETED event carries a fresh
+            # resourceVersion (an etcd revision); replay ordering needs it
+            self._bump(gone)
             self._notify("DELETED", kind, gone)
 
     def get(self, kind: str, namespace: str, name: str, cached: bool = False):
@@ -222,6 +267,18 @@ class FakeCluster:
                     continue
                 out.append(deep_copy(obj))
             return out
+
+    def list_with_rv(self, kind: str, namespace: Optional[str] = None,
+                     label_selector: Optional[Dict[str, str]] = None
+                     ) -> Tuple[List[object], str]:
+        """Snapshot + the collection resourceVersion, read atomically under
+        ONE lock — reading them separately lets a concurrent write land
+        between them, producing a list that claims an RV it does not
+        contain (the resume protocol would then skip that write forever)."""
+        with self._lock:
+            return (self.list(kind, namespace=namespace,
+                              label_selector=label_selector),
+                    str(self._last_rv))
 
     # ----------------------------------------------------- object conveniences
     #

@@ -95,14 +95,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _list(self, kind: str, namespace: Optional[str], qs: Dict) -> None:
         sel = _parse_label_selector(qs)
-        objs = self.cluster.list(kind, namespace=namespace,
-                                 label_selector=sel)
+        # snapshot + RV atomically: a separate current_rv() read could
+        # postdate the snapshot and make the watch skip the gap forever
+        objs, rv = self.cluster.list_with_rv(kind, namespace=namespace,
+                                             label_selector=sel)
         field = qs.get("fieldSelector", [None])[0]
         if field and field.startswith("spec.nodeName="):
             want = field.split("=", 1)[1]
             objs = [o for o in objs if o.spec.node_name == want]
         self._send(200, serde.list_to_json(
-            kind, [_TO_JSON[kind](o) for o in objs]))
+            kind, [_TO_JSON[kind](o) for o in objs], resource_version=rv))
 
     def _get_one(self, kind: str, namespace: str, name: str) -> None:
         try:
@@ -234,7 +236,31 @@ class _Handler(BaseHTTPRequestHandler):
                                f"lease {ns}/{name} not found")
         self._send(200, serde.lease_to_json(lease))
 
+    _MICROTIME_RE = re.compile(
+        r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z$")
+
+    def _check_lease_microtime(self, body: Dict) -> bool:
+        """Real-apiserver strictness: LeaseSpec acquireTime/renewTime are
+        metav1.MicroTime and MUST carry exactly six fractional digits
+        (RFC3339Micro). client-go and kubectl always emit that format;
+        second-precision values are rejected with 400, which is how a real
+        cluster surfaces the ADVICE r2 serialization bug the lenient fake
+        used to hide."""
+        spec = body.get("spec") or {}
+        for field in ("acquireTime", "renewTime"):
+            val = spec.get(field)
+            if val is not None and not self._MICROTIME_RE.match(str(val)):
+                self._error(
+                    400, "BadRequest",
+                    f'unable to decode spec.{field}: parsing time "{val}" '
+                    f'as "2006-01-02T15:04:05.000000Z07:00": cannot parse '
+                    f'"{str(val)[19:]}" as ".000000"')
+                return False
+        return True
+
     def _create_lease(self, ns: str, body: Dict) -> None:
+        if not self._check_lease_microtime(body):
+            return
         lease = serde.lease_from_json(body)
         lease.metadata.namespace = ns
         try:
@@ -244,6 +270,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(201, serde.lease_to_json(created))
 
     def _update_lease(self, ns: str, name: str, body: Dict) -> None:
+        if not self._check_lease_microtime(body):
+            return
         lease = serde.lease_from_json(body)
         lease.metadata.namespace = ns
         lease.metadata.name = name
@@ -286,19 +314,68 @@ class _Handler(BaseHTTPRequestHandler):
         """Streaming watch: one JSON object per line, connection held open
         until ``timeoutSeconds`` (default 30) or client disconnect — the
         real apiserver's chunked watch shape (client-go reconnects on
-        timeout; so does our client)."""
+        timeout; so does our client).
+
+        Resume protocol: ``resourceVersion=N`` replays buffered events with
+        RV > N before streaming live ones; a version older than the replay
+        window gets the real apiserver's 410 Gone as an ERROR event.
+        ``allowWatchBookmarks=true`` emits a BOOKMARK carrying the current
+        collection RV at window end, so an idle client's resume point stays
+        fresh."""
         import json as _json
         import queue as _queue
         import time as _time
+
+        from .client import ExpiredError
         sel = _parse_label_selector(qs)
         timeout = float(qs.get("timeoutSeconds", ["30"])[0])
-        q = self.cluster.subscribe()
+        rv_param = qs.get("resourceVersion", [None])[0]
+        bookmarks = qs.get("allowWatchBookmarks", ["false"])[0] == "true"
+
+        def matches(ekind, obj) -> bool:
+            if ekind != kind:
+                return False
+            if namespace is not None and obj.metadata.namespace != namespace:
+                return False
+            return not sel or all(obj.metadata.labels.get(k) == v
+                                  for k, v in sel.items())
+
+        def write_line(payload: Dict) -> None:
+            self.wfile.write(_json.dumps(payload).encode() + b"\n")
+            self.wfile.flush()
+
+        q = self.cluster.subscribe()  # subscribe BEFORE replay: no gap
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.flush()
+            # events already in the replay buffer are also about to arrive
+            # on the queue if they raced the subscribe; dedup by RV floor.
+            # max_seen tracks the highest RV OBSERVED on this stream
+            # (replayed or dequeued, matching or not) — the only safe
+            # bookmark value: the global current_rv() could exceed events
+            # still sitting undelivered in our queue, and bookmarking past
+            # them would skip them forever.
+            max_seen = 0
+            if rv_param and rv_param != "0":
+                try:
+                    events = self.cluster.events_since(rv_param)
+                except ExpiredError as exc:
+                    write_line({"type": "ERROR", "object": {
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure", "reason": "Expired",
+                        "code": 410, "message": str(exc)}})
+                    return
+                max_seen = int(rv_param)
+                for etype, ekind, obj in events:
+                    rv = int(obj.metadata.resource_version)
+                    max_seen = max(max_seen, rv)
+                    if matches(ekind, obj):
+                        write_line({"type": etype,
+                                    "object": _TO_JSON[kind](obj)})
+            replayed_past = max_seen
             deadline = _time.monotonic() + timeout
             while True:
                 remaining = deadline - _time.monotonic()
@@ -308,17 +385,21 @@ class _Handler(BaseHTTPRequestHandler):
                     etype, ekind, obj = q.get(timeout=min(remaining, 0.25))
                 except _queue.Empty:
                     continue
-                if ekind != kind:
+                try:
+                    rv = int(obj.metadata.resource_version)
+                except (TypeError, ValueError):
+                    rv = None
+                if rv is not None:
+                    if rv <= replayed_past:
+                        continue  # already replayed from the buffer
+                    max_seen = max(max_seen, rv)
+                if not matches(ekind, obj):
                     continue
-                if namespace is not None and obj.metadata.namespace != namespace:
-                    continue
-                if sel and not all(obj.metadata.labels.get(k) == v
-                                   for k, v in sel.items()):
-                    continue
-                line = _json.dumps({"type": etype,
-                                    "object": _TO_JSON[kind](obj)})
-                self.wfile.write(line.encode() + b"\n")
-                self.wfile.flush()
+                write_line({"type": etype, "object": _TO_JSON[kind](obj)})
+            if bookmarks and max_seen > 0:
+                write_line({"type": "BOOKMARK", "object": {
+                    "kind": kind,
+                    "metadata": {"resourceVersion": str(max_seen)}}})
         except (BrokenPipeError, ConnectionResetError):
             pass  # client hung up — normal watch termination
         finally:

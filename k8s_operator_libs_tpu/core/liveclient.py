@@ -37,12 +37,13 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import yaml
 
 from . import serde
-from .client import (Client, ConflictError, NotFoundError, TooManyRequestsError,
+from .client import (Client, ConflictError, ExpiredError, NotFoundError,
+                     TooManyRequestsError,
                      WatchError)  # noqa: F401  (WatchError re-export)
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
 
@@ -299,7 +300,14 @@ class KubeHTTP:
 
 def _check_watch_error(ev: Dict) -> None:
     if ev.get("type") == "ERROR":
-        raise WatchError(str(ev.get("object")))
+        obj = ev.get("object") or {}
+        if isinstance(obj, dict) and obj.get("code") == 410:
+            raise ExpiredError(str(obj))
+        raise WatchError(str(obj))
+
+
+def _list_rv(j: Dict) -> str:
+    return str((j.get("metadata") or {}).get("resourceVersion", "") or "")
 
 
 def _selector_params(label_selector: Optional[Dict[str, str]] = None,
@@ -334,9 +342,17 @@ class LiveClient(Client):
             self._http.request("GET", f"/api/v1/nodes/{name}"))
 
     def list_nodes(self, label_selector=None) -> List[Node]:
+        return self.list_nodes_with_rv(label_selector)[0]
+
+    def list_nodes_with_rv(self, label_selector=None
+                           ) -> Tuple[List[Node], str]:
+        """LIST plus the collection resourceVersion (ListMeta) — the resume
+        point the informer hands to the next watch (controller-runtime
+        ListWatch protocol)."""
         j = self._http.request("GET", "/api/v1/nodes",
                                params=_selector_params(label_selector))
-        return [serde.node_from_json(i) for i in j.get("items", [])]
+        return ([serde.node_from_json(i) for i in j.get("items", [])],
+                _list_rv(j))
 
     def get_pod(self, namespace: str, name: str) -> Pod:
         return serde.pod_from_json(self._http.request(
@@ -344,19 +360,30 @@ class LiveClient(Client):
 
     def list_pods(self, namespace=None, label_selector=None,
                   field_node_name=None) -> List[Pod]:
+        return self.list_pods_with_rv(namespace, label_selector,
+                                      field_node_name)[0]
+
+    def list_pods_with_rv(self, namespace=None, label_selector=None,
+                          field_node_name=None) -> Tuple[List[Pod], str]:
         path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
                 else "/api/v1/pods")
         j = self._http.request("GET", path, params=_selector_params(
             label_selector, field_node_name))
-        return [serde.pod_from_json(i) for i in j.get("items", [])]
+        return ([serde.pod_from_json(i) for i in j.get("items", [])],
+                _list_rv(j))
 
     def list_daemonsets(self, namespace=None,
                         label_selector=None) -> List[DaemonSet]:
+        return self.list_daemonsets_with_rv(namespace, label_selector)[0]
+
+    def list_daemonsets_with_rv(self, namespace=None, label_selector=None
+                                ) -> Tuple[List[DaemonSet], str]:
         path = (f"/apis/apps/v1/namespaces/{namespace}/daemonsets"
                 if namespace else "/apis/apps/v1/daemonsets")
         j = self._http.request("GET", path,
                                params=_selector_params(label_selector))
-        return [serde.daemonset_from_json(i) for i in j.get("items", [])]
+        return ([serde.daemonset_from_json(i) for i in j.get("items", [])],
+                _list_rv(j))
 
     def list_controller_revisions(self, namespace=None, label_selector=None
                                   ) -> List[ControllerRevision]:
@@ -374,43 +401,63 @@ class LiveClient(Client):
     # ------------------------------------------------------------- watch
 
     def _watch_stream(self, path: str, from_json,
-                      label_selector=None, timeout_seconds: float = 30.0):
-        """Shared watch protocol: one ("ADDED"|"MODIFIED"|"DELETED", obj)
-        per line until the server ends the window (controller-runtime
-        informer analog: consumers loop, reconnecting per window). ERROR
-        events (410 Gone) raise :class:`WatchError` → consumers re-list."""
+                      label_selector=None, timeout_seconds: float = 30.0,
+                      resource_version: Optional[str] = None,
+                      allow_bookmarks: bool = False):
+        """Shared watch protocol: one ("ADDED"|"MODIFIED"|"DELETED"|
+        "BOOKMARK", obj) per line until the server ends the window
+        (controller-runtime informer analog: consumers loop, reconnecting
+        per window). ``resource_version`` resumes from a prior LIST/event
+        RV so nothing is missed between windows; ``allow_bookmarks``
+        requests BOOKMARK events (objects carrying only a fresh RV) so an
+        idle watch's resume point doesn't expire. ERROR events raise
+        :class:`WatchError` — 410 Gone specifically raises
+        :class:`ExpiredError` → consumers re-list."""
         params = _selector_params(label_selector) or {}
         params.update({"watch": "true",
                        # int string: the real apiserver ParseInts this
                        "timeoutSeconds": str(int(timeout_seconds))})
+        if resource_version:
+            params["resourceVersion"] = str(resource_version)
+        if allow_bookmarks:
+            params["allowWatchBookmarks"] = "true"
         for ev in self._http.stream_lines(path, params,
                                           read_timeout=timeout_seconds + 30):
             _check_watch_error(ev)
             yield ev.get("type", ""), from_json(ev.get("object") or {})
 
-    def watch_nodes(self, label_selector=None, timeout_seconds: float = 30.0):
+    def watch_nodes(self, label_selector=None, timeout_seconds: float = 30.0,
+                    resource_version: Optional[str] = None,
+                    allow_bookmarks: bool = False):
         return self._watch_stream("/api/v1/nodes", serde.node_from_json,
-                                  label_selector, timeout_seconds)
+                                  label_selector, timeout_seconds,
+                                  resource_version, allow_bookmarks)
 
     def watch_pods(self, namespace: Optional[str] = None,
-                   label_selector=None, timeout_seconds: float = 30.0):
+                   label_selector=None, timeout_seconds: float = 30.0,
+                   resource_version: Optional[str] = None,
+                   allow_bookmarks: bool = False):
         """Driver-pod recreation is what unblocks pod-restart-required, so
         operators watch their pods as well as nodes."""
         path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
                 else "/api/v1/pods")
         return self._watch_stream(path, serde.pod_from_json,
-                                  label_selector, timeout_seconds)
+                                  label_selector, timeout_seconds,
+                                  resource_version, allow_bookmarks)
 
     def watch_daemonsets(self, namespace: Optional[str] = None,
                          label_selector=None,
-                         timeout_seconds: float = 30.0):
+                         timeout_seconds: float = 30.0,
+                         resource_version: Optional[str] = None,
+                         allow_bookmarks: bool = False):
         """The informer cache watches driver DaemonSets so revision bumps
         appear without polling (reference: the controller-runtime cache
         informs on every GVK it reads — upgrade_state.go:127-130)."""
         path = (f"/apis/apps/v1/namespaces/{namespace}/daemonsets"
                 if namespace else "/apis/apps/v1/daemonsets")
         return self._watch_stream(path, serde.daemonset_from_json,
-                                  label_selector, timeout_seconds)
+                                  label_selector, timeout_seconds,
+                                  resource_version, allow_bookmarks)
 
     # ------------------------------------------------------------ writes
 

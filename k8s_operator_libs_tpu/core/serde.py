@@ -35,15 +35,35 @@ def _ts_to_rfc3339(ts: Optional[float]) -> Optional[str]:
     return time.strftime(RFC3339, time.gmtime(ts))
 
 
+def _ts_to_rfc3339_micro(ts: Optional[float]) -> Optional[str]:
+    """RFC3339Micro — exactly six fractional digits. coordination.k8s.io/v1
+    declares Lease acquireTime/renewTime as metav1.MicroTime, which a real
+    apiserver parses STRICTLY in this format; second-precision values get
+    HTTP 400 ('cannot parse "Z" as ".000000"')."""
+    if ts is None:
+        return None
+    # integer microseconds with carry: round(.9999996s) must roll into the
+    # seconds, not wrap to .000000 of the PREVIOUS second
+    sec, usec = divmod(round(ts * 1_000_000), 1_000_000)
+    return (time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(sec))
+            + ".%06dZ" % usec)
+
+
 def _rfc3339_to_ts(s: Optional[str]) -> Optional[float]:
     if not s:
         return None
     try:
         # calendar.timegm, NOT mktime: the timestamp is UTC and mktime would
         # apply the local (possibly DST-shifted) offset
-        return float(calendar.timegm(time.strptime(s[:19] + "Z", RFC3339)))
+        ts = float(calendar.timegm(time.strptime(s[:19] + "Z", RFC3339)))
     except ValueError:
         return None
+    # preserve fractional seconds (MicroTime round-trip fidelity)
+    if len(s) > 19 and s[19] == ".":
+        frac = s[20:].rstrip("Zz")
+        if frac.isdigit():
+            ts += int(frac) / (10.0 ** len(frac))
+    return ts
 
 
 # ------------------------------------------------------------------ meta
@@ -288,10 +308,12 @@ def lease_to_json(lease: Lease) -> Dict:
         "leaseDurationSeconds": lease.spec.lease_duration_seconds,
         "leaseTransitions": lease.spec.lease_transitions,
     }
+    # MicroTime fields, NOT metav1.Time: a real apiserver rejects
+    # second-precision RFC3339 here with HTTP 400 (ADVICE r2)
     if lease.spec.acquire_time is not None:
-        spec["acquireTime"] = _ts_to_rfc3339(lease.spec.acquire_time)
+        spec["acquireTime"] = _ts_to_rfc3339_micro(lease.spec.acquire_time)
     if lease.spec.renew_time is not None:
-        spec["renewTime"] = _ts_to_rfc3339(lease.spec.renew_time)
+        spec["renewTime"] = _ts_to_rfc3339_micro(lease.spec.renew_time)
     return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
             "metadata": meta_to_json(lease.metadata), "spec": spec}
 
@@ -311,5 +333,10 @@ def lease_from_json(j: Dict) -> Lease:
             lease_transitions=int(spec_j.get("leaseTransitions") or 0)))
 
 
-def list_to_json(kind: str, items: List[Dict]) -> Dict:
-    return {"apiVersion": "v1", "kind": f"{kind}List", "items": items}
+def list_to_json(kind: str, items: List[Dict],
+                 resource_version: Optional[str] = None) -> Dict:
+    out = {"apiVersion": "v1", "kind": f"{kind}List", "items": items}
+    if resource_version is not None:
+        # the collection RV a watch resumes from (ListMeta.resourceVersion)
+        out["metadata"] = {"resourceVersion": resource_version}
+    return out

@@ -54,7 +54,6 @@ def make_sp_loss(cfg: LlamaConfig, mesh: Mesh, axis_name: str = "seq",
 
     def shard_loss(params, inputs, targets):
         # inputs/targets: local chunks [B, Tl]
-        n = jax.lax.psum(1, axis_name)
         my = jax.lax.axis_index(axis_name)
         B, Tl = inputs.shape
         positions = my * Tl + jnp.broadcast_to(

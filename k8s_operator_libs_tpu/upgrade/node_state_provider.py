@@ -102,11 +102,14 @@ class NodeUpgradeStateProvider:
             labels = {self._keys.state_label: label_value}
         patched_annos = {k: (None if v == NULL else v)
                          for k, v in (annotations or {}).items()}
+        rv_floor = {}
         for node in nodes:
             with self._mutex.lock(node.metadata.name):
-                self._client.patch_node_metadata(
+                patched = self._client.patch_node_metadata(
                     node.metadata.name, labels=labels,
                     annotations=patched_annos or None)
+            rv_floor[node.metadata.name] = getattr(
+                patched.metadata, "resource_version", "") if patched else ""
 
         def synced(n: Node) -> bool:
             if labels is not None and (
@@ -116,7 +119,8 @@ class NodeUpgradeStateProvider:
             return all(n.metadata.annotations.get(k) == v
                        for k, v in patched_annos.items())
 
-        self._wait_synced_many({n.metadata.name for n in nodes}, synced)
+        self._wait_synced_many({n.metadata.name for n in nodes}, synced,
+                               rv_floor)
 
         for node in nodes:
             if labels is not None:
@@ -145,27 +149,47 @@ class NodeUpgradeStateProvider:
 
     # --------------------------------------------------------------- barrier
 
-    def _wait_synced_many(self, names, pred) -> None:
+    def _wait_synced_many(self, names, pred, rv_floor=None) -> None:
         """Poll-until-visible (:92-117) over a set of nodes: the individual
         writes' cache lags overlap inside one wait. Raises
         CacheSyncTimeoutError after sync_timeout — the reference returns an
         error, failing the current ApplyState pass; the next reconcile
         retries idempotently.
 
+        A node is also considered synced when the cached object's
+        resourceVersion has reached or passed ``rv_floor`` (the version our
+        patch produced) even though the written values no longer match: a
+        concurrent writer — e.g. an async DrainManager thread moving the
+        node to upgrade-failed — superseded our write between the patch and
+        the poll. The barrier's contract is "the next reconcile sees a state
+        at least as new as this write", which supersession satisfies;
+        requiring the exact values would turn that benign race into a
+        CacheSyncTimeoutError failing the whole batch (ADVICE r2).
+
         Polling is ADAPTIVE where the reference's is fixed-1 s: start at
         sync_poll/20 and back off x2 to sync_poll. Same contract (bounded by
         sync_timeout, poll-until-visible), far lower added latency — informer
         caches typically sync in tens of ms."""
         pending = set(names)
+        rv_floor = rv_floor or {}
         deadline = self._clock.now() + self._sync_timeout
         poll = self._sync_poll / 20.0
         while pending:
             for name in list(pending):
                 try:
-                    if pred(self._client.get_node(name)):
-                        pending.discard(name)
+                    n = self._client.get_node(name)
                 except KeyError:
-                    pass  # node not in cache yet
+                    continue  # node not in cache yet
+                if pred(n):
+                    pending.discard(name)
+                elif self._rv_at_least(n.metadata.resource_version,
+                                       rv_floor.get(name)):
+                    logger.info(
+                        "node %s: write superseded by a concurrent writer "
+                        "(cache at resourceVersion %s >= patch %s); barrier "
+                        "satisfied", name, n.metadata.resource_version,
+                        rv_floor.get(name))
+                    pending.discard(name)
             if not pending:
                 break
             if self._clock.now() >= deadline:
@@ -174,3 +198,16 @@ class NodeUpgradeStateProvider:
                     f"{sorted(pending)} within {self._sync_timeout}s")
             self._clock.sleep(poll)
             poll = min(poll * 2.0, self._sync_poll)
+
+    @staticmethod
+    def _rv_at_least(observed, floor) -> bool:
+        """True when the cache's resourceVersion is at/past the patch's.
+        resourceVersions are opaque strings in the API contract, but both
+        real etcd and the in-repo fakes emit monotonically increasing
+        integers; anything non-numeric falls back to exact-match-only."""
+        if not observed or not floor:
+            return False
+        try:
+            return int(observed) >= int(floor)
+        except (TypeError, ValueError):
+            return False

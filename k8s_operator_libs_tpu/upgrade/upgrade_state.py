@@ -151,11 +151,11 @@ class ClusterUpgradeStateManager:
 
     # ------------------------------------------------------ builder options
 
-    def with_pod_deletion_enabled(self, filter: PodDeletionFilter
+    def with_pod_deletion_enabled(self, deletion_filter: PodDeletionFilter
                                   ) -> "ClusterUpgradeStateManager":
         """WithPodDeletionEnabled (:155-165): turn on the optional
         pod-deletion state with the consumer-supplied filter."""
-        self.pod_manager._filter = filter
+        self.pod_manager._filter = deletion_filter
         self._pod_deletion_enabled = True
         return self
 
@@ -310,8 +310,12 @@ class ClusterUpgradeStateManager:
         Admission is per *group*: a group is admitted only when every member
         is in upgrade-required (slice atomicity), and consumes one throttle
         slot per member node. Already-cordoned nodes bypass the throttle
-        (:606-616); `upgrade.skip`-labeled nodes are skipped (:601-604);
-        the upgrade-requested annotation is cleared on processing (:594-600).
+        (:606-616); the upgrade-requested annotation is cleared on
+        processing (:594-600). An `upgrade.skip`-labeled node is skipped
+        (:601-604) — and because a multi-host slice cannot atomically
+        upgrade *around* one host, a skip label on ANY member holds the
+        WHOLE group in upgrade-required with a Warning event (the
+        single-node case degenerates to exact reference behavior).
         Oversized-group deadlock is broken per GroupPolicy (SURVEY §7.4)."""
         bucket = state.bucket(UpgradeState.UPGRADE_REQUIRED)
         in_progress = self.get_upgrades_in_progress(state)
@@ -322,15 +326,37 @@ class ClusterUpgradeStateManager:
             if self._is_upgrade_requested(ns.node):
                 self.node_upgrade_state_provider.change_node_upgrade_annotation(
                     ns.node, self.keys.upgrade_requested_annotation, NULL)
-            if self._skip_node_upgrade(ns.node):
-                logger.info("node %s is marked for skipping upgrades",
-                            ns.node.metadata.name)
-                continue
             key = self.grouper.group_key(ns.node)
             if key in processed:
                 continue
             processed.add(key)
             group = groups[key]
+            # The skip check is group-scoped, not node-scoped: checking only
+            # the per-node label would let admission triggered by a sibling
+            # member cordon the skipped host anyway (the group collects
+            # members by state label alone below).
+            skip_nodes = [m.node.metadata.name for m in group.members
+                          if self._skip_node_upgrade(m.node)]
+            if skip_nodes:
+                if group.size == 1:
+                    logger.info("node %s is marked for skipping upgrades",
+                                ns.node.metadata.name)
+                else:
+                    logger.warning(
+                        "group %s held in upgrade-required: member node(s) %s "
+                        "carry the %s=true skip label and a multi-host slice "
+                        "upgrades atomically",
+                        group.key, ",".join(skip_nodes),
+                        self.keys.skip_node_label)
+                    log_event(
+                        self.recorder, ns.node, "Warning",
+                        self.keys.event_reason,
+                        f"Holding upgrade of group {group.key}: node(s) "
+                        f"{','.join(skip_nodes)} carry the "
+                        f"{self.keys.skip_node_label}=true label; a "
+                        f"multi-host slice cannot upgrade around one host — "
+                        f"remove the label to resume")
+                continue
             # Slice atomicity: a group may start only when every member's
             # intent is known — members are upgrade-required themselves,
             # already current (done: they'll wait at the group barriers), or

@@ -159,6 +159,62 @@ def test_background_renewal_outlives_long_reconcile():
         stop.set()
 
 
+def test_lease_times_serialize_as_microtime():
+    """ADVICE r2 (high): LeaseSpec acquireTime/renewTime are
+    metav1.MicroTime — a real apiserver strictly requires RFC3339Micro
+    (exactly six fractional digits); second-precision values are rejected
+    with 400. Round-trip must preserve microseconds."""
+    import re
+
+    from k8s_operator_libs_tpu.core.objects import Lease, LeaseSpec, ObjectMeta
+    from k8s_operator_libs_tpu.core.serde import lease_from_json, lease_to_json
+
+    micro = re.compile(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{6}Z$")
+    ts = 1769900000.123456
+    j = lease_to_json(Lease(
+        metadata=ObjectMeta(name="l", namespace="ns"),
+        spec=LeaseSpec(holder_identity="a", lease_duration_seconds=15,
+                       acquire_time=ts, renew_time=ts)))
+    assert micro.match(j["spec"]["acquireTime"]), j["spec"]["acquireTime"]
+    assert micro.match(j["spec"]["renewTime"]), j["spec"]["renewTime"]
+    back = lease_from_json(j)
+    assert abs(back.spec.renew_time - ts) < 1e-6
+    assert abs(back.spec.acquire_time - ts) < 1e-6
+    # whole-second timestamps still carry the six-digit fraction
+    j2 = lease_to_json(Lease(
+        metadata=ObjectMeta(name="l", namespace="ns"),
+        spec=LeaseSpec(holder_identity="a", renew_time=1769900000.0)))
+    assert j2["spec"]["renewTime"].endswith(".000000Z")
+    # microsecond rollover carries into the seconds (not one second stale)
+    from k8s_operator_libs_tpu.core.serde import _ts_to_rfc3339_micro
+    assert _ts_to_rfc3339_micro(1.9999996) == "1970-01-01T00:00:02.000000Z"
+
+
+def test_fake_apiserver_rejects_second_precision_lease_times():
+    """The HTTP fake now enforces real-apiserver MicroTime strictness, so
+    the lenient-parse hole that hid the ADVICE r2 bug is closed."""
+    import json as jsonlib
+    import urllib.request
+
+    from k8s_operator_libs_tpu.core.httpapi import FakeAPIServer
+
+    cluster = FakeCluster()
+    with FakeAPIServer(cluster) as srv:
+        body = jsonlib.dumps({
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "l", "namespace": "ns"},
+            "spec": {"holderIdentity": "a", "leaseDurationSeconds": 15,
+                     "renewTime": "2026-07-30T10:00:00Z"}}).encode()
+        req = urllib.request.Request(
+            srv.base_url + "/apis/coordination.k8s.io/v1/namespaces/ns/leases",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req)
+        assert exc_info.value.code == 400
+        assert ".000000" in exc_info.value.read().decode()
+
+
 def test_lease_serde_tolerates_explicit_nulls():
     """A lease another client released can carry JSON nulls in any spec
     field (they are optional pointers in the real API)."""

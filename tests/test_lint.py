@@ -40,7 +40,7 @@ class C:
 
     def method(self, x: Optional[int] = None) -> "C":
         y = os.getcwd()
-        return [y for y in (1, 2) if y]
+        return [c for c in y if c]
 
 def outer():
     z = 1
@@ -154,6 +154,136 @@ def test_w605_respects_noqa(tmp_path):
     flagged = run_lint(tmp_path, 'p = "\\d+"\n')
     assert codes(flagged) == ["W605"]
     assert run_lint(tmp_path, 'p = "\\d+"  # noqa\n') == []
+
+
+def test_unused_local_flagged(tmp_path):
+    src = "def f():\n    x = compute()\n    return 1\ndef compute():\n    return 2\n"
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["F841"] and "'x'" in found[0]
+
+
+def test_unused_local_exemptions(tmp_path):
+    """No F841 for: underscore names, tuple unpacking, loop variables,
+    with-as targets, closure reads from nested scopes, augmented
+    assignment, module-level names, global-declared writes."""
+    src = '''
+import contextlib
+
+MODULE_LEVEL = 1  # module scope: not a local
+
+def f(pairs):
+    _ = ignored()
+    a, b = pairs        # tuple unpacking exempt
+    for i in range(3):  # loop variable exempt
+        pass
+    with contextlib.suppress(Exception) as cm:  # with-as exempt
+        pass
+    captured = b
+    def inner():
+        return captured  # closure read counts as a use
+    total = 0
+    total += a           # augassign is a use
+    return inner, total
+
+def g():
+    global MODULE_LEVEL
+    MODULE_LEVEL = 2     # escapes the scope
+
+def ignored():
+    return None
+'''
+    assert run_lint(tmp_path, src) == []
+
+
+def test_def_redefinition_flagged(tmp_path):
+    src = "def f():\n    return 1\ndef f():\n    return 2\n"
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["F811"] and "line 1" in found[0]
+
+
+def test_class_method_redefinition_flagged(tmp_path):
+    src = ("class C:\n    def m(self):\n        return 1\n"
+           "    def m(self):\n        return 2\n")
+    assert codes(run_lint(tmp_path, src)) == ["F811"]
+
+
+def test_import_then_def_redefinition_flagged(tmp_path):
+    src = "import json\ndef json():\n    return 1\n"
+    assert codes(run_lint(tmp_path, src)) == ["F811"]
+
+
+def test_decorated_def_over_unused_import_keeps_f401(tmp_path):
+    """An exempt (decorated) redefinition must not swallow the unused-import
+    finding: F811 stays silent but F401 still fires."""
+    src = ("import functools\nimport json\n"
+           "@functools.cache\ndef json():\n    return 1\n")
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["F401"] and "json" in found[0]
+
+
+def test_decorated_redefinition_exempt(tmp_path):
+    """@property/@x.setter pairs and conditional defs must not fire."""
+    src = '''
+class C:
+    @property
+    def x(self):
+        return self._x
+
+    @x.setter
+    def x(self, v):
+        self._x = v
+
+try:
+    def impl():
+        return "fast"
+except ImportError:
+    def impl():
+        return "slow"
+
+def used():
+    return 1
+
+_ = used()
+
+def used():  # redefinition AFTER a use: allowed
+    return 2
+'''
+    assert run_lint(tmp_path, src) == []
+
+
+def test_unused_local_eval_guard(tmp_path):
+    """A local read only through eval/exec must not fire F841 (same
+    soundness guard as F821)."""
+    src = 'def f():\n    x = 1\n    return eval("x")\n'
+    assert run_lint(tmp_path, src) == []
+
+
+def test_shadowed_builtin_assignment(tmp_path):
+    found = run_lint(tmp_path, "def f():\n    list = [1]\n    return list\n")
+    assert codes(found) == ["A001"] and "'list'" in found[0]
+
+
+def test_shadowed_builtin_argument(tmp_path):
+    found = run_lint(tmp_path, "def f(filter):\n    return filter\n")
+    assert codes(found) == ["A002"]
+
+
+def test_shadowed_builtin_exemptions(tmp_path):
+    """Class attributes (behind self./cls.), underscore names and non-
+    builtin names stay silent; a builtin-shadowing local that IS used must
+    not additionally fire F841."""
+    src = '''
+class Model:
+    id = 0          # class attribute: exempt (A003 territory, skipped)
+
+    def get(self):  # method NAME is a class attribute too
+        return self.id
+
+def f(_input=None):
+    value = 1
+    return value, _input
+'''
+    assert run_lint(tmp_path, src) == []
 
 
 def test_state_diagram_svg_is_current(tmp_path):

@@ -735,7 +735,7 @@ def test_status_cli_reports_table_and_exit_codes(tmp_path, capsys):
     status = _load_cli("status")
 
     cluster = FakeCluster()
-    ds = _seed(cluster)
+    _seed(cluster)
     with FakeAPIServer(cluster) as srv:
         kc_path = tmp_path / "kc"
         kc_path.write_text(yaml.safe_dump({

@@ -106,6 +106,46 @@ def test_batched_write_visible_before_return_and_single_barrier(keys):
     assert elapsed < 8 * 0.2, f"batched barrier serialized: {elapsed}s"
 
 
+def test_barrier_tolerates_superseding_concurrent_write(keys):
+    """ADVICE r2: a concurrent writer (async DrainManager moving the node to
+    upgrade-failed) that lands between our patch and the barrier poll must
+    NOT turn into a CacheSyncTimeoutError — the cache reaching a
+    resourceVersion at/past our patch satisfies the visibility contract."""
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("node1")
+    cluster.flush_cache()
+
+    class RacingClient:
+        """Delegates to the cached client, but immediately after OUR patch a
+        'drain thread' overwrites the state label via the direct client —
+        exactly the window the barrier polls in."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def patch_node_metadata(self, name, labels=None, annotations=None):
+            patched = self._inner.patch_node_metadata(
+                name, labels=labels, annotations=annotations)
+            cluster.client.direct().patch_node_metadata(
+                name, labels={keys.state_label: UpgradeState.FAILED})
+            cluster.flush_cache()
+            return patched
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    provider = NodeUpgradeStateProvider(
+        RacingClient(cluster.client), keys, clock=clock,
+        sync_timeout=10.0, sync_poll=1.0)
+    node = cluster.client.direct().get_node("node1")
+    # previously: CacheSyncTimeoutError (exact-value predicate never true)
+    provider.change_node_upgrade_state(node, UpgradeState.POD_RESTART_REQUIRED)
+    # the superseding write is what the cluster records
+    assert (cluster.client.get_node("node1").metadata.labels[keys.state_label]
+            == UpgradeState.FAILED)
+
+
 def test_batched_write_empty_is_noop(cluster, keys, provider):
     provider.change_nodes_state_and_annotations([], UpgradeState.DONE)
     cluster.add_node("node1")

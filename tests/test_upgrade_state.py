@@ -79,7 +79,7 @@ def test_build_state_buckets_nodes_by_label(cluster, keys, clock):
 
 
 def test_build_state_rejects_unscheduled_daemonset_pods(cluster, keys, clock):
-    ds = setup_fleet(cluster, 1)
+    setup_fleet(cluster, 1)
     # desired 2, only 1 pod exists
     cur = cluster.get("DaemonSet", NS, "driver")
     cur.status.desired_number_scheduled = 2
@@ -179,9 +179,6 @@ def test_max_parallel_upgrades(cluster, keys, clock, max_parallel,
                                expected_cordoned):
     setup_fleet(cluster, 4, revision="rev-2", pod_revision="rev-1")
     mgr = make_manager(cluster, keys, clock)
-    policy = DriverUpgradePolicySpec(auto_upgrade=True,
-                                     max_parallel_upgrades=max_parallel,
-                                     max_unavailable="100%")
     state = mgr.build_state(NS, DRIVER_LABELS)
     mgr.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
     state = mgr.build_state(NS, DRIVER_LABELS)

@@ -11,7 +11,14 @@ of that surface:
         comprehensions, global/nonlocal, builtins)
   F401  unused import (module scope; `as _`, __init__ re-exports and
         __all__ entries exempt)
-  F811  import shadowed by another import of the same name
+  F811  redefinition without use: an import shadowed by another import, or
+        a module/class-level def/class redefining an earlier def/class/
+        import of the same name (decorated defs — @property/@overload
+        pairs — and conditional/try-fallback definitions exempt)
+  F841  local variable assigned but never used (function scopes; simple
+        `name = ...` targets only — tuple unpacking, loop variables,
+        `with ... as`, except-handler names and `_`-prefixed names exempt;
+        closure reads from nested scopes count as uses)
   B006  mutable default argument (list/dict/set literal)
   E722  bare `except:`
   F541  f-string without any placeholders
@@ -19,6 +26,9 @@ of that surface:
   F631  assert on a non-empty tuple literal (always true)
   F602  duplicate literal key in a dict display
   W605  invalid escape sequence in a plain (non-raw) string literal
+  A001  name binding shadows a Python builtin (module/function scopes;
+        class attributes exempt — they live behind `self.`/`cls.`)
+  A002  function argument shadows a Python builtin
 
 Usage: python tools/lint.py [paths...]   (default: package + cmd + tests +
 bench.py + __graft_entry__.py). Exit 1 on any finding. A finding can be
@@ -53,6 +63,11 @@ class Scope:
         self.nonlocals: Set[str] = set()
         self.has_star_import = False
         self.uses_exec = False
+        # F841 bookkeeping (function scopes): first plain-assignment
+        # position per name, and every name a load resolved to here —
+        # including loads from scopes nested inside this one (closures)
+        self.assign_pos: Dict[str, int] = {}
+        self.loaded: Set[str] = set()
 
     def chain_has_star_or_exec(self) -> bool:
         s: Optional[Scope] = self
@@ -79,6 +94,10 @@ class Checker(ast.NodeVisitor):
         # walk, when use positions are known)
         self.import_events: List[Tuple[int, str, str, bool]] = []
         self.name_use_lines: Dict[str, List[int]] = {}
+        # every Name load in the file, for the F811 redefinition check
+        self.all_use_lines: Dict[str, List[int]] = {}
+        self._redef_checks: List[List[Tuple[int, str, bool, bool]]] = []
+        self.redefined_imports: Set[str] = set()
         self.is_init = path.endswith("__init__.py")
         self.dunder_all: Set[str] = set()
 
@@ -112,6 +131,8 @@ class Checker(ast.NodeVisitor):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             scope.bindings.add(node.name)
+            self._check_builtin_shadow(scope, node.name, node.lineno,
+                                       what="definition of")
             return  # nested scope bodies handled separately
         if isinstance(node, (ast.Lambda,)):
             return
@@ -146,19 +167,41 @@ class Checker(ast.NodeVisitor):
             return
         if isinstance(node, ast.Assign):
             for t in node.targets:
-                scope.bindings.update(self._target_names(t))
-        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                names = self._target_names(t)
+                scope.bindings.update(names)
+                # F841 considers only simple `name = ...` targets: tuple
+                # unpacking is idiomatically allowed to discard values
+                if isinstance(t, ast.Name) and scope.kind == "function":
+                    scope.assign_pos.setdefault(t.id, node.lineno)
+                for n in names:
+                    self._check_builtin_shadow(scope, n, node.lineno)
+        elif isinstance(node, ast.AnnAssign):
             scope.bindings.update(self._target_names(node.target))
+            if (isinstance(node.target, ast.Name)
+                    and scope.kind == "function" and node.value is not None):
+                scope.assign_pos.setdefault(node.target.id, node.lineno)
+            for n in self._target_names(node.target):
+                self._check_builtin_shadow(scope, n, node.lineno)
+        elif isinstance(node, ast.AugAssign):
+            # `x += 1` both reads and writes x: a use, never an F841 seed
+            scope.bindings.update(self._target_names(node.target))
+            scope.loaded.update(self._target_names(node.target))
         elif isinstance(node, (ast.For, ast.AsyncFor)):
-            scope.bindings.update(self._target_names(node.target))
+            names = self._target_names(node.target)
+            scope.bindings.update(names)
+            for n in names:
+                self._check_builtin_shadow(scope, n, node.lineno)
         elif isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 if item.optional_vars is not None:
-                    scope.bindings.update(
-                        self._target_names(item.optional_vars))
+                    names = self._target_names(item.optional_vars)
+                    scope.bindings.update(names)
+                    for n in names:
+                        self._check_builtin_shadow(scope, n, node.lineno)
         elif isinstance(node, ast.ExceptHandler):
             if node.name:
                 scope.bindings.add(node.name)
+                self._check_builtin_shadow(scope, node.name, node.lineno)
         elif isinstance(node, (ast.Match,)):
             for case in node.cases:
                 for n in ast.walk(case.pattern):
@@ -189,6 +232,18 @@ class Checker(ast.NodeVisitor):
             self.import_events.append((lineno, name, full, in_try))
             self.import_positions[name] = (lineno, full)
         scope.bindings.add(name)
+        self._check_builtin_shadow(scope, name, lineno, what="import of")
+
+    def _check_builtin_shadow(self, scope: Scope, name: str, lineno: int,
+                              what: str = "assignment to") -> None:
+        """A001: a module- or function-scope binding hides a builtin for
+        everything below it. Class-scope attributes are exempt (accessed
+        through self./cls., never bare)."""
+        if scope.kind in ("class", "comprehension"):
+            return
+        if name.startswith("_") or name not in BUILTINS:
+            return
+        self.report(lineno, "A001", f"{what} {name!r} shadows a builtin")
 
     def _check_import_shadowing(self) -> None:
         """F811: a module-scope import redefines an earlier import of the
@@ -216,8 +271,8 @@ class Checker(ast.NodeVisitor):
     # ---------------------------------------------------------- resolving
 
     def resolve(self, scope: Scope, name: str) -> bool:
-        if name in BUILTINS:
-            return True
+        # scope chain FIRST, builtins last: a local shadowing a builtin must
+        # still be marked loaded or F841 would misreport it unused
         s: Optional[Scope] = scope
         first = True
         while s is not None:
@@ -231,10 +286,11 @@ class Checker(ast.NodeVisitor):
                 first = False
                 continue
             if name in s.bindings:
-                return True
+                s.loaded.add(name)  # F841: resolved loads are uses,
+                return True         # including closure reads from children
             first = False
             s = s.parent
-        return False
+        return name in BUILTINS
 
     # --------------------------------------------------------- scope walk
 
@@ -246,9 +302,86 @@ class Checker(ast.NodeVisitor):
                       + ([args.vararg] if args.vararg else [])
                       + ([args.kwarg] if args.kwarg else [])):
                 scope.bindings.add(a.arg)
+                if not a.arg.startswith("_") and a.arg in BUILTINS \
+                        and a.arg != "self":
+                    self.report(a.lineno, "A002",
+                                f"argument {a.arg!r} shadows a builtin")
         self.bind_scope(scope, body)
+        self._collect_def_events(scope, body)
         for stmt in body:
             self._walk_expr_container(scope, stmt)
+        if scope.kind == "function" and not scope.chain_has_star_or_exec():
+            # F841: every nested scope below has been walked by now, so
+            # closure reads have already landed in scope.loaded. eval/exec
+            # or star-imports anywhere in the chain make use analysis
+            # unsound — same guard as F821.
+            for name, lineno in sorted(scope.assign_pos.items(),
+                                       key=lambda kv: kv[1]):
+                if name in scope.loaded or name.startswith("_"):
+                    continue
+                if name in scope.globals or name in scope.nonlocals:
+                    continue  # writes escape the scope
+                self.report(lineno, "F841",
+                            f"local variable {name!r} assigned but "
+                            "never used")
+
+    def _collect_def_events(self, scope: Scope,
+                            body: List[ast.stmt]) -> None:
+        """Record direct-child def/class definitions of module and class
+        bodies for the post-walk F811 redefinition check. Indirect children
+        (under if/try — conditional or fallback definitions) are not
+        collected, so they are exempt by construction."""
+        if scope.kind not in ("module", "class"):
+            return
+        # (line, name, decorated, is_import)
+        events: List[Tuple[int, str, bool, bool]] = []
+        if scope is self.module_scope:
+            # submodule imports (`import urllib.error` + `import
+            # urllib.request`) complement each other — same exemption as
+            # the import-vs-import F811 check
+            events.extend((line, name, False, True)
+                          for line, name, full, in_try
+                          in self.import_events
+                          if not in_try and "." not in full)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                events.append((stmt.lineno, stmt.name,
+                               bool(stmt.decorator_list), False))
+        if events:
+            self._redef_checks.append(events)
+
+    def _check_def_redefinition(self) -> None:
+        """F811 beyond imports (resolved after the walk, when use positions
+        are known): an undecorated def/class redefining an earlier same-name
+        def/class/import in the same module/class body with no use in
+        between. Decorated defs (@property/@x.setter/@overload chains) are
+        exempt."""
+        for events in self._redef_checks:
+            by_name: Dict[str, List[Tuple[int, bool, bool]]] = {}
+            for line, name, decorated, is_import in sorted(events):
+                by_name.setdefault(name, []).append(
+                    (line, decorated, is_import))
+            for name, evs in by_name.items():
+                uses = self.all_use_lines.get(name, [])
+                for (prev_line, _, prev_imp), (line, decorated, is_imp) \
+                        in zip(evs, evs[1:]):
+                    if is_imp:
+                        continue  # import-vs-import handled by the import
+                    #             F811 check; def-then-import left alone
+                    if decorated:
+                        continue
+                    if any(prev_line < u <= line for u in uses):
+                        continue
+                    if prev_imp:
+                        # a def redefining an import supersedes the
+                        # import's F401 — but only when the F811 finding
+                        # actually replaces it (an exempt redefinition must
+                        # not swallow the F401)
+                        self.redefined_imports.add(name)
+                    self.report(line, "F811",
+                                f"redefinition of {name!r} shadows unused "
+                                f"definition on line {prev_line}")
 
     def _walk_expr_container(self, scope: Scope, node: ast.AST) -> None:
         """Visit `node` attributing Name loads to `scope`, descending into
@@ -311,6 +444,14 @@ class Checker(ast.NodeVisitor):
             return
         if isinstance(node, ast.Name):
             if isinstance(node.ctx, ast.Load):
+                self.all_use_lines.setdefault(node.id, []).append(
+                    node.lineno)
+                if node.id in ("eval", "exec"):
+                    # a dynamic-evaluation use ANYWHERE in the scope makes
+                    # name-use analysis unsound (F821 + F841 guard) — the
+                    # statement-level detection in _bind_stmt only sees
+                    # bare `exec(...)` expression statements
+                    scope.uses_exec = True
                 if node.id in self.import_positions:
                     self.import_uses.add(node.id)
                     self.name_use_lines.setdefault(node.id, []).append(
@@ -435,6 +576,7 @@ class Checker(ast.NodeVisitor):
         assert isinstance(tree, ast.Module)
         self.check_scope(self.module_scope, tree.body)
         self._check_import_shadowing()
+        self._check_def_redefinition()
         # unused imports: module scope, skipped for __init__.py (re-export
         # surface), names in __all__, underscore names, and future imports
         if not self.is_init:
@@ -442,6 +584,8 @@ class Checker(ast.NodeVisitor):
                                                key=lambda kv: kv[1][0]):
                 if name in self.import_uses or name in self.dunder_all:
                     continue
+                if name in self.redefined_imports:
+                    continue  # F811 already reports the redefinition
                 if name.startswith("_") or full == "__future__":
                     continue
                 self.report(lineno, "F401", f"unused import {name!r}")
