@@ -18,8 +18,37 @@ Two measured halves, combined into one downtime number:
    readiness). The modelled clock advances through the same cache-sync
    barriers and per-state passes a real operator would execute.
 
-Downtime = checkpoint-save (real) + slice-unavailable window (modelled
-pipeline, cordon→uncordon) + restore (real) + re-warmup (real).
+Downtime formula (r3, VERDICT r2 #2 — the drain checkpoint's slow half
+OVERLAPS the unavailability window instead of serializing with it):
+
+    downtime = ckpt_fetch_s
+               + max(ckpt_write_s, window_to_restart_s)
+               + window_after_restart_s + ckpt_restore_s + rewarmup_s
+
+where ckpt_save_s is split into its two physical phases:
+
+- ``ckpt_fetch_s`` — device→host transfer (timed jax.device_get of the
+  train state). SERIAL: it needs the live TPU runtime, so it must finish
+  before the job releases the device and before any driver teardown.
+- ``ckpt_write_s`` = ckpt_save_s − ckpt_fetch_s — the host→storage write.
+  OVERLAPPABLE: once the state is off-device the job hands it to a
+  checkpoint-uploader DaemonSet pod (hostPath spool), exits, and the
+  wait-for-jobs gate opens; the durable write then rides concurrently
+  with eviction + driver restart, because `drain` does NOT evict
+  DaemonSet pods (IgnoreAllDaemonSets — the reference's own drain
+  contract, drain_manager.go:76-96). Crash before the upload lands ⇒ the
+  resumed job falls back to the previous periodic checkpoint — degraded
+  to the uncoordinated baseline, never data loss.
+
+``window_to_restart_s`` (cordon → old libtpu pods evicted) and
+``window_after_restart_s`` (driver restart + plugin ready + uncordon
+barriers) come from the modelled pipeline. Every term is reported
+separately in the detail JSON, so tunnel-throughput variance in the
+checkpoint numbers (observed 40-210 s for identical code) is visible
+rather than folded invisibly into the headline. Note the bench
+environment inflates ckpt_fetch_s (device→host rides a tunnel); on a real
+TPU VM the fetch is PCIe-fast and the write term dominates, which is
+exactly the term the overlap removes from the critical path.
 
 Baseline (vs_baseline): the reference-equivalent *uncoordinated* upgrade —
 the job is killed on drain with no drain-coordinated checkpoint, losing on
@@ -80,6 +109,16 @@ _PEAK_BF16 = (
     ("v4", 275e12),
 )
 
+# HBM bandwidth (bytes/s) per chip generation (public spec sheets) — the
+# decode roofline denominator
+_HBM_BW = (
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v4", 1228e9),
+)
+
 
 def _chip_peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
@@ -87,6 +126,14 @@ def _chip_peak_flops(device) -> float:
         if tag in kind:
             return peak
     return 0.0  # unknown chip / CPU → MFU reported as null
+
+
+def _chip_hbm_bw(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, bw in _HBM_BW:
+        if tag in kind:
+            return bw
+    return 0.0
 
 
 def _model_flops_per_token(cfg, seq_len: int, n_params: int) -> float:
@@ -166,10 +213,21 @@ def measure_workload():
     # (observed 40s..130s for the same 1.5 GB state), so extra reps stop
     # once the time budget is spent rather than blowing the bench deadline.
     import statistics
-    saves, restores = [], []
-    ckpt_budget_s = 200.0
+    saves, restores, fetches = [], [], []
+    # per-rep cost grew by the adjacent fetch measurement; trim the budget
+    # so good-tunnel days still stop at ~2 reps and bad days at 1
+    ckpt_budget_s = 150.0
     ckpt_t0 = time.monotonic()
     for rep in range(3):
+        # device→host fetch alone: the SERIAL half of the drain save (the
+        # write half overlaps the upgrade window — module docstring).
+        # Measured ADJACENT to the save it is subtracted from, once per
+        # rep, so the split rides the same tunnel weather as the save
+        # instead of comparing a lone sample against a median
+        t0 = time.monotonic()
+        _fetched = jax.device_get(state.params)
+        fetches.append(time.monotonic() - t0)
+        del _fetched  # free the host copy before the save
         t0 = time.monotonic()
         trainer.save(state, wait=True)
         saves.append(time.monotonic() - t0)
@@ -188,6 +246,7 @@ def measure_workload():
     trainer.close()
     save_s = statistics.median(saves)
     restore_s = statistics.median(restores)
+    fetch_s = statistics.median(fetches)
     tokens_per_s = batch_shape[0] * (batch_shape[1] - 1) / step_s
     n_params = sum(int(p.size) for p in jax.tree_util.tree_leaves(
         state.params))
@@ -207,6 +266,8 @@ def measure_workload():
         "tflops": achieved / 1e12,
         "mfu": round(achieved / peak, 4) if peak else None,
         "ckpt_save_s": save_s,
+        "ckpt_fetch_s": fetch_s,
+        "ckpt_write_s": max(0.0, save_s - fetch_s),
         "ckpt_restore_s": restore_s,
     }
 
@@ -321,15 +382,100 @@ def measure_mfu():
         return None
 
 
+def measure_mfu_trainer():
+    """MFU of the PRODUCTION training path (VERDICT r2 #3): the exact
+    ``CheckpointingTrainer._step_fn`` the downtime workload runs — adamw
+    with fp32 moments, global-norm clipping, donated jit — at an MXU-worthy
+    shape. Distinct from measure_mfu, which is the kernel-stack ceiling
+    (bf16 params, plain SGD, no moments). The gap between the two is the
+    optimizer-state HBM traffic + fp32 master weights; remat (if engaged by
+    the fallback ladder) additionally costs recompute FLOPs that model-flops
+    MFU deliberately does not credit."""
+    import tempfile
+
+    import jax
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
+
+    if jax.default_backend() != "tpu":
+        return None
+    t_start = time.monotonic()
+    # ladder: remat on from the start — the 760M adamw state (fp32 params
+    # + mu + nu ≈ 9.1 GB) plus no-remat activations measured 18.5 GB on a
+    # 15.75 GB v5e, so the no-remat attempt always OOMs there; remat costs
+    # recompute FLOPs that model-flops MFU honestly does not credit
+    attempts = [{"B": 8, "remat": True}, {"B": 4, "remat": True}]
+    T = 1024
+    for att in attempts:
+        trainer = state = tokens = m = None
+        try:
+            import jax.numpy as jnp
+            cfg = LlamaConfig.bench_mfu(max_seq_len=T, remat=att["remat"])
+            trainer = CheckpointingTrainer(
+                cfg, tempfile.mkdtemp(prefix="bench_mfu_trainer_"),
+                mesh=None, checkpoint_interval=10_000_000)
+            state = trainer.init_or_resume(jax.random.PRNGKey(0))
+            tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                        (att["B"], T + 1), 0,
+                                        cfg.vocab_size, dtype=jnp.int32)
+            state, m = trainer._step_fn(state, tokens)
+            float(m["loss"])  # scalar readback = actual completion
+            n_steps = 10
+            t0 = time.monotonic()
+            for _ in range(n_steps):
+                state, m = trainer._step_fn(state, tokens)
+            float(m["loss"])
+            step_s = (time.monotonic() - t0) / n_steps
+            n_params = sum(int(p.size) for p in jax.tree_util.tree_leaves(
+                state.params))
+            flops_per_token = _model_flops_per_token(cfg, T, n_params)
+            tokens_per_s = att["B"] * T / step_s
+            achieved = tokens_per_s * flops_per_token
+            peak = _chip_peak_flops(jax.devices()[0])
+            trainer.close()
+            return {
+                "mfu_trainer": round(achieved / peak, 4) if peak else None,
+                "mfu_trainer_tflops": achieved / 1e12,
+                "mfu_trainer_tokens_per_s": tokens_per_s,
+                "mfu_trainer_params": n_params,
+                "mfu_trainer_batch": att["B"],
+                "mfu_trainer_remat": att["remat"],
+                "mfu_trainer_measure_s": time.monotonic() - t_start,
+            }
+        except Exception as exc:
+            print(json.dumps({"warning": f"mfu_trainer attempt {att} "
+                                         f"failed: {exc}"}), file=sys.stderr)
+            # free the failed attempt's HBM before the retry: the ~9 GB
+            # adamw state would otherwise stay referenced by these locals
+            # and OOM the smaller attempt too
+            if trainer is not None:
+                try:
+                    trainer.close()
+                except Exception:
+                    pass
+            del trainer, state, tokens, m
+            jax.clear_caches()
+    return None
+
+
 def measure_decode():
-    """KV-cache decode throughput on the attached chip: the inference-side
-    datapoint (single-chip greedy decode on the 125M workload model, batch 8
-    — decode is cache/weight-bandwidth-bound, so tokens/s is the figure of
-    merit). Returns None on failure rather than sinking the bench."""
+    """KV-cache decode throughput on the attached chip, judged against the
+    chip (VERDICT r2 #8): decode streams the whole model + the KV cache
+    once per step, so the HBM-bandwidth roofline is
+
+        roofline_tok/s = B * HBM_BW / (param_bytes + B * kv_bytes(T_avg))
+
+    and ``decode_pct_roofline`` reports how much of it the measured number
+    achieves — comparable across rounds even if the shape changes. Both
+    cache layouts are measured: the contiguous baseline and the paged
+    (block-pool) layout that decouples batch x context from a fixed
+    pre-allocation (models/paged.py). Returns None on failure rather than
+    sinking the bench."""
     import jax
     import jax.numpy as jnp
     from k8s_operator_libs_tpu.models.generate import generate
     from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    from k8s_operator_libs_tpu.models.paged import paged_generate
 
     if jax.default_backend() != "tpu":
         return None
@@ -340,21 +486,48 @@ def measure_decode():
         B, Tp, new = 8, 64, 128
         prompt = jax.random.randint(jax.random.PRNGKey(1), (B, Tp), 0,
                                     cfg.vocab_size, dtype=jnp.int32)
-        fn = jax.jit(lambda p, t: generate(p, t, cfg, max_new_tokens=new))
-        out = fn(params, prompt)
-        jax.block_until_ready(out)
-        int(out[0, -1])  # scalar readback: actual completion
-        reps = 3
-        t0 = time.monotonic()
-        for _ in range(reps):
+
+        def timed(fn):
             out = fn(params, prompt)
-        jax.block_until_ready(out)
-        int(out[0, -1])
-        dt = (time.monotonic() - t0) / reps
+            jax.block_until_ready(out)
+            int(out[0, -1])  # scalar readback: actual completion
+            reps = 3
+            t0 = time.monotonic()
+            for _ in range(reps):
+                out = fn(params, prompt)
+            jax.block_until_ready(out)
+            int(out[0, -1])
+            return B * new / ((time.monotonic() - t0) / reps)
+
+        tok_s = timed(jax.jit(
+            lambda p, t: generate(p, t, cfg, max_new_tokens=new)))
+        paged_tok_s = timed(jax.jit(
+            lambda p, t: paged_generate(p, t, cfg, max_new_tokens=new)))
+
+        # roofline: bytes the chip must stream per decode STEP
+        param_bytes = sum(int(p.size) * p.dtype.itemsize
+                          for p in jax.tree_util.tree_leaves(params))
+        t_avg = Tp + new / 2.0
+        kv_bytes = (2 * cfg.n_layers * t_avg * cfg.n_kv_heads
+                    * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+        bw = _chip_hbm_bw(jax.devices()[0])
+        roofline = (B * bw / (param_bytes + B * kv_bytes)) if bw else None
         return {
-            "decode_tokens_per_s": B * new / dt,
+            "decode_tokens_per_s": tok_s,
+            "decode_paged_tokens_per_s": paged_tok_s,
             "decode_batch": B,
             "decode_new_tokens": new,
+            "decode_param_bytes": param_bytes,
+            "decode_kv_bytes_per_seq": kv_bytes,
+            "decode_bytes_per_token": round(
+                (param_bytes + B * kv_bytes) / B),
+            "decode_hbm_bw_gbs": bw / 1e9 if bw else None,
+            "decode_roofline_tokens_per_s": roofline,
+            "decode_pct_roofline": (round(100.0 * tok_s / roofline, 1)
+                                    if roofline else None),
+            "decode_paged_pct_roofline": (
+                round(100.0 * paged_tok_s / roofline, 1)
+                if roofline else None),
             "decode_measure_s": time.monotonic() - t_start,
         }
     except Exception as exc:
@@ -404,9 +577,9 @@ def model_upgrade_pipeline():
     barrier_count = {"n": 0}
     orig_wait_many = provider._wait_synced_many
 
-    def counting_wait_many(names, pred):
+    def counting_wait_many(names, pred, *args, **kwargs):
         barrier_count["n"] += 1
-        return orig_wait_many(names, pred)
+        return orig_wait_many(names, pred, *args, **kwargs)
 
     provider._wait_synced_many = counting_wait_many
     policy = DriverUpgradePolicySpec(
@@ -414,7 +587,7 @@ def model_upgrade_pipeline():
         wait_for_completion=WaitForCompletionSpec(pod_selector="job=llama-fsdp"),
         drain=DrainSpec(enable=True, force=True, timeout_second=300))
 
-    cordon_t = uncordon_t = None
+    cordon_t = gate_t = uncordon_t = None
     job_exited = False
     driver_restarted = False
     for _ in range(200):
@@ -427,16 +600,23 @@ def model_upgrade_pipeline():
         states = [s for s, _ in snap.values()]
         if cordon_t is None and any(u for _, u in snap.values()):
             cordon_t = clock.now()
-        # the drain-coordinated job checkpoints and exits once cordoned
+        # the drain-coordinated job checkpoints and exits once cordoned;
+        # gate_t marks where the wait-for-jobs gate opens given an instant
+        # save — the real save races the cordon→gate segment (see formula)
         if not job_exited and all(u for _, u in snap.values()):
+            gate_t = clock.now()
             for i in range(SLICE_HOSTS):
                 cluster.set_pod_status("default", f"train-{i:02d}",
                                        phase="Succeeded")
             job_exited = True
         if job_exited and not driver_restarted and not cluster.client.direct(
                 ).list_pods(namespace="kube-system"):
-            # all libtpu pods deleted: model eviction + driver restart
-            clock.advance(EVICTION_S + DRIVER_RESTART_S)
+            # all libtpu pods deleted: eviction finishes the pre-restart
+            # half of the window; driver restart + plugin readiness open
+            # the post-restart half
+            clock.advance(EVICTION_S)
+            restart_t = clock.now()
+            clock.advance(DRIVER_RESTART_S)
             cluster.reconcile_daemonsets()
             clock.advance(PLUGIN_READY_S)
             driver_restarted = True
@@ -447,6 +627,11 @@ def model_upgrade_pipeline():
             break
     assert uncordon_t is not None, "upgrade never converged"
     return {"slice_unavailable_s": uncordon_t - cordon_t,
+            # three window segments (see module docstring): the drain save
+            # overlaps only cordon→gate; the rest is serial
+            "window_to_gate_s": gate_t - cordon_t,
+            "window_gate_to_restart_s": restart_t - gate_t,
+            "window_after_restart_s": uncordon_t - restart_t,
             "pipeline_total_s": uncordon_t,
             "cache_barriers": barrier_count["n"]}
 
@@ -455,13 +640,19 @@ def main():
     _healthcheck()
     workload = measure_workload()
     mfu = measure_mfu() or {}
+    mfu_trainer = measure_mfu_trainer() or {}
     decode = measure_decode() or {}
     pipeline = model_upgrade_pipeline()
 
-    # the resumed job re-warms from the persistent compilation cache
-    # (rewarmup_s), not a cold XLA compile
-    our_downtime = (workload["ckpt_save_s"]
-                    + pipeline["slice_unavailable_s"]
+    # the drain checkpoint's write half overlaps the pre-restart window
+    # (module docstring documents the protocol); the resumed job re-warms
+    # from the persistent compilation cache (rewarmup_s), not a cold
+    # XLA compile
+    window_to_restart = (pipeline["window_to_gate_s"]
+                         + pipeline["window_gate_to_restart_s"])
+    our_downtime = (workload["ckpt_fetch_s"]
+                    + max(workload["ckpt_write_s"], window_to_restart)
+                    + pipeline["window_after_restart_s"]
                     + workload["ckpt_restore_s"]
                     + workload["rewarmup_s"])
     # uncoordinated baseline: same pipeline, but the job is SIGKILLed and
@@ -480,11 +671,16 @@ def main():
         # MFU from the MXU-sized model; the small workload model's figure
         # is in the stderr detail for comparison
         "mfu": mfu.get("mfu", workload["mfu"]),
+        "mfu_trainer": mfu_trainer.get("mfu_trainer"),
         "tflops": round(mfu.get("mfu_tflops", workload["tflops"]), 2),
         "tokens_per_s": round(workload["tokens_per_s"], 1),
     }
-    detail = {**workload, **mfu, **decode, **pipeline,
-              "baseline_downtime_s": round(baseline_downtime, 2)}
+    detail = {**workload, **mfu, **mfu_trainer, **decode, **pipeline,
+              "baseline_downtime_s": round(baseline_downtime, 2),
+              # the overlapped term of the downtime formula, explicit
+              "window_to_restart_s": round(window_to_restart, 2),
+              "downtime_overlapped_term_s": round(
+                  max(workload["ckpt_write_s"], window_to_restart), 2)}
     print(json.dumps(detail), file=sys.stderr)
     print(json.dumps(result))
 

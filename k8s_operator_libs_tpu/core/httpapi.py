@@ -47,16 +47,123 @@ _TO_JSON = {"Node": serde.node_to_json, "Pod": serde.pod_to_json,
             "Job": serde.job_to_json}
 
 
-def _parse_label_selector(qs: Dict) -> Optional[Dict[str, str]]:
+_SET_REQ_RE = re.compile(
+    r"^([A-Za-z0-9._/-]+)\s+(in|notin)\s+\(\s*([^()]*?)\s*\)$")
+_KEY_RE = re.compile(r"^!?[A-Za-z0-9._/-]+$")
+
+
+def _split_requirements(raw: str):
+    """Split a selector on commas NOT inside parentheses — `a in (x,y),b=c`
+    is two requirements."""
+    parts, depth, cur = [], 0, []
+    for ch in raw:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _parse_label_selector(qs: Dict):
+    """The real apiserver's label-selector grammar (labels.Parse):
+    equality (`k=v`, `k==v`, `k!=v`), set (`k in (a,b)`, `k notin (a)`),
+    and existence (`k`, `!k`) requirements, comma-conjoined. Returns a list
+    of (key, op, values) requirements (None = no selector); raises
+    ValueError on malformed input — the route maps that to the real
+    apiserver's 400."""
     raw = qs.get("labelSelector", [None])[0]
     if not raw:
         return None
-    out = {}
-    for part in raw.split(","):
+    reqs = []
+    for part in _split_requirements(raw):
+        m = _SET_REQ_RE.match(part)
+        if m:
+            vals = [v.strip() for v in m.group(3).split(",") if v.strip()]
+            reqs.append((m.group(1), m.group(2), vals))
+            continue
+        if "!=" in part:
+            k, _, v = part.partition("!=")
+            k, v = k.strip(), v.strip()
+            if not _KEY_RE.match(k) or k.startswith("!"):
+                raise ValueError(f"unable to parse requirement: {part!r}")
+            reqs.append((k, "neq", [v]))
+            continue
         if "=" in part:
             k, _, v = part.partition("=")
-            out[k.strip()] = v.strip().lstrip("=")
-    return out
+            k, v = k.strip(), v.strip().lstrip("=").strip()
+            if not _KEY_RE.match(k) or k.startswith("!"):
+                raise ValueError(f"unable to parse requirement: {part!r}")
+            reqs.append((k, "eq", [v]))
+            continue
+        if _KEY_RE.match(part):
+            if part.startswith("!"):
+                reqs.append((part[1:], "nexists", []))
+            else:
+                reqs.append((part, "exists", []))
+            continue
+        raise ValueError(f"unable to parse requirement: {part!r}")
+    return reqs
+
+
+def _match_selector(labels: Dict[str, str], reqs) -> bool:
+    """Real matching semantics worth pinning: `!=` and `notin` also match
+    objects that LACK the key; `in`/`=` require it present."""
+    labels = labels or {}
+    for key, op, vals in reqs:
+        if op == "eq" and labels.get(key) != vals[0]:
+            return False
+        if op == "neq" and key in labels and labels[key] == vals[0]:
+            return False
+        if op == "in" and labels.get(key) not in vals:
+            return False
+        if op == "notin" and key in labels and labels[key] in vals:
+            return False
+        if op == "exists" and key not in labels:
+            return False
+        if op == "nexists" and key in labels:
+            return False
+    return True
+
+
+_FIELD_GETTERS = {
+    "metadata.name": lambda o: o.metadata.name,
+    "metadata.namespace": lambda o: o.metadata.namespace,
+    "spec.nodeName": lambda o: getattr(o.spec, "node_name", ""),
+    "status.phase": lambda o: getattr(getattr(o, "status", None),
+                                      "phase", ""),
+}
+
+
+def _apply_field_selector(objs, raw: Optional[str]):
+    """Comma-conjoined `field=value` / `field!=value` terms over the small
+    set of fields the real apiserver indexes. Unsupported fields raise
+    ValueError → 400 ('field label not supported'), matching a real
+    apiserver rather than silently returning everything."""
+    if not raw:
+        return objs
+    for term in raw.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            field, _, want = term.partition("!=")
+            neq = True
+        else:
+            field, _, want = term.partition("=")
+            neq = False
+        field = field.strip()
+        if field not in _FIELD_GETTERS:
+            raise ValueError(f'field label not supported: "{field}"')
+        getter = _FIELD_GETTERS[field]
+        objs = [o for o in objs
+                if (getter(o) != want if neq else getter(o) == want)]
+    return objs
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -94,15 +201,18 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(n) or b"{}")
 
     def _list(self, kind: str, namespace: Optional[str], qs: Dict) -> None:
-        sel = _parse_label_selector(qs)
-        # snapshot + RV atomically: a separate current_rv() read could
-        # postdate the snapshot and make the watch skip the gap forever
-        objs, rv = self.cluster.list_with_rv(kind, namespace=namespace,
-                                             label_selector=sel)
-        field = qs.get("fieldSelector", [None])[0]
-        if field and field.startswith("spec.nodeName="):
-            want = field.split("=", 1)[1]
-            objs = [o for o in objs if o.spec.node_name == want]
+        try:
+            reqs = _parse_label_selector(qs)
+            # snapshot + RV atomically: a separate current_rv() read could
+            # postdate the snapshot and make the watch skip the gap forever
+            objs, rv = self.cluster.list_with_rv(kind, namespace=namespace)
+            if reqs:
+                objs = [o for o in objs
+                        if _match_selector(o.metadata.labels, reqs)]
+            objs = _apply_field_selector(
+                objs, qs.get("fieldSelector", [None])[0])
+        except ValueError as exc:
+            return self._error(400, "BadRequest", str(exc))
         self._send(200, serde.list_to_json(
             kind, [_TO_JSON[kind](o) for o in objs], resource_version=rv))
 
@@ -203,10 +313,19 @@ class _Handler(BaseHTTPRequestHandler):
         client = self.cluster.client.direct()
         try:
             meta = patch.get("metadata") or {}
+            labels, annotations = meta.get("labels"), meta.get("annotations")
+            # strategic-merge edge: an explicit JSON null for the whole MAP
+            # clears it on a real apiserver (distinct from per-key nulls,
+            # which delete individual keys)
+            if "labels" in meta and labels is None:
+                cur = self.cluster.get("Node", "", name)
+                labels = {k: None for k in cur.metadata.labels}
+            if "annotations" in meta and annotations is None:
+                cur = self.cluster.get("Node", "", name)
+                annotations = {k: None for k in cur.metadata.annotations}
             if "labels" in meta or "annotations" in meta:
                 node = client.patch_node_metadata(
-                    name, labels=meta.get("labels"),
-                    annotations=meta.get("annotations"))
+                    name, labels=labels, annotations=annotations)
             else:
                 node = self.cluster.get("Node", "", name)
             spec = patch.get("spec") or {}
@@ -327,7 +446,10 @@ class _Handler(BaseHTTPRequestHandler):
         import time as _time
 
         from .client import ExpiredError
-        sel = _parse_label_selector(qs)
+        try:
+            reqs = _parse_label_selector(qs)
+        except ValueError as exc:
+            return self._error(400, "BadRequest", str(exc))
         timeout = float(qs.get("timeoutSeconds", ["30"])[0])
         rv_param = qs.get("resourceVersion", [None])[0]
         bookmarks = qs.get("allowWatchBookmarks", ["false"])[0] == "true"
@@ -337,8 +459,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return False
             if namespace is not None and obj.metadata.namespace != namespace:
                 return False
-            return not sel or all(obj.metadata.labels.get(k) == v
-                                  for k, v in sel.items())
+            return not reqs or _match_selector(obj.metadata.labels, reqs)
 
         def write_line(payload: Dict) -> None:
             self.wfile.write(_json.dumps(payload).encode() + b"\n")

@@ -19,6 +19,18 @@ Checkpoints are orbax (async by default, so the save hides behind the next
 steps' compute; forced synchronous on drain), sharding-aware: each host
 writes its own param shards, restore re-shards to whatever mesh the resumed
 job has — the slice that comes back does not need the same device order.
+
+Drain-save overlap protocol (BENCH r3 downtime formula): point
+``checkpoint_dir`` at NODE-LOCAL storage (a hostPath volume). The drain
+save then only pays device→host fetch + a local write before the job pod
+exits and the wait-for-jobs gate opens; the durable upload (GCS etc.) is
+carried by a checkpoint-uploader DaemonSet pod on the same host, which the
+drain helper never evicts (IgnoreAllDaemonSets — the reference's own drain
+contract, drain_manager.go:76-96) and which therefore overlaps the
+eviction/teardown half of the slice-unavailability window. If the host
+dies before the upload lands, the resumed job falls back to the previous
+periodic checkpoint — degraded to the uncoordinated baseline, never data
+loss.
 """
 
 from __future__ import annotations
@@ -86,8 +98,13 @@ class CheckpointingTrainer:
         self.checkpoint_interval = checkpoint_interval
         self._mngr = ocp.CheckpointManager(
             checkpoint_dir,
-            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
-                                                 create=True))
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True,
+                # pinned explicitly: periodic saves MUST dispatch in the
+                # background (the step loop continues while the write
+                # lands); only the drain-triggered save is synchronous
+                # via save(wait=True) → wait_until_finished
+                enable_async_checkpointing=True))
         self._step_fn = step_fn or make_train_step(cfg, optimizer, mesh)
         self._init_fn = init_fn or (
             lambda rng: init_train_state(rng, self.cfg, self.optimizer,

@@ -242,6 +242,50 @@ def test_periodic_checkpoints_keep_latest(rng, tmp_path):
     assert trainer.latest_step == 6
 
 
+def test_periodic_saves_async_drain_save_durable(rng, tmp_path):
+    """VERDICT r2 #9: interval checkpoints dispatch in the background (the
+    step loop is NOT blocked on durability), while the drain-triggered save
+    blocks until finished. Asserted through a recording wrapper around the
+    real orbax manager: periodic saves never trigger wait_until_finished;
+    the drain save does, before run() returns."""
+    mesh = make_mesh(fsdp=8)
+    trainer = CheckpointingTrainer(CFG, str(tmp_path / "ckpt"), mesh=mesh,
+                                   checkpoint_interval=2)
+    calls = []
+    real = trainer._mngr
+
+    class SpyManager:
+        def save(self, step, args=None):
+            calls.append(("save", step))
+            return real.save(step, args=args)
+
+        def wait_until_finished(self):
+            calls.append(("wait", None))
+            return real.wait_until_finished()
+
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+    trainer._mngr = SpyManager()
+    state = trainer.init_or_resume(rng)
+    data = batches(batch=8)
+    trainer.run(state, data, num_steps=5)  # periodic saves at steps 2, 4
+    saves = [c for c in calls if c[0] == "save"]
+    assert [s for _, s in saves] == [2, 4]
+    assert ("wait", None) not in calls, \
+        "a periodic save blocked the step loop on durability"
+
+    # drain → the save is made durable before run() returns
+    calls.clear()
+    result = trainer.run(trainer.init_or_resume(rng), data, num_steps=50,
+                         drain_signal=lambda: True)
+    assert result.preempted
+    assert ("wait", None) in calls, "drain save did not wait until durable"
+    assert calls.index(("wait", None)) > 0  # after the save dispatch
+    trainer._mngr = real
+    trainer.close()
+
+
 def test_make_mesh_uses_each_device_once_any_assignment():
     """Physical (mesh_utils) or reshape assignment must both yield the same
     logical shape/axis names with every device exactly once — shardings and

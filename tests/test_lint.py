@@ -201,6 +201,15 @@ def test_def_redefinition_flagged(tmp_path):
     assert codes(found) == ["F811"] and "line 1" in found[0]
 
 
+def test_recursive_first_def_still_flags_redefinition(tmp_path):
+    """A self-reference inside the first definition's own body is NOT an
+    intervening use — the duplicate def must still fire (pyflakes parity)."""
+    src = ("def f():\n    return f()\n"
+           "def f():\n    return 2\n")
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["F811"], found
+
+
 def test_class_method_redefinition_flagged(tmp_path):
     src = ("class C:\n    def m(self):\n        return 1\n"
            "    def m(self):\n        return 2\n")

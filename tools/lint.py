@@ -333,21 +333,23 @@ class Checker(ast.NodeVisitor):
         collected, so they are exempt by construction."""
         if scope.kind not in ("module", "class"):
             return
-        # (line, name, decorated, is_import)
-        events: List[Tuple[int, str, bool, bool]] = []
+        # (line, end_line, name, decorated, is_import) — end_line bounds
+        # the definition's own body, so a recursive self-reference inside
+        # it does not count as a "use between definitions"
+        events: List[Tuple[int, int, str, bool, bool]] = []
         if scope is self.module_scope:
             # submodule imports (`import urllib.error` + `import
             # urllib.request`) complement each other — same exemption as
             # the import-vs-import F811 check
-            events.extend((line, name, False, True)
+            events.extend((line, line, name, False, True)
                           for line, name, full, in_try
                           in self.import_events
                           if not in_try and "." not in full)
         for stmt in body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
-                events.append((stmt.lineno, stmt.name,
-                               bool(stmt.decorator_list), False))
+                events.append((stmt.lineno, stmt.end_lineno or stmt.lineno,
+                               stmt.name, bool(stmt.decorator_list), False))
         if events:
             self._redef_checks.append(events)
 
@@ -358,20 +360,24 @@ class Checker(ast.NodeVisitor):
         between. Decorated defs (@property/@x.setter/@overload chains) are
         exempt."""
         for events in self._redef_checks:
-            by_name: Dict[str, List[Tuple[int, bool, bool]]] = {}
-            for line, name, decorated, is_import in sorted(events):
+            by_name: Dict[str, List[Tuple[int, int, bool, bool]]] = {}
+            for line, end_line, name, decorated, is_import in sorted(events):
                 by_name.setdefault(name, []).append(
-                    (line, decorated, is_import))
+                    (line, end_line, decorated, is_import))
             for name, evs in by_name.items():
                 uses = self.all_use_lines.get(name, [])
-                for (prev_line, _, prev_imp), (line, decorated, is_imp) \
-                        in zip(evs, evs[1:]):
+                for (prev_line, prev_end, _, prev_imp), \
+                        (line, _, decorated, is_imp) in zip(evs, evs[1:]):
                     if is_imp:
                         continue  # import-vs-import handled by the import
                     #             F811 check; def-then-import left alone
                     if decorated:
                         continue
-                    if any(prev_line < u <= line for u in uses):
+                    # a use counts as intervening only AFTER the first
+                    # definition's own body ends — a recursive call inside
+                    # it must not exempt a genuine duplicate (pyflakes
+                    # flags that case too)
+                    if any(prev_end < u <= line for u in uses):
                         continue
                     if prev_imp:
                         # a def redefining an import supersedes the
