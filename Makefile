@@ -19,7 +19,7 @@ test:
 test-fast:  ## operator-library tests only (skips slow JAX compiles)
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_jax_stack.py
 
-lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/F841/B006/E722/F541/F601/F631/F602/W605/A001/A002) + import sanity
+lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/F841/B006/E722/F541/F601/F631/F602/W605/W0101/A001/A002) + import sanity
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py
 	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
@@ -27,11 +27,13 @@ lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/F
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
-cov-report:  ## coverage run; FAILS (exit 2) if pytest-cov is unavailable
-	@$(PYTHON) -c "import pytest_cov" 2>/dev/null || \
-	  { echo "error: pytest-cov is not installed — coverage cannot be" \
-	         "measured. Install pytest-cov or use 'make test'." >&2; exit 2; }
-	$(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu --cov-report=term
+cov-report:  ## coverage: pytest-cov when installed, else the stdlib tools/cov.py (sys.monitoring)
+	@if $(PYTHON) -c "import pytest_cov" 2>/dev/null; then \
+	  $(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu --cov-report=term; \
+	else \
+	  echo "pytest-cov not installed; using tools/cov.py (sys.monitoring)"; \
+	  $(PYTHON) tools/cov.py tests/ -q; \
+	fi
 
 bench:
 	$(PYTHON) bench.py

@@ -32,7 +32,8 @@ where ckpt_save_s is split into its two physical phases:
   before the job releases the device and before any driver teardown.
 - ``ckpt_write_s`` = ckpt_save_s − ckpt_fetch_s — the host→storage write.
   OVERLAPPABLE: once the state is off-device the job hands it to a
-  checkpoint-uploader DaemonSet pod (hostPath spool), exits, and the
+  checkpoint-uploader DaemonSet pod (hostPath spool;
+  train/uploader.py:CheckpointUploader is that pod's loop), exits, and the
   wait-for-jobs gate opens; the durable write then rides concurrently
   with eviction + driver restart, because `drain` does NOT evict
   DaemonSet pods (IgnoreAllDaemonSets — the reference's own drain

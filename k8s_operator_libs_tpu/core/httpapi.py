@@ -85,6 +85,12 @@ def _parse_label_selector(qs: Dict):
         m = _SET_REQ_RE.match(part)
         if m:
             vals = [v.strip() for v in m.group(3).split(",") if v.strip()]
+            if not vals:
+                # labels.Parse: "for 'in', 'notin' operators, values set
+                # can't be empty" — a silent match-all here would hide
+                # client bugs a real cluster 400s
+                raise ValueError(f"unable to parse requirement {part!r}: "
+                                 "values set can't be empty")
             reqs.append((m.group(1), m.group(2), vals))
             continue
         if "!=" in part:

@@ -1,3 +1,6 @@
-"""Model zoo for the TPU workload harness (flagship: Llama-3-style LM)."""
+"""Model zoo for the TPU workload harness (flagship: Llama-3-style LM;
+second family: Mixtral-style MoE). Decode paths: contiguous KV
+(:mod:`.generate`), paged/block KV (:mod:`.paged`), int8 weight-only
+(:mod:`.quant`), MoE (:func:`.moe.moe_generate`)."""
 
 from .llama import LlamaConfig, forward, init_params  # noqa: F401

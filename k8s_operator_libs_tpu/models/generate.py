@@ -58,22 +58,27 @@ def _attend_cached(cfg: LlamaConfig, q: jax.Array, k_cache: jax.Array,
     """q: [B, Tq, H, Dh] against cache [B, max_len, KV, Dh]; positions ≥
     cache validity are masked. Returns [B, Tq, H, Dh]. Head counts come from
     the array shapes, so this works unchanged on tensor-parallel shards
-    (H/tp, KV/tp local heads)."""
-    H, KV = q.shape[2], k_cache.shape[2]
-    if KV != H:
-        rep = H // KV
-        k_cache = jnp.repeat(k_cache, rep, axis=2)
-        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    (H/tp, KV/tp local heads).
+
+    GQA via GROUPED einsum, not jnp.repeat: decode is cache-bandwidth-bound
+    and repeating the KV cache H/KV-fold before the matmul multiplies the
+    per-step cache traffic by the group size; folding the query groups into
+    the contraction reads each cache byte once."""
+    B, Tq, H, Dh = q.shape
+    KV = k_cache.shape[2]
+    G = H // KV
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+    q_g = q.reshape(B, Tq, KV, G, Dh)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache,
                         preferred_element_type=jnp.float32) * scale
     max_len = k_cache.shape[1]
     k_pos = jnp.arange(max_len, dtype=jnp.int32)
     # causal + validity: key visible iff k_pos <= q's absolute position
     mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, max_len]
-    logits = jnp.where(mask[None, None], logits, -1e30)
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache)
+    return out.reshape(B, Tq, H, Dh)
 
 
 def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
@@ -130,14 +135,14 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     return logits, new_cache
 
 
-def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
-                 cfg: LlamaConfig, max_new_tokens: int, temperature: float,
-                 rng: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
-    """Prefill + scanned single-token decode: the one loop both the
-    single-device and tensor-parallel paths share (only the cache layout
-    and the tp_axis psums differ)."""
-    logits, cache = _forward_cached(params, prompt, cache, cfg, tp_axis)
-
+def scan_decode(forward_fn, params: Params, prompt: jax.Array, cache,
+                last_logits: jax.Array, max_new_tokens: int,
+                temperature: float, rng: jax.Array) -> jax.Array:
+    """THE decode tail every cache layout shares: sample the first token
+    from the prefill's last logits, then a ``lax.scan`` of single-token
+    ``forward_fn(params, tok[:, None], cache) -> (logits, cache)`` steps.
+    Single-device, tensor-parallel, paged, int8 and MoE decoding all call
+    this — the sampling/rng protocol lives in exactly one place."""
     def sample(logits_last, key):
         if temperature == 0.0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
@@ -147,12 +152,11 @@ def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
     # split BEFORE the first sample — reusing rng as both a sampling key and
     # the split root correlates the first token with later draws
     rng, first_key = jax.random.split(rng)
-    first = sample(logits[:, -1], first_key)
+    first = sample(last_logits, first_key)
 
     def step(carry, key):
         tok, cache = carry
-        logits, cache = _forward_cached(params, tok[:, None], cache, cfg,
-                                        tp_axis)
+        logits, cache = forward_fn(params, tok[:, None], cache)
         return (sample(logits[:, -1], key), cache), tok
 
     keys = jax.random.split(rng, max_new_tokens - 1)
@@ -160,6 +164,17 @@ def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
     generated = jnp.concatenate(
         [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
+                 cfg: LlamaConfig, max_new_tokens: int, temperature: float,
+                 rng: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+    """Prefill + :func:`scan_decode` for the contiguous cache (single-device
+    and tensor-parallel — only the cache layout and tp_axis psums differ)."""
+    logits, cache = _forward_cached(params, prompt, cache, cfg, tp_axis)
+    fwd = partial(_forward_cached, cfg=cfg, tp_axis=tp_axis)
+    return scan_decode(fwd, params, prompt, cache, logits[:, -1],
+                       max_new_tokens, temperature, rng)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))

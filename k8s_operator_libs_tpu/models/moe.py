@@ -26,6 +26,7 @@ why they share it.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -271,3 +272,69 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
         scan_body, (x, aux_init), params["blocks"])
     x = rms_norm(x, params["final_norm"])
     return (x @ params["lm_head"]).astype(jnp.float32), aux_total
+
+
+# ------------------------------------------------------------- decoding
+
+
+def _forward_cached_moe(params: Params, tokens: jax.Array, cache,
+                        cfg: MoEConfig):
+    """KV-cached MoE forward [B, T] starting at cache.length — the decode
+    analog of generate._forward_cached with the routed expert FFN in place
+    of the dense MLP. Dense dispatch: at decode every expert's weights are
+    streamed once per step regardless of routing, which is the honest cost
+    of token-choice MoE inference without expert offload."""
+    from .generate import KVCache, _attend_cached
+
+    B, T = tokens.shape
+    Dh = cfg.head_dim
+    positions = cache.length + jnp.arange(T, dtype=jnp.int32)
+    pos_b = jnp.broadcast_to(positions, (B, T))
+    x = params["embed"][tokens]
+
+    def body(carry, layer_in):
+        x, = carry
+        layer, k_cache_l, v_cache_l = layer_in
+        H = layer["wq"].shape[-1] // Dh
+        KV = layer["wk"].shape[-1] // Dh
+        h = rms_norm(x, layer["attn_norm"])
+        q = rope((h @ layer["wq"]).reshape(B, T, H, Dh), pos_b,
+                 cfg.rope_theta)
+        k = rope((h @ layer["wk"]).reshape(B, T, KV, Dh), pos_b,
+                 cfg.rope_theta)
+        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+        k_cache_l = jax.lax.dynamic_update_slice(
+            k_cache_l, k.astype(k_cache_l.dtype), (0, cache.length, 0, 0))
+        v_cache_l = jax.lax.dynamic_update_slice(
+            v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
+        attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
+                              cache.length)
+        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+        h2 = rms_norm(x, layer["mlp_norm"])
+        moe_out, _ = moe_ffn(h2, layer, cfg)
+        return (x + moe_out,), (k_cache_l, v_cache_l)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=cache.length + T)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+def moe_generate(params: Params, prompt: jax.Array, cfg: MoEConfig,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy/sampled KV-cached decoding for the MoE family — the same
+    loop and rng protocol as generate.generate (prefill + the shared
+    scan_decode tail, one jit)."""
+    from .generate import init_cache, scan_decode
+
+    B, Tp = prompt.shape
+    cache = init_cache(cfg, B, Tp + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    logits, cache = _forward_cached_moe(params, prompt, cache, cfg)
+    return scan_decode(partial(_forward_cached_moe, cfg=cfg), params,
+                       prompt, cache, logits[:, -1], max_new_tokens,
+                       temperature, rng)

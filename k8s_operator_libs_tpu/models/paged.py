@@ -130,21 +130,22 @@ def _attend_paged(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
     """q [B, Tq, H, Dh] over gathered views [B, cap, KV, Dh]; q_pos [B, Tq]
     per-sequence absolute positions (ragged batches decode at different
     offsets). Causal + validity in one mask: key col visible iff
-    k_pos <= q_pos[b, t]."""
-    H, KV = q.shape[2], k_view.shape[2]
-    if KV != H:
-        rep = H // KV
-        k_view = jnp.repeat(k_view, rep, axis=2)
-        v_view = jnp.repeat(v_view, rep, axis=2)
+    k_pos <= q_pos[b, t]. GQA via grouped einsum — the cache is read once,
+    never repeated (see generate._attend_cached)."""
+    B, Tq, H, Dh = q.shape
+    KV = k_view.shape[2]
+    G = H // KV
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
+    q_g = q.reshape(B, Tq, KV, G, Dh)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_view,
                         preferred_element_type=jnp.float32) * scale
     cap = k_view.shape[1]
     k_pos = jnp.arange(cap, dtype=jnp.int32)
     mask = k_pos[None, None, :] <= q_pos[:, :, None]      # [B, Tq, cap]
-    logits = jnp.where(mask[:, None], logits, -1e30)
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_view)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_view)
+    return out.reshape(B, Tq, H, Dh)
 
 
 def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
@@ -221,23 +222,6 @@ def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
     # rewind lengths so decode continues from the true end of each prompt
     cache = PagedKVCache(k=cache.k, v=cache.v, table=cache.table,
                          lengths=prompt_lengths)
-
-    def sample(lg, key):
-        if temperature == 0.0:
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, lg / temperature,
-                                      axis=-1).astype(jnp.int32)
-
-    rng, first_key = jax.random.split(rng)
-    first = sample(last_logits, first_key)
-
-    def step(carry, key):
-        tok, cache = carry
-        logits, cache = _forward_paged(params, tok[:, None], cache, cfg)
-        return (sample(logits[:, -1], key), cache), tok
-
-    keys = jax.random.split(rng, max_new_tokens - 1)
-    (last, _), toks = jax.lax.scan(step, (first, cache), keys)
-    generated = jnp.concatenate(
-        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
-    return jnp.concatenate([prompt, generated], axis=1)
+    from .generate import scan_decode
+    return scan_decode(partial(_forward_paged, cfg=cfg), params, prompt,
+                       cache, last_logits, max_new_tokens, temperature, rng)

@@ -1,3 +1,5 @@
-"""Training harness: orbax checkpoint/resume, upgrade-aware run loop."""
+"""Training harness: orbax checkpoint/resume, upgrade-aware run loop,
+and the drain-immune checkpoint uploader (:mod:`.uploader`)."""
 
 from .harness import CheckpointingTrainer, TrainResult  # noqa: F401
+from .uploader import CheckpointUploader, mirror_once  # noqa: F401

@@ -24,7 +24,9 @@ Drain-save overlap protocol (BENCH r3 downtime formula): point
 ``checkpoint_dir`` at NODE-LOCAL storage (a hostPath volume). The drain
 save then only pays device→host fetch + a local write before the job pod
 exits and the wait-for-jobs gate opens; the durable upload (GCS etc.) is
-carried by a checkpoint-uploader DaemonSet pod on the same host, which the
+carried by a checkpoint-uploader DaemonSet pod on the same host
+(:mod:`.uploader` — CheckpointUploader mirrors finalized local steps to
+durable storage with atomic staging renames), which the
 drain helper never evicts (IgnoreAllDaemonSets — the reference's own drain
 contract, drain_manager.go:76-96) and which therefore overlaps the
 eviction/teardown half of the slice-unavailability window. If the host

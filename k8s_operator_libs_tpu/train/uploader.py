@@ -1,0 +1,173 @@
+"""Checkpoint uploader: the drain-immune half of the drain-save protocol.
+
+bench.py's downtime formula overlaps the drain checkpoint's durable-write
+half with the slice-unavailability window. This module is the code that
+makes the overlap real rather than aspirational: the training job saves to
+NODE-LOCAL storage (fast; only the device→host fetch gates its exit), and
+a :class:`CheckpointUploader` — deployed as a DaemonSet pod sharing the
+hostPath volume — mirrors finalized checkpoints to durable storage
+(GCS-mounted path, NFS, …) in the background. Because `drain` never
+evicts DaemonSet pods (IgnoreAllDaemonSets, the reference's own drain
+contract — drain_manager.go:76-96), the mirror keeps running while the
+job is torn down, the old libtpu pods are evicted, and the driver
+restarts: the durable write rides the window instead of preceding it.
+
+Correctness hinges on two atomic-rename facts:
+
+- orbax finalizes a step by RENAMING its ``<step>.orbax-checkpoint-tmp``
+  staging dir to the bare ``<step>`` name, so any all-digit directory in
+  the local root is a complete checkpoint — the uploader never sees a
+  partial source;
+- the uploader stages its own copy under a ``.uploading`` suffix and
+  renames on completion, so a reader of the durable dir (the resumed job)
+  likewise never sees a partial copy, and an uploader crash leaves only
+  an ignorable staging dir that is re-copied on restart.
+
+If the host dies before a mirror lands, the resumed job restores the
+previous durable checkpoint — degraded to the uncoordinated baseline,
+never data loss (train/harness.py module docstring)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_STAGING_SUFFIX = ".uploading"
+
+
+def _finalized_steps(root: str):
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    return sorted((n for n in names if n.isdigit()), key=int)
+
+
+def mirror_once(local_dir: str, durable_dir: str) -> int:
+    """Copy every finalized local step not yet present in ``durable_dir``
+    (atomically, via a staging dir + rename). Returns the number of steps
+    mirrored. Usable standalone (a cron-style Job) or via the background
+    :class:`CheckpointUploader`.
+
+    Concurrent-safe by construction: staging names are unique per attempt
+    (pid + random), so two uploaders whose hosts both hold a step — a job
+    drained on host A and rescheduled to host B — can never interleave
+    inside one staging dir; whichever rename lands first wins, the loser
+    detects the existing destination and discards its own complete copy.
+    A crashed attempt leaves only an inert ``*.uploading-*`` dir that is
+    never read (finalized steps are all-digit names) and is swept by the
+    next pass once it goes stale."""
+    os.makedirs(durable_dir, exist_ok=True)
+    _sweep_stale_staging(durable_dir)
+    done = set(_finalized_steps(durable_dir))
+    mirrored = 0
+    for step in _finalized_steps(local_dir):
+        if step in done:
+            continue
+        src = os.path.join(local_dir, step)
+        staging = os.path.join(
+            durable_dir,
+            f"{step}{_STAGING_SUFFIX}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        dst = os.path.join(durable_dir, step)
+        shutil.copytree(src, staging)
+        try:
+            os.rename(staging, dst)  # readers see complete steps only
+        except OSError:
+            # a concurrent uploader published this step first — both
+            # copies were complete, so discarding ours is lossless
+            shutil.rmtree(staging, ignore_errors=True)
+            continue
+        mirrored += 1
+        logger.info("mirrored checkpoint step %s -> %s", step, durable_dir)
+    return mirrored
+
+
+_STALE_STAGING_SECONDS = 3600.0
+
+
+def _sweep_stale_staging(durable_dir: str) -> None:
+    """Remove crashed attempts' staging dirs once they are old enough that
+    no live uploader can still be writing them (bounded disk debris)."""
+    now = time.time()
+    try:
+        names = os.listdir(durable_dir)
+    except FileNotFoundError:
+        return
+    for n in names:
+        if _STAGING_SUFFIX not in n:
+            continue
+        path = os.path.join(durable_dir, n)
+        try:
+            if now - os.path.getmtime(path) > _STALE_STAGING_SECONDS:
+                shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            continue
+
+
+class CheckpointUploader:
+    """Background mirror of ``local_dir`` → ``durable_dir``.
+
+    Lifecycle is independent of the training job by design (that IS the
+    protocol): start it before the job, leave it running across job
+    restarts. ``wait_idle`` blocks until everything currently finalized
+    locally is durable — tests and the single-host bench use it where
+    production relies on the DaemonSet simply outliving the drain."""
+
+    def __init__(self, local_dir: str, durable_dir: str,
+                 poll_seconds: float = 1.0):
+        self.local_dir = local_dir
+        self.durable_dir = durable_dir
+        self.poll_seconds = poll_seconds
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CheckpointUploader":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ckpt-uploader")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                mirror_once(self.local_dir, self.durable_dir)
+                # idle = every finalized local step is durable
+                if set(_finalized_steps(self.local_dir)) <= set(
+                        _finalized_steps(self.durable_dir)):
+                    self._idle.set()
+                else:
+                    self._idle.clear()
+            except Exception:
+                logger.exception("checkpoint mirror pass failed; retrying")
+                self._idle.clear()
+            self._stop.wait(self.poll_seconds)
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until the mirror has caught up (or timeout)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (self._idle.is_set()
+                    and set(_finalized_steps(self.local_dir))
+                    <= set(_finalized_steps(self.durable_dir))):
+                return True
+            time.sleep(min(0.05, self.poll_seconds))
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "CheckpointUploader":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -101,7 +101,8 @@ def test_malformed_selector_is_400(wire):
     list — a silent empty result would hide operator bugs."""
     cluster, http = wire
     seed_nodes(cluster)
-    for bad in ("env in prod", "env)(", "in (a)", "a=b,%%"):
+    for bad in ("env in prod", "env)(", "in (a)", "a=b,%%",
+                "env in ()", "env notin ( , )"):
         with pytest.raises(RuntimeError, match="400"):
             http.request("GET", "/api/v1/nodes",
                          params={"labelSelector": bad})

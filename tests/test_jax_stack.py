@@ -286,6 +286,96 @@ def test_periodic_saves_async_drain_save_durable(rng, tmp_path):
     trainer.close()
 
 
+def test_uploader_mirrors_drain_checkpoint_after_job_exit(rng, tmp_path):
+    """The drain-save overlap protocol end-to-end: the job saves to LOCAL
+    storage and exits; the uploader (the drain-immune DaemonSet role)
+    finishes mirroring to durable storage AFTER the job is gone; a fresh
+    job pointed at the durable dir restores the drained step. Partial
+    copies are never visible (staging dirs excluded)."""
+    from k8s_operator_libs_tpu.train.uploader import (CheckpointUploader,
+                                                      _finalized_steps)
+
+    local, durable = str(tmp_path / "local"), str(tmp_path / "durable")
+    mesh = make_mesh(fsdp=8)
+    with CheckpointUploader(local, durable, poll_seconds=0.05) as up:
+        trainer = CheckpointingTrainer(CFG, local, mesh=mesh,
+                                       checkpoint_interval=100)
+        state = trainer.init_or_resume(rng)
+        result = trainer.run(state, batches(batch=8), num_steps=50,
+                             drain_signal=lambda: True)  # drain at once
+        assert result.preempted
+        trainer.close()  # job pod exits — uploader must outlive it
+        assert up.wait_idle(timeout=30.0), "uploader never caught up"
+    assert _finalized_steps(durable) == ["0"]
+    assert not any(n.endswith(".uploading") for n in
+                   __import__("os").listdir(durable))
+    # the resumed job (new slice) restores from DURABLE storage
+    trainer2 = CheckpointingTrainer(CFG, durable, mesh=mesh)
+    state2 = trainer2.init_or_resume(jax.random.PRNGKey(9))
+    assert int(state2.step) == 0
+    for a, b in zip(jax.tree_util.tree_leaves(result.state.params),
+                    jax.tree_util.tree_leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    trainer2.close()
+
+
+def test_uploader_mirror_once_is_idempotent_and_crash_safe(tmp_path,
+                                                           monkeypatch):
+    """mirror_once: re-runs copy nothing new; unfinalized orbax tmp dirs
+    are skipped; a crashed attempt's staging debris never blocks a fresh
+    copy and is swept once stale; a concurrently-published destination
+    makes the loser discard its copy losslessly."""
+    import os
+
+    from k8s_operator_libs_tpu.train import uploader
+    from k8s_operator_libs_tpu.train.uploader import mirror_once
+
+    local, durable = tmp_path / "l", tmp_path / "d"
+    (local / "7").mkdir(parents=True)
+    (local / "7" / "data").write_text("payload")
+    (local / "8.orbax-checkpoint-tmp").mkdir()  # unfinalized: skipped
+    assert mirror_once(str(local), str(durable)) == 1
+    assert (durable / "7" / "data").read_text() == "payload"
+    assert mirror_once(str(local), str(durable)) == 0  # idempotent
+    # crashed prior attempt: unique-named staging debris does not block
+    (local / "9").mkdir()
+    (local / "9" / "data").write_text("new")
+    stale = durable / "9.uploading-12345-deadbeef"
+    stale.mkdir()
+    (stale / "garbage").write_text("stale")
+    old = __import__("time").time() - 2 * uploader._STALE_STAGING_SECONDS
+    os.utime(stale, (old, old))
+    assert mirror_once(str(local), str(durable)) == 1
+    assert (durable / "9" / "data").read_text() == "new"
+    assert not stale.exists(), "stale staging debris not swept"
+    # already-published step (the other uploader finished first): skipped
+    (local / "11").mkdir()
+    (local / "11" / "data").write_text("ours")
+    (durable / "11").mkdir()
+    (durable / "11" / "data").write_text("winner")
+    assert mirror_once(str(local), str(durable)) == 0
+    assert (durable / "11" / "data").read_text() == "winner"
+    # the narrow race: winner publishes BETWEEN our copy and our rename →
+    # rename fails, our complete copy is discarded losslessly
+    import shutil as _shutil
+    (local / "12").mkdir()
+    (local / "12" / "data").write_text("ours")
+    orig_copytree = _shutil.copytree
+
+    def racing_copytree(src, dst, **kw):
+        out = orig_copytree(src, dst, **kw)
+        if not (durable / "12").exists():
+            (durable / "12").mkdir()
+            (durable / "12" / "data").write_text("winner")
+        return out
+
+    monkeypatch.setattr(_shutil, "copytree", racing_copytree)
+    assert mirror_once(str(local), str(durable)) == 0
+    monkeypatch.undo()
+    assert (durable / "12" / "data").read_text() == "winner"
+    assert not any(".uploading" in n for n in os.listdir(durable))
+
+
 def test_make_mesh_uses_each_device_once_any_assignment():
     """Physical (mesh_utils) or reshape assignment must both yield the same
     logical shape/axis names with every device exactly once — shardings and

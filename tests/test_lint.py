@@ -267,6 +267,54 @@ def test_unused_local_eval_guard(tmp_path):
     assert run_lint(tmp_path, src) == []
 
 
+def test_unreachable_code_flagged(tmp_path):
+    src = ("def f():\n    return 1\n    print(2)\n"
+           "def g():\n    for i in range(3):\n"
+           "        continue\n        print(i)\n")
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["W0101", "W0101"]
+    assert "return" in found[0] and "continue" in found[1]
+
+
+def test_unreachable_code_negatives(tmp_path):
+    """Reachable siblings of terminal statements must stay silent: code
+    after an if/try whose BRANCH returns, loop bodies after a conditional
+    break, and one-finding-per-block (no cascade)."""
+    src = '''
+def f(x):
+    if x:
+        return 1
+    return 2
+
+def g(xs):
+    for x in xs:
+        if x:
+            break
+        process(x)
+    return xs
+
+def h():
+    try:
+        risky()
+    except ValueError:
+        raise
+    return "ok"
+
+def dead():
+    return 1
+    process(2)   # flagged
+    process(3)   # transitively dead: NOT flagged again
+
+def process(x):
+    return x
+
+def risky():
+    return 0
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["W0101"] and ":23:" in found[0]
+
+
 def test_shadowed_builtin_assignment(tmp_path):
     found = run_lint(tmp_path, "def f():\n    list = [1]\n    return list\n")
     assert codes(found) == ["A001"] and "'list'" in found[0]

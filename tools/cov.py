@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Stdlib-only line coverage via sys.monitoring (PEP 669, Python >= 3.12).
+
+The reference gates CI on coverage uploaded to Coveralls (ci.yaml:50-69);
+this image carries no pytest-cov and installing one is off-limits, so —
+like tools/lint.py stands in for golangci-lint — this stands in for
+coverage.py: a collector registered on :data:`sys.monitoring.COVERAGE_ID`
+records the first execution of every (code object, line) in the measured
+package and then returns ``sys.monitoring.DISABLE`` for that location, so
+steady-state overhead is near zero (each line pays one callback ever;
+uninteresting files disable themselves on first sight).
+
+Denominator: executable statement lines from the AST (module docstrings
+and bare-string docstring expressions are excluded — CPython emits no code
+for them; ``global``/``nonlocal`` likewise).
+
+Usage:
+    python tools/cov.py [pytest args...]     # default: tests/ -q
+prints per-file coverage for the worst-covered files plus the package
+total, writes the full per-file table to ``cov.json``, and exits with
+pytest's exit code (so CI still fails on test failures, not coverage).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Dict, Set
+
+REPO = Path(__file__).resolve().parent.parent
+MEASURED_DIRS = ("k8s_operator_libs_tpu",)
+
+
+def _measured_path(filename: str):
+    """Resolved path string when the file is measured, else None. The
+    RESOLVED form is the canonical hits key — co_filename can be relative
+    or traverse symlinks, and report() looks up by resolved path."""
+    if "__pycache__" in filename or not filename.endswith(".py"):
+        return None
+    resolved = Path(filename).resolve()
+    try:
+        rel = resolved.relative_to(REPO)
+    except ValueError:
+        return None
+    return str(resolved) if rel.parts[0] in MEASURED_DIRS else None
+
+
+def _measured(filename: str) -> bool:
+    return _measured_path(filename) is not None
+
+
+def executable_lines(path: Path) -> Set[int]:
+    """Line numbers that produce executed bytecode, from the AST."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError:
+        return set()
+    lines: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            lines.add(node.lineno)
+            continue
+        if not isinstance(node, ast.stmt):
+            continue
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            continue  # compile-time declarations: no bytecode
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue  # docstring / bare string: no bytecode
+        lines.add(node.lineno)
+    return lines
+
+
+class Collector:
+    """First-hit line recorder over sys.monitoring. ``tool_id`` defaults
+    to COVERAGE_ID; the self-test passes another id so it can run inside
+    a coverage run without fighting over the slot."""
+
+    def __init__(self, tool_id: int = None):
+        self.hits: Dict[str, Set[int]] = {}
+        self._tool = (sys.monitoring.COVERAGE_ID
+                      if tool_id is None else tool_id)
+
+    def start(self) -> None:
+        sys.monitoring.use_tool_id(self._tool, "k8s-operator-libs-tpu-cov")
+        sys.monitoring.register_callback(
+            self._tool, sys.monitoring.events.LINE, self._on_line)
+        sys.monitoring.set_events(self._tool, sys.monitoring.events.LINE)
+
+    def stop(self) -> None:
+        sys.monitoring.set_events(self._tool, 0)
+        sys.monitoring.register_callback(
+            self._tool, sys.monitoring.events.LINE, None)
+        sys.monitoring.free_tool_id(self._tool)
+
+    def _on_line(self, code, lineno):
+        resolved = _measured_path(code.co_filename)
+        if resolved is not None:
+            self.hits.setdefault(resolved, set()).add(lineno)
+        # either way: this (code, line) never fires again
+        return sys.monitoring.DISABLE
+
+
+def report(hits: Dict[str, Set[int]], out_path: Path) -> float:
+    rows = []
+    total_exec = total_hit = 0
+    for d in MEASURED_DIRS:
+        for path in sorted((REPO / d).rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            exe = executable_lines(path)
+            if not exe:
+                continue
+            got = hits.get(str(path.resolve()), set()) & exe
+            total_exec += len(exe)
+            total_hit += len(got)
+            rows.append((str(path.relative_to(REPO)), len(got), len(exe)))
+    pct = 100.0 * total_hit / total_exec if total_exec else 0.0
+    rows.sort(key=lambda r: r[1] / r[2])
+    print("\n--- coverage (tools/cov.py, sys.monitoring) ---")
+    for rel, got, exe in rows[:12]:
+        print(f"  {100.0 * got / exe:5.1f}%  {got:>5}/{exe:<5}  {rel}")
+    if len(rows) > 12:
+        print(f"  ... {len(rows) - 12} more files in cov.json")
+    print(f"TOTAL: {pct:.1f}% ({total_hit}/{total_exec} lines, "
+          f"{len(rows)} files)")
+    out_path.write_text(json.dumps({
+        "total_pct": round(pct, 2),
+        "lines_hit": total_hit, "lines_executable": total_exec,
+        "files": {rel: {"hit": got, "executable": exe,
+                        "pct": round(100.0 * got / exe, 2)}
+                  for rel, got, exe in rows}}, indent=1))
+    print(f"full table: {out_path}")
+    return pct
+
+
+def main(argv) -> int:
+    os.chdir(REPO)
+    # `python -m pytest` puts the cwd on sys.path; in-process pytest.main
+    # does not, so the measured package must be made importable here
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    collector = Collector()
+    collector.start()
+    try:
+        import pytest
+        rc = pytest.main(argv or ["tests/", "-q"])
+    finally:
+        collector.stop()
+    report(collector.hits, REPO / "cov.json")
+    return int(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

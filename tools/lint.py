@@ -26,6 +26,8 @@ of that surface:
   F631  assert on a non-empty tuple literal (always true)
   F602  duplicate literal key in a dict display
   W605  invalid escape sequence in a plain (non-raw) string literal
+  W0101 unreachable code: a statement directly following return / raise /
+        break / continue in the same block
   A001  name binding shadows a Python builtin (module/function scopes;
         class attributes exempt — they live behind `self.`/`cls.`)
   A002  function argument shadows a Python builtin
@@ -530,6 +532,25 @@ class Checker(ast.NodeVisitor):
             self._walk_annotation(scope, a.annotation)
         self._walk_annotation(scope, node.returns)
 
+    _TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+    def _check_unreachable(self, tree: ast.Module) -> None:
+        """W0101: statements directly following a return/raise/break/
+        continue in the same block can never execute (golangci's
+        unreachable-code class). One finding per block (everything after
+        the first is transitively dead)."""
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if not isinstance(stmts, list):
+                    continue
+                for prev, nxt in zip(stmts, stmts[1:]):
+                    if isinstance(prev, self._TERMINAL):
+                        kw = type(prev).__name__.lower()
+                        self.report(nxt.lineno, "W0101",
+                                    f"unreachable code after {kw!r}")
+                        break
+
     # ------------------------------------------------------ per-node checks
 
     def _stmt_checks(self, scope: Scope, node: ast.AST) -> None:
@@ -583,6 +604,7 @@ class Checker(ast.NodeVisitor):
         self.check_scope(self.module_scope, tree.body)
         self._check_import_shadowing()
         self._check_def_redefinition()
+        self._check_unreachable(tree)
         # unused imports: module scope, skipped for __init__.py (re-export
         # surface), names in __all__, underscore names, and future imports
         if not self.is_init:
