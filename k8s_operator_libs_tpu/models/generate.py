@@ -83,14 +83,27 @@ def _attend_cached(cfg: LlamaConfig, q: jax.Array, k_cache: jax.Array,
 
 def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
                     cfg: LlamaConfig,
-                    tp_axis: Optional[str] = None) -> Tuple[jax.Array, KVCache]:
+                    tp_axis: Optional[str] = None,
+                    matmul=None, ffn=None,
+                    lm_head_fn=None) -> Tuple[jax.Array, KVCache]:
     """Forward [B, T] starting at cache.length; appends K/V to the cache.
-    Used for both prefill (T = prompt len) and decode (T = 1).
+    Used for both prefill (T = prompt len) and decode (T = 1) — and shared
+    by EVERY contiguous-cache decode variant through three hooks, so the
+    cache protocol and attention live in exactly one place:
+
+    - ``matmul(x, layer, name) -> x @ layer[name]`` — the int8 path swaps
+      in its dequant-fused product (quant._qmat);
+    - ``ffn(h2, layer) -> mlp_out`` — the MoE path swaps in the routed
+      expert layer (moe.moe_ffn);
+    - ``lm_head_fn(x, params) -> logits-prescale`` — int8 lm_head.
 
     With ``tp_axis`` (inside shard_map) the weights and cache arrive with
     head dims already sharded (Megatron column/row split); two psums per
     block restore the full residual stream. Head counts are derived from
-    the weight shapes, so the same code runs both ways."""
+    the PRODUCT shapes (q.shape[-1] // head_dim), so the same code runs
+    under TP sharding and over quantized {"q","s"} weight dicts alike."""
+    mm = matmul or (lambda x, layer, name: x @ layer[name])
+    lm = lm_head_fn or (lambda x, p: x @ p["lm_head"])
     B, T = tokens.shape
     Dh = cfg.head_dim
     positions = cache.length + jnp.arange(T, dtype=jnp.int32)
@@ -100,12 +113,14 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     def body(carry, layer_in):
         x, = carry
         layer, k_cache_l, v_cache_l = layer_in
-        H = layer["wq"].shape[-1] // Dh     # local heads (H/tp under TP)
-        KV = layer["wk"].shape[-1] // Dh
         h = rms_norm(x, layer["attn_norm"])
-        q = (h @ layer["wq"]).reshape(B, T, H, Dh)
-        k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
-        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+        q_flat = mm(h, layer, "wq")
+        k_flat = mm(h, layer, "wk")
+        H = q_flat.shape[-1] // Dh          # local heads (H/tp under TP)
+        KV = k_flat.shape[-1] // Dh
+        q = q_flat.reshape(B, T, H, Dh)
+        k = k_flat.reshape(B, T, KV, Dh)
+        v = mm(h, layer, "wv").reshape(B, T, KV, Dh)
         q = rope(q, pos_b, cfg.rope_theta)
         k = rope(k, pos_b, cfg.rope_theta)
         k_cache_l = jax.lax.dynamic_update_slice(
@@ -114,14 +129,17 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
             v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
         attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
                               cache.length)
-        attn_out = attn.reshape(B, T, H * Dh) @ layer["wo"]
+        attn_out = mm(attn.reshape(B, T, H * Dh), layer, "wo")
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         x = x + attn_out
         h2 = rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu((h2 @ layer["w_gate"]).astype(jnp.float32)
-                           ).astype(h2.dtype)
-        mlp_out = (gate * (h2 @ layer["w_up"])) @ layer["w_down"]
+        if ffn is not None:
+            mlp_out = ffn(h2, layer)
+        else:
+            gate = jax.nn.silu(mm(h2, layer, "w_gate").astype(jnp.float32)
+                               ).astype(h2.dtype)
+            mlp_out = mm(gate * mm(h2, layer, "w_up"), layer, "w_down")
         if tp_axis is not None:
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
         x = x + mlp_out
@@ -130,7 +148,7 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     (x,), (new_k, new_v) = jax.lax.scan(
         body, (x,), (params["blocks"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = lm(x, params).astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     return logits, new_cache
 

@@ -279,46 +279,17 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
 
 def _forward_cached_moe(params: Params, tokens: jax.Array, cache,
                         cfg: MoEConfig):
-    """KV-cached MoE forward [B, T] starting at cache.length — the decode
-    analog of generate._forward_cached with the routed expert FFN in place
-    of the dense MLP. Dense dispatch: at decode every expert's weights are
-    streamed once per step regardless of routing, which is the honest cost
-    of token-choice MoE inference without expert offload."""
-    from .generate import KVCache, _attend_cached
-
-    B, T = tokens.shape
-    Dh = cfg.head_dim
-    positions = cache.length + jnp.arange(T, dtype=jnp.int32)
-    pos_b = jnp.broadcast_to(positions, (B, T))
-    x = params["embed"][tokens]
-
-    def body(carry, layer_in):
-        x, = carry
-        layer, k_cache_l, v_cache_l = layer_in
-        H = layer["wq"].shape[-1] // Dh
-        KV = layer["wk"].shape[-1] // Dh
-        h = rms_norm(x, layer["attn_norm"])
-        q = rope((h @ layer["wq"]).reshape(B, T, H, Dh), pos_b,
-                 cfg.rope_theta)
-        k = rope((h @ layer["wk"]).reshape(B, T, KV, Dh), pos_b,
-                 cfg.rope_theta)
-        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
-        k_cache_l = jax.lax.dynamic_update_slice(
-            k_cache_l, k.astype(k_cache_l.dtype), (0, cache.length, 0, 0))
-        v_cache_l = jax.lax.dynamic_update_slice(
-            v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
-        attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
-                              cache.length)
-        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
-        h2 = rms_norm(x, layer["mlp_norm"])
-        moe_out, _ = moe_ffn(h2, layer, cfg)
-        return (x + moe_out,), (k_cache_l, v_cache_l)
-
-    (x,), (new_k, new_v) = jax.lax.scan(
-        body, (x,), (params["blocks"], cache.k, cache.v))
-    x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=cache.length + T)
+    """KV-cached MoE forward — generate._forward_cached with the routed
+    expert FFN hooked in place of the dense MLP (one cache/attention
+    implementation; generate.py owns it). Dense dispatch: at decode every
+    expert's weights are streamed once per step regardless of routing,
+    which is the honest cost of token-choice MoE inference without expert
+    offload. The load-balance aux term is dropped — decode does not
+    train."""
+    from .generate import _forward_cached
+    return _forward_cached(
+        params, tokens, cache, cfg,
+        ffn=lambda h2, layer: moe_ffn(h2, layer, cfg)[0])
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from .generate import KVCache, init_cache
-from .llama import LlamaConfig, rms_norm, rope
+from .llama import LlamaConfig
 
 Params = Dict[str, Any]
 
@@ -96,44 +96,13 @@ def quantized_size_bytes(params: Params) -> int:
 
 def _forward_quant(params: Params, tokens: jax.Array, cache: KVCache,
                    cfg: LlamaConfig) -> Tuple[jax.Array, KVCache]:
-    """generate._forward_cached with _qmat in place of every quantized
-    matmul (same scan layout, same cache protocol)."""
-    B, T = tokens.shape
-    Dh = cfg.head_dim
-    positions = cache.length + jnp.arange(T, dtype=jnp.int32)
-    pos_b = jnp.broadcast_to(positions, (B, T))
-    x = params["embed"][tokens]
-
-    def body(carry, layer_in):
-        x, = carry
-        layer, k_cache_l, v_cache_l = layer_in
-        H = layer["wq"]["q"].shape[-1] // Dh
-        KV = layer["wk"]["q"].shape[-1] // Dh
-        h = rms_norm(x, layer["attn_norm"])
-        q = _qmat(h, layer["wq"]).reshape(B, T, H, Dh)
-        k = _qmat(h, layer["wk"]).reshape(B, T, KV, Dh)
-        v = _qmat(h, layer["wv"]).reshape(B, T, KV, Dh)
-        q = rope(q, pos_b, cfg.rope_theta)
-        k = rope(k, pos_b, cfg.rope_theta)
-        k_cache_l = jax.lax.dynamic_update_slice(
-            k_cache_l, k.astype(k_cache_l.dtype), (0, cache.length, 0, 0))
-        v_cache_l = jax.lax.dynamic_update_slice(
-            v_cache_l, v.astype(v_cache_l.dtype), (0, cache.length, 0, 0))
-        from .generate import _attend_cached
-        attn = _attend_cached(cfg, q, k_cache_l, v_cache_l, positions,
-                              cache.length)
-        x = x + _qmat(attn.reshape(B, T, H * Dh), layer["wo"])
-        h2 = rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(_qmat(h2, layer["w_gate"]).astype(jnp.float32)
-                           ).astype(h2.dtype)
-        x = x + _qmat(gate * _qmat(h2, layer["w_up"]), layer["w_down"])
-        return (x,), (k_cache_l, v_cache_l)
-
-    (x,), (new_k, new_v) = jax.lax.scan(
-        body, (x,), (params["blocks"], cache.k, cache.v))
-    x = rms_norm(x, params["final_norm"])
-    logits = _qmat(x, params["lm_head"]).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=cache.length + T)
+    """generate._forward_cached with _qmat hooked in for every quantized
+    matmul (one cache/attention implementation — generate.py owns it)."""
+    from .generate import _forward_cached
+    return _forward_cached(
+        params, tokens, cache, cfg,
+        matmul=lambda x, layer, name: _qmat(x, layer[name]),
+        lm_head_fn=lambda x, p: _qmat(x, p["lm_head"]))
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))

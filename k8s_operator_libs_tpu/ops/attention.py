@@ -14,8 +14,9 @@ blocks from (q, k, lse) on the fly — two kernels, one gridded over q-blocks
 [T, T] matrix is never materialized in HBM in either direction.
 
 Dispatch rules (shape + platform gates, decided at trace time):
-- TPU backend, head_dim a multiple of 128, seq a multiple of the 128-row
-  q-block → Pallas kernels;
+- TPU backend, head_dim a multiple of 128, seq a multiple of 128 →
+  Pallas kernels (block size adapts: the largest of 512/256/128 dividing
+  T — see MAX_BLOCK);
 - anything else (CPU tests on the virtual mesh, tiny toy heads) → reference.
 Set ``INTERPRET = True`` to run the kernels in Pallas interpret mode on any
 backend (used by the CPU equivalence tests).
@@ -29,9 +30,19 @@ import math
 import jax
 import jax.numpy as jnp
 
-Q_BLOCK = 128
-K_BLOCK = 128
+# Block-size ladder: the largest of these dividing T is used (bigger
+# blocks = bigger MXU dots and fewer serialized loop steps; 128x128 dots
+# measured only ~3-8% of bf16 peak at 8k context, 512-blocks ~4x that).
+# Tests can pin MAX_BLOCK = 128 to exercise multi-block paths at small T.
+MAX_BLOCK = 512
 NEG_INF = -1e30
+
+
+def _block_size(T: int) -> int:
+    for b in (MAX_BLOCK, 256, 128):
+        if b <= MAX_BLOCK and T % b == 0:
+            return b
+    return 128
 
 # Run pallas kernels in interpret mode (any backend). Tests flip this to
 # exercise the real kernel logic without TPU hardware.
@@ -56,46 +67,50 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, seq_len: int,
-                  causal: bool):
+                  causal: bool, q_block: int, k_block: int):
     """One (batch·head, q-block) program: stream K/V blocks with online
-    softmax. Block shapes: q/o [1, Q_BLOCK, Dh]; k/v [1, T, Dh];
-    lse [1, Q_BLOCK] (per-row logsumexp of the scaled scores, saved for the
+    softmax. Block shapes: q/o [1, q_block, Dh]; k/v [1, T, Dh];
+    lse [1, q_block] (per-row logsumexp of the scaled scores, saved for the
     backward kernels)."""
     import jax.experimental.pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [Bq, Dh]
+    # MXU-native inputs: keep q/k/v in their storage dtype (bf16) and let
+    # the dot accumulate in fp32 via preferred_element_type — casting the
+    # OPERANDS to fp32 forces the MXU's fp32 path at ~1/4 throughput
+    # (measured 3-7% of bf16 peak at 8k before this change)
+    q = q_ref[0]  # [Bq, Dh]
     Dh = q.shape[-1]
-    q = q * (1.0 / math.sqrt(Dh))
+    scale = 1.0 / math.sqrt(Dh)
 
-    n_kb = seq_len // K_BLOCK
+    n_kb = seq_len // k_block
     # causal: only k-blocks at or before this q-block's rows contribute
-    kb_hi = jnp.minimum(n_kb, (iq + 1) * Q_BLOCK // K_BLOCK) if causal else n_kb
+    kb_hi = jnp.minimum(n_kb, (iq + 1) * q_block // k_block) if causal else n_kb
 
     def body(kb, carry):
         acc, m, l = carry  # [Bq, Dh], [Bq, 1], [Bq, 1] — all fp32
-        k_blk = k_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * k_block, k_block), :]
+        v_blk = v_ref[0, pl.ds(kb * k_block, k_block), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [Bq, Kb]
+                                preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = iq * Q_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 0)
-            k_pos = kb * K_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 1)
+            q_pos = iq * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 0)
+            k_pos = kb * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
-    init = (jnp.zeros((Q_BLOCK, Dh), jnp.float32),
-            jnp.full((Q_BLOCK, 1), NEG_INF, jnp.float32),
-            jnp.zeros((Q_BLOCK, 1), jnp.float32))
+    init = (jnp.zeros((q_block, Dh), jnp.float32),
+            jnp.full((q_block, 1), NEG_INF, jnp.float32),
+            jnp.zeros((q_block, 1), jnp.float32))
     acc, m, l = jax.lax.fori_loop(0, kb_hi, body, init)
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
@@ -115,21 +130,24 @@ def _unfold(x, B, H):  # [B·H, T, Dh] → [B, T, H, Dh]
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool):
     """q,k,v: [B, T, H, Dh] → (out [B, T, H, Dh], lse [B·H, T, 1]) via
-    pallas_call over a (B·H, T//Q_BLOCK) grid. Full K/V per head rides VMEM
-    (≤4 MB at 8k·128 bf16), streamed blockwise inside the kernel. The lse
-    residual is a column vector — block (1, Q_BLOCK, 1) lowers because the
-    minor block dim equals the array's minor dim."""
+    pallas_call over a (B·H, T//block) grid, block = _block_size(T). Full
+    K/V per head rides VMEM (≤4 MB at 8k·128 bf16), streamed blockwise
+    inside the kernel. The lse residual is a column vector — block
+    (1, block, 1) lowers because the minor block dim equals the array's
+    minor dim."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, Dh = q.shape
+    blk = _block_size(T)
 
-    kernel = functools.partial(_flash_kernel, seq_len=T, causal=causal)
+    kernel = functools.partial(_flash_kernel, seq_len=T, causal=causal,
+                               q_block=blk, k_block=blk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, T // Q_BLOCK),
+        grid=(B * H, T // blk),
         in_specs=[
-            pl.BlockSpec((1, Q_BLOCK, Dh), lambda bh, iq: (bh, iq, 0),
+            pl.BlockSpec((1, blk, Dh), lambda bh, iq: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T, Dh), lambda bh, iq: (bh, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -137,9 +155,9 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, Q_BLOCK, Dh), lambda bh, iq: (bh, iq, 0),
+            pl.BlockSpec((1, blk, Dh), lambda bh, iq: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Q_BLOCK, 1), lambda bh, iq: (bh, iq, 0),
+            pl.BlockSpec((1, blk, 1), lambda bh, iq: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -152,93 +170,98 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, seq_len: int, causal: bool):
+                         dq_ref, *, seq_len: int, causal: bool,
+                         q_block: int, k_block: int):
     """dq for one (batch·head, q-block) program. Recomputes probability
     blocks from (q, k, lse); delta = rowsum(dO ⊙ O) is precomputed outside.
-    Block shapes: q/do/dq [1, Q_BLOCK, Dh]; k/v [1, T, Dh];
-    lse/delta [1, Q_BLOCK, 1] (per-row scalars as column vectors)."""
+    Block shapes: q/do/dq [1, q_block, Dh]; k/v [1, T, Dh];
+    lse/delta [1, q_block, 1] (per-row scalars as column vectors)."""
     import jax.experimental.pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)            # [Bq, Dh]
-    do = do_ref[0].astype(jnp.float32)          # [Bq, Dh]
+    q = q_ref[0]                                # [Bq, Dh] storage dtype
+    do = do_ref[0]                              # [Bq, Dh]
     lse = lse_ref[0]                            # [Bq, 1]
     delta = delta_ref[0]                        # [Bq, 1]
     Dh = q.shape[-1]
     scale = 1.0 / math.sqrt(Dh)
 
-    n_kb = seq_len // K_BLOCK
-    kb_hi = jnp.minimum(n_kb, (iq + 1) * Q_BLOCK // K_BLOCK) if causal else n_kb
+    n_kb = seq_len // k_block
+    kb_hi = jnp.minimum(n_kb, (iq + 1) * q_block // k_block) if causal else n_kb
 
     def body(kb, dq_acc):
-        k_blk = k_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * K_BLOCK, K_BLOCK), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * k_block, k_block), :]
+        v_blk = v_ref[0, pl.ds(kb * k_block, k_block), :]
+        # bf16 operands, fp32 accumulation — see _flash_kernel
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = iq * Q_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 0)
-            k_pos = kb * K_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 1)
+            q_pos = iq * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 0)
+            k_pos = kb * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                                     # [Bq, Kb]
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k_blk.dtype)
         return dq_acc + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, kb_hi, body,
-                           jnp.zeros((Q_BLOCK, Dh), jnp.float32))
+                           jnp.zeros((q_block, Dh), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, seq_len: int, causal: bool):
+                          dk_ref, dv_ref, *, seq_len: int, causal: bool,
+                          q_block: int, k_block: int):
     """dk/dv for one (batch·head, k-block) program: stream q-blocks.
-    Block shapes: k/v/dk/dv [1, K_BLOCK, Dh]; q/do [1, T, Dh];
+    Block shapes: k/v/dk/dv [1, k_block, Dh]; q/do [1, T, Dh];
     lse/delta [1, T, 1] (per-row scalars as column vectors)."""
     import jax.experimental.pallas as pl
 
     ik = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)            # [Bk, Dh]
-    v = v_ref[0].astype(jnp.float32)            # [Bk, Dh]
+    k = k_ref[0]                                # [Bk, Dh] storage dtype
+    v = v_ref[0]                                # [Bk, Dh]
     Dh = k.shape[-1]
     scale = 1.0 / math.sqrt(Dh)
 
-    n_qb = seq_len // Q_BLOCK
+    n_qb = seq_len // q_block
     # causal: only q-blocks at or after this k-block's rows contribute
-    qb_lo = (ik * K_BLOCK) // Q_BLOCK if causal else 0
+    qb_lo = (ik * k_block) // q_block if causal else 0
 
     def body(qb, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :]
-        delta_blk = delta_ref[0, pl.ds(qb * Q_BLOCK, Q_BLOCK), :]
+        q_blk = q_ref[0, pl.ds(qb * q_block, q_block), :]
+        do_blk = do_ref[0, pl.ds(qb * q_block, q_block), :]
+        lse_blk = lse_ref[0, pl.ds(qb * q_block, q_block), :]
+        delta_blk = delta_ref[0, pl.ds(qb * q_block, q_block), :]
+        # bf16 operands, fp32 accumulation — see _flash_kernel
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qb * Q_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 0)
-            k_pos = ik * K_BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (Q_BLOCK, K_BLOCK), 1)
+            q_pos = qb * q_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 0)
+            k_pos = ik * k_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block, k_block), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_blk)                                 # [Bq, Bk]
+        p_lo = p.astype(do_blk.dtype)
         dv_new = dv_acc + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p_lo, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [Bk, Dh]
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)                                # [Bq, Bk]
+        ds = (p * (dp - delta_blk)).astype(q_blk.dtype)          # [Bq, Bk]
         dk_new = dk_acc + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                  # [Bk, Dh]
         return dk_new, dv_new
 
-    init = (jnp.zeros((K_BLOCK, Dh), jnp.float32),
-            jnp.zeros((K_BLOCK, Dh), jnp.float32))
+    init = (jnp.zeros((k_block, Dh), jnp.float32),
+            jnp.zeros((k_block, Dh), jnp.float32))
     dk, dv = jax.lax.fori_loop(qb_lo, n_qb, body, init)
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -256,16 +279,18 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [B·H, T, 1]
 
+    blk = _block_size(T)
     qblk = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     full3 = qblk((1, T, Dh), lambda bh, i: (bh, 0, 0))
     full2 = qblk((1, T, 1), lambda bh, i: (bh, 0, 0))
-    qb3 = qblk((1, Q_BLOCK, Dh), lambda bh, i: (bh, i, 0))
-    qb2 = qblk((1, Q_BLOCK, 1), lambda bh, i: (bh, i, 0))
-    kb3 = qblk((1, K_BLOCK, Dh), lambda bh, i: (bh, i, 0))
+    qb3 = qblk((1, blk, Dh), lambda bh, i: (bh, i, 0))
+    qb2 = qblk((1, blk, 1), lambda bh, i: (bh, i, 0))
+    kb3 = qblk((1, blk, Dh), lambda bh, i: (bh, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, seq_len=T, causal=causal),
-        grid=(B * H, T // Q_BLOCK),
+        functools.partial(_flash_bwd_dq_kernel, seq_len=T, causal=causal,
+                          q_block=blk, k_block=blk),
+        grid=(B * H, T // blk),
         in_specs=[qb3, full3, full3, qb3, qb2, qb2],
         out_specs=qb3,
         out_shape=jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
@@ -273,8 +298,9 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     )(qf, kf, vf, gf, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, seq_len=T, causal=causal),
-        grid=(B * H, T // K_BLOCK),
+        functools.partial(_flash_bwd_dkv_kernel, seq_len=T, causal=causal,
+                          q_block=blk, k_block=blk),
+        grid=(B * H, T // blk),
         in_specs=[full3, kb3, kb3, full3, full2, full2],
         out_specs=[kb3, kb3],
         out_shape=[jax.ShapeDtypeStruct((B * H, T, Dh), k.dtype),
@@ -292,7 +318,7 @@ def _use_pallas(q: jax.Array) -> bool:
     if jax.default_backend() != "tpu":
         return False
     _, T, _, Dh = q.shape
-    return Dh % 128 == 0 and T % Q_BLOCK == 0 and T % K_BLOCK == 0
+    return Dh % 128 == 0 and T % 128 == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -5,8 +5,13 @@ half with the slice-unavailability window. This module is the code that
 makes the overlap real rather than aspirational: the training job saves to
 NODE-LOCAL storage (fast; only the device→host fetch gates its exit), and
 a :class:`CheckpointUploader` — deployed as a DaemonSet pod sharing the
-hostPath volume — mirrors finalized checkpoints to durable storage
-(GCS-mounted path, NFS, …) in the background. Because `drain` never
+hostPath volume (docs/checkpoint-uploader.yaml) — mirrors finalized
+checkpoints to durable storage in the background. The durable target must
+provide ATOMIC directory rename (NFS, PD, local disk): publication relies
+on rename for readers to see only complete steps. gcsfuse directory
+rename is copy+delete, NOT atomic — for GCS targets, mirror to a
+rename-atomic spool and upload objects from there, or gate readers on a
+separate completion marker. Because `drain` never
 evicts DaemonSet pods (IgnoreAllDaemonSets, the reference's own drain
 contract — drain_manager.go:76-96), the mirror keeps running while the
 job is torn down, the old libtpu pods are evicted, and the driver
@@ -79,10 +84,19 @@ def mirror_once(local_dir: str, durable_dir: str) -> int:
         shutil.copytree(src, staging)
         try:
             os.rename(staging, dst)  # readers see complete steps only
-        except OSError:
-            # a concurrent uploader published this step first — both
-            # copies were complete, so discarding ours is lossless
+        except OSError as exc:
             shutil.rmtree(staging, ignore_errors=True)
+            if os.path.isdir(dst):
+                # a concurrent uploader published this step first — both
+                # copies were complete, so discarding ours is lossless
+                logger.info("step %s already published by a concurrent "
+                            "uploader; discarded our copy", step)
+            else:
+                # genuine rename failure (exotic filesystem, permissions):
+                # the step is NOT durable — say so loudly and retry next
+                # pass rather than silently losing it
+                logger.error("failed to publish checkpoint step %s -> %s: "
+                             "%s (will retry)", step, dst, exc)
             continue
         mirrored += 1
         logger.info("mirrored checkpoint step %s -> %s", step, durable_dir)
@@ -92,9 +106,25 @@ def mirror_once(local_dir: str, durable_dir: str) -> int:
 _STALE_STAGING_SECONDS = 3600.0
 
 
+def _newest_mtime(root: str) -> float:
+    """Most recent mtime anywhere in the tree — the top-level dir's mtime
+    alone does not change while a copy writes into SUBdirectories, and
+    sweeping on it could delete a live slow copy mid-flight."""
+    newest = os.path.getmtime(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        for n in dirnames + filenames:
+            try:
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(dirpath, n)))
+            except OSError:
+                continue
+    return newest
+
+
 def _sweep_stale_staging(durable_dir: str) -> None:
-    """Remove crashed attempts' staging dirs once they are old enough that
-    no live uploader can still be writing them (bounded disk debris)."""
+    """Remove crashed attempts' staging dirs once NOTHING in them has been
+    written for _STALE_STAGING_SECONDS (bounded disk debris; a live copy —
+    however slow — keeps touching files and is never swept)."""
     now = time.time()
     try:
         names = os.listdir(durable_dir)
@@ -105,7 +135,7 @@ def _sweep_stale_staging(durable_dir: str) -> None:
             continue
         path = os.path.join(durable_dir, n)
         try:
-            if now - os.path.getmtime(path) > _STALE_STAGING_SECONDS:
+            if now - _newest_mtime(path) > _STALE_STAGING_SECONDS:
                 shutil.rmtree(path, ignore_errors=True)
         except OSError:
             continue

@@ -1,0 +1,294 @@
+"""Error branches and threaded (non-synchronous) manager paths.
+
+The main suites run managers with ``synchronous=True`` for determinism, so
+the goroutine-analog thread paths (reference drain_manager.go:98-137,
+pod_manager.go:162-230) and several failure branches were untested
+(cov.json). These tests close that: real managers, real fake-apiserver,
+real threads joined via wait_idle."""
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec, PodDeletionSpec
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.pod_manager import (
+    PodManager,
+    PodManagerConfig,
+    daemonset_revision_hash,
+)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory, StringSet
+
+NS = "kube-system"
+
+
+@pytest.fixture
+def provider(cluster, keys, clock):
+    return NodeUpgradeStateProvider(cluster.client, keys, cluster.recorder,
+                                    clock)
+
+
+def state_of(cluster, keys, name):
+    return cluster.client.direct().get_node(name).metadata.labels.get(
+        keys.state_label, "")
+
+
+# ------------------------------------------------------- threaded drain
+
+
+def test_threaded_drain_advances_nodes(cluster, provider, keys, clock):
+    """synchronous=False: one thread per node (reference goroutine-per-node,
+    drain_manager.go:98-137), states land after wait_idle."""
+    for i in range(3):
+        cluster.add_node(f"n{i}")
+        cluster.add_pod(f"w{i}", f"n{i}", labels={"app": "x"})
+    mgr = DrainManager(cluster.client, provider, keys, cluster.recorder,
+                       clock, synchronous=False)
+    nodes = [cluster.client.direct().get_node(f"n{i}") for i in range(3)]
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        nodes=nodes))
+    mgr.wait_idle()
+    for i in range(3):
+        assert state_of(cluster, keys, f"n{i}") == \
+            UpgradeState.POD_RESTART_REQUIRED
+        assert cluster.client.direct().get_node(f"n{i}").spec.unschedulable
+    assert len(mgr.draining_nodes) == 0  # finally-block released every claim
+
+
+def test_threaded_drain_dedups_inflight_nodes(cluster, provider, keys, clock):
+    """A node already claimed by an in-flight drain is skipped (StringSet
+    add_if_absent — reference drain_manager.go:98-108)."""
+    cluster.add_node("n0")
+    mgr = DrainManager(cluster.client, provider, keys, cluster.recorder,
+                       clock, synchronous=False)
+    node = cluster.client.direct().get_node("n0")
+    assert mgr.draining_nodes.add_if_absent("n0")  # simulate in-flight claim
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        nodes=[node]))
+    mgr.wait_idle()
+    # skipped: no state transition happened
+    assert state_of(cluster, keys, "n0") == ""
+    mgr.draining_nodes.remove("n0")
+
+
+def test_threaded_drain_failure_moves_node_to_failed(cluster, provider, keys,
+                                                     clock):
+    """Un-evictable pod (unmanaged, no force) on the threaded path →
+    upgrade-failed with a Warning event (drain_manager.go:122-128)."""
+    cluster.add_node("n0")
+    cluster.add_pod("stubborn", "n0")  # no owner, force=False → refused
+    mgr = DrainManager(cluster.client, provider, keys, cluster.recorder,
+                       clock, synchronous=False)
+    node = cluster.client.direct().get_node("n0")
+    mgr.schedule_nodes_drain(DrainConfiguration(
+        spec=DrainSpec(enable=True, force=False, timeout_second=5),
+        nodes=[node]))
+    mgr.wait_idle()
+    assert state_of(cluster, keys, "n0") == UpgradeState.FAILED
+    assert any(e.event_type == "Warning" and "drain" in e.message.lower()
+               for e in cluster.recorder.drain())
+
+
+# ---------------------------------------------------- threaded eviction
+
+
+def test_threaded_pod_eviction_advances_node(cluster, provider, keys, clock):
+    cluster.add_node("n0")
+    cluster.add_pod("w0", "n0", labels={"evict": "yes"})
+    mgr = PodManager(cluster.client, provider, keys,
+                     lambda p: p.metadata.labels.get("evict") == "yes",
+                     cluster.recorder, clock, synchronous=False)
+    node = cluster.client.direct().get_node("n0")
+    mgr.schedule_pod_eviction(PodManagerConfig(
+        nodes=[node], deletion_spec=PodDeletionSpec(force=True),
+        drain_enabled=False))
+    mgr.wait_idle()
+    assert state_of(cluster, keys, "n0") == UpgradeState.POD_RESTART_REQUIRED
+    assert cluster.client.direct().list_pods(namespace="default") == []
+
+
+def test_threaded_eviction_dedup_and_empty_config(cluster, provider, keys,
+                                                  clock):
+    mgr = PodManager(cluster.client, provider, keys, lambda p: True,
+                     cluster.recorder, clock, synchronous=False)
+    # empty node list: no-op, no error (reference 'should not fail on
+    # empty input')
+    mgr.schedule_pod_eviction(PodManagerConfig(
+        nodes=[], deletion_spec=PodDeletionSpec()))
+    # missing spec: loud error (pod_manager.go guards the nil spec)
+    cluster.add_node("n0")
+    node = cluster.client.direct().get_node("n0")
+    with pytest.raises(ValueError, match="deletion spec"):
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=None))
+    # in-flight dedup: claimed node is skipped
+    assert mgr._in_progress.add_if_absent("n0")
+    mgr.schedule_pod_eviction(PodManagerConfig(
+        nodes=[node], deletion_spec=PodDeletionSpec()))
+    mgr.wait_idle()
+    assert state_of(cluster, keys, "n0") == ""
+
+
+def test_eviction_failure_without_drain_goes_failed(cluster, provider, keys,
+                                                    clock):
+    """Partial eviction failure with drain DISABLED → upgrade-failed
+    directly (reference updateNodeToDrainOrFailed, pod_manager.go:396-406)."""
+    from k8s_operator_libs_tpu.core.objects import Volume
+    cluster.add_node("n0")
+    cluster.add_pod("empty-dir-pod", "n0", labels={"evict": "yes"})
+    pod = cluster.get("Pod", "default", "empty-dir-pod")
+    pod.spec.volumes = [Volume(name="c", empty_dir=True)]
+    cluster.update(pod)
+    mgr = PodManager(cluster.client, provider, keys,
+                     lambda p: p.metadata.labels.get("evict") == "yes",
+                     cluster.recorder, clock, synchronous=True)
+    node = cluster.client.direct().get_node("n0")
+    mgr.schedule_pod_eviction(PodManagerConfig(
+        nodes=[node],
+        deletion_spec=PodDeletionSpec(force=True, delete_empty_dir=False),
+        drain_enabled=False))
+    assert state_of(cluster, keys, "n0") == UpgradeState.FAILED
+
+
+# ------------------------------------------------------ revision errors
+
+
+def test_revision_hash_error_paths(cluster, keys, clock, provider):
+    ds = cluster.add_daemonset("drv", NS, revision_hash="v1")
+    cluster.add_node("n0")
+    pod = cluster.add_pod("p0", "n0", namespace=NS, owner_ds=ds,
+                          revision_hash="v1")
+    mgr = PodManager(cluster.client, provider, keys, None,
+                     cluster.recorder, clock, synchronous=True)
+    # pod without the revision label
+    del pod.metadata.labels["controller-revision-hash"]
+    cluster.update(pod)
+    live = cluster.client.direct().get_pod(NS, "p0")
+    with pytest.raises(ValueError, match="controller-revision-hash"):
+        mgr.get_pod_controller_revision_hash(live)
+    # DaemonSet with no ControllerRevisions
+    orphan_ds = cluster.add_daemonset("lonely", NS, revision_hash="v1")
+    cluster._store.pop(  # drop its revisions to simulate the broken state
+        next(k for k in list(cluster._store)
+             if k[0] == "ControllerRevision" and "lonely" in k[2]))
+    with pytest.raises(ValueError, match="no ControllerRevisions"):
+        daemonset_revision_hash(cluster.client.direct(), orphan_ds)
+
+
+# ------------------------------------------------------ scheduler edges
+
+
+def test_scheduler_rejects_bad_num_slices_and_non_tpu_nodes(cluster):
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler, TPUWorkload
+    cluster.add_node("cpu-only")  # no TPU labels: skipped by inventory
+    sched = SliceScheduler(cluster.client)
+    assert sched.eligible_slices("tpu-v5-lite-podslice", "4x4") == {}
+    with pytest.raises(ValueError, match="num_slices"):
+        sched.place(TPUWorkload(name="w", accelerator="tpu-v5-lite-podslice",
+                                topology="4x4", num_slices=0))
+
+
+def test_scheduler_adoption_with_vanished_node(cluster):
+    """Adoption reconstructs a Placement even when a pod's node no longer
+    exists (KeyError path falls back to the node name as slice id)."""
+    from k8s_operator_libs_tpu.tpu.scheduler import (WORKLOAD_LABEL,
+                                                     SliceScheduler,
+                                                     TPUWorkload)
+    sched = SliceScheduler(cluster.client)
+    # a full single-host pod set referencing a node that's gone
+    cluster.add_pod("w-0", "gone-node", labels={WORKLOAD_LABEL: "w"})
+    placement = sched.place(TPUWorkload(
+        name="w", accelerator="tpu-v5-lite-device", topology="2x4"))
+    assert placement is not None
+    assert placement.pods == ["w-0"]
+    assert placement.slice_ids == ["gone-node"]
+
+
+def test_scheduler_without_create_capabilities(cluster):
+    """A client that can create neither Services nor Pods: the Service gap
+    logs a warning (DNS must be pre-created), the Pod gap is a loud
+    NotImplementedError — misconfiguration, never a silent no-op."""
+    from k8s_operator_libs_tpu.tpu.scheduler import SliceScheduler, TPUWorkload
+    from k8s_operator_libs_tpu.tpu.topology import (GKE_ACCELERATOR_LABEL,
+                                                    GKE_NODEPOOL_LABEL,
+                                                    GKE_TOPOLOGY_LABEL)
+    cluster.add_node("h0", labels={
+        GKE_ACCELERATOR_LABEL: "tpu-v5-lite-device",
+        GKE_TOPOLOGY_LABEL: "2x4", GKE_NODEPOOL_LABEL: "pool"})
+
+    class NoCreateClient:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def direct(self):
+            return self
+
+        def __getattr__(self, attr):
+            if attr in ("create_pod", "create_service"):
+                raise AttributeError(attr)
+            return getattr(self._inner.direct(), attr)
+
+    sched = SliceScheduler(NoCreateClient(cluster.client))
+    with pytest.raises(NotImplementedError, match="pod creation"):
+        sched.place(TPUWorkload(name="w",
+                                accelerator="tpu-v5-lite-device",
+                                topology="2x4"))
+
+
+# ----------------------------------------------------------------- util
+
+
+def test_stringset_and_keyfactory_edges():
+    s = StringSet()
+    s.add("a")
+    assert s.has("a") and len(s) == 1
+    assert not s.add_if_absent("a")  # already present
+    s.remove("a")
+    s.remove("a")  # discard semantics: no error
+    assert len(s) == 0
+    with pytest.raises(ValueError, match="non-empty"):
+        KeyFactory("")
+
+
+def test_policy_validation_and_roundtrip_edges():
+    """Policy spec: every validate() branch raises on its own bad field;
+    from_dict/to_dict round-trips all sub-specs (upgrade_spec.go defaults +
+    kubebuilder validation analog)."""
+    from k8s_operator_libs_tpu.api.v1alpha1 import (
+        DrainSpec, DriverUpgradePolicySpec, PodDeletionSpec,
+        WaitForCompletionSpec, scaled_int_or_percent)
+
+    with pytest.raises(ValueError, match="int-or-percent"):
+        scaled_int_or_percent("banana", 100)
+    assert scaled_int_or_percent("25%", 10, round_up=True) == 3
+    assert scaled_int_or_percent("25%", 10, round_up=False) == 2
+    assert scaled_int_or_percent(4, 10) == 4
+    with pytest.raises(ValueError, match="maxParallelUpgrades"):
+        DriverUpgradePolicySpec(auto_upgrade=True,
+                                max_parallel_upgrades=-1).validate()
+    with pytest.raises(ValueError, match="timeoutSecond"):
+        WaitForCompletionSpec(timeout_second=-1).validate()
+    full = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=2, max_unavailable="50%",
+        wait_for_completion=WaitForCompletionSpec(pod_selector="job=x",
+                                                  timeout_second=60),
+        pod_deletion=PodDeletionSpec(force=True, delete_empty_dir=True),
+        drain=DrainSpec(enable=True, force=True, timeout_second=120))
+    full.validate()
+    back = DriverUpgradePolicySpec.from_dict(full.to_dict())
+    assert back == full
+
+
+def test_parse_selector_invalid_term():
+    from k8s_operator_libs_tpu.upgrade.util import parse_selector
+    with pytest.raises(ValueError, match="invalid selector term"):
+        parse_selector("a=b,banana")
+    assert parse_selector("") is None
+    assert parse_selector(" a=b , c=d ") == {"a": "b", "c": "d"}

@@ -307,7 +307,7 @@ def test_uploader_mirrors_drain_checkpoint_after_job_exit(rng, tmp_path):
         trainer.close()  # job pod exits — uploader must outlive it
         assert up.wait_idle(timeout=30.0), "uploader never caught up"
     assert _finalized_steps(durable) == ["0"]
-    assert not any(n.endswith(".uploading") for n in
+    assert not any(".uploading" in n for n in
                    __import__("os").listdir(durable))
     # the resumed job (new slice) restores from DURABLE storage
     trainer2 = CheckpointingTrainer(CFG, durable, mesh=mesh)
@@ -345,6 +345,7 @@ def test_uploader_mirror_once_is_idempotent_and_crash_safe(tmp_path,
     (stale / "garbage").write_text("stale")
     old = __import__("time").time() - 2 * uploader._STALE_STAGING_SECONDS
     os.utime(stale, (old, old))
+    os.utime(stale / "garbage", (old, old))  # deep mtime check needs both
     assert mirror_once(str(local), str(durable)) == 1
     assert (durable / "9" / "data").read_text() == "new"
     assert not stale.exists(), "stale staging debris not swept"

@@ -41,12 +41,31 @@ def test_flash_forward_matches_reference(causal):
 
 
 def test_flash_forward_multiblock_rows():
-    # T spans 4 q-blocks and 4 k-blocks; exercises the causal kb_hi clamp
-    q, k, v = _qkv(jax.random.PRNGKey(1), T=512)
-    out = attention._flash_attention(q, k, v, True)
-    ref = attention.reference_attention(q, k, v, True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               atol=2e-5, rtol=2e-5)
+    # T spans 4 q-blocks and 4 k-blocks; exercises the causal kb_hi clamp.
+    # MAX_BLOCK pinned to 128 so T=512 genuinely multi-blocks (the adaptive
+    # ladder would otherwise pick one 512 block).
+    old = attention.MAX_BLOCK
+    attention.MAX_BLOCK = 128
+    try:
+        q, k, v = _qkv(jax.random.PRNGKey(1), T=512)
+        out = attention._flash_attention(q, k, v, True)
+        ref = attention.reference_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+    finally:
+        attention.MAX_BLOCK = old
+
+
+def test_flash_block_ladder():
+    assert attention._block_size(8192) == 512
+    assert attention._block_size(512 + 256) == 256  # 768 % 512 != 0
+    assert attention._block_size(384) == 128
+    old = attention.MAX_BLOCK
+    attention.MAX_BLOCK = 128
+    try:
+        assert attention._block_size(8192) == 128
+    finally:
+        attention.MAX_BLOCK = old
 
 
 @pytest.mark.parametrize("causal", [True, False])

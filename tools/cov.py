@@ -118,11 +118,12 @@ def report(hits: Dict[str, Set[int]], out_path: Path) -> float:
             got = hits.get(str(path.resolve()), set()) & exe
             total_exec += len(exe)
             total_hit += len(got)
-            rows.append((str(path.relative_to(REPO)), len(got), len(exe)))
+            rows.append((str(path.relative_to(REPO)), len(got), len(exe),
+                         sorted(exe - got)))
     pct = 100.0 * total_hit / total_exec if total_exec else 0.0
     rows.sort(key=lambda r: r[1] / r[2])
     print("\n--- coverage (tools/cov.py, sys.monitoring) ---")
-    for rel, got, exe in rows[:12]:
+    for rel, got, exe, _missing in rows[:12]:
         print(f"  {100.0 * got / exe:5.1f}%  {got:>5}/{exe:<5}  {rel}")
     if len(rows) > 12:
         print(f"  ... {len(rows) - 12} more files in cov.json")
@@ -132,8 +133,9 @@ def report(hits: Dict[str, Set[int]], out_path: Path) -> float:
         "total_pct": round(pct, 2),
         "lines_hit": total_hit, "lines_executable": total_exec,
         "files": {rel: {"hit": got, "executable": exe,
-                        "pct": round(100.0 * got / exe, 2)}
-                  for rel, got, exe in rows}}, indent=1))
+                        "pct": round(100.0 * got / exe, 2),
+                        "missing": missing}
+                  for rel, got, exe, missing in rows}}, indent=1))
     print(f"full table: {out_path}")
     return pct
 
