@@ -346,3 +346,31 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if _use_pallas(q):
         return _flash_attention(q, k, v, causal)
     return reference_attention(q, k, v, causal)
+
+
+def reference_attention_with_lse(q, k, v, causal: bool = True):
+    """reference_attention that also returns the per-row logsumexp of the
+    scaled scores — the residual chunk-merging needs (ring attention)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)            # [B, H, Tq, 1]
+    p = jnp.exp(logits - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / l).astype(q.dtype), v)
+    return out, m + jnp.log(l)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True):
+    """(attention output, per-row logsumexp [B, H, T, 1]) — the pair a
+    consumer needs to MERGE partial attentions over key chunks (ring
+    attention's per-step block). Pallas on TPU, reference elsewhere."""
+    B, T, H, _ = q.shape
+    if _use_pallas(q):
+        out, lse = _flash_forward(q, k, v, causal)
+        return out, lse.reshape(B, H, T, 1)
+    return reference_attention_with_lse(q, k, v, causal)

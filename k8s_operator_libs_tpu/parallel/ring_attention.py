@@ -22,7 +22,6 @@ Usage: inside ``shard_map`` over a mesh with a "seq" axis (see
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
@@ -34,46 +33,70 @@ NEG_INF = -1e30
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "seq", causal: bool = True) -> jax.Array:
     """Per-device body (call under shard_map). q,k,v: local chunks
-    [B, Tl, H, Dh], sequence-sharded over ``axis_name``."""
+    [B, Tl, H, Dh], sequence-sharded over ``axis_name``.
+
+    Each ring step computes the (resident q-chunk x visiting kv-chunk)
+    attention through the FLASH kernel (ops.attention.flash_attention_
+    with_lse — Pallas on TPU, reference on CPU), so the [Tl, Tl] score
+    matrix stays blocked in VMEM instead of materializing in HBM; the
+    per-chunk (out, lse) partials are then merged with the standard
+    logsumexp reweighting. Causality resolves per chunk pair: a visiting
+    chunk from EARLIER in the sequence is fully visible (non-causal
+    block), the diagonal chunk takes the triangular mask, a LATER chunk
+    contributes nothing (lse = -inf)."""
+    from ..ops.attention import flash_attention_with_lse
+
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, Tl, H, Dh = q.shape
-    scale = 1.0 / math.sqrt(Dh)
-    qf = q.astype(jnp.float32) * scale
+
+    def full_block(kc, vc):
+        return flash_attention_with_lse(q, kc, vc, causal=False)
+
+    def diag_block(kc, vc):
+        return flash_attention_with_lse(q, kc, vc, causal=True)
+
+    def masked_block(kc, vc):
+        # pcast: constants are replicated by default; the other branches'
+        # outputs are device-varying over the seq axis, and lax.switch
+        # requires matching types
+        return (jax.lax.pcast(jnp.zeros((B, Tl, H, Dh), q.dtype),
+                              axis_name, to='varying'),
+                jax.lax.pcast(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32),
+                              axis_name, to='varying'))
 
     def step(carry, s):
-        acc, m, l, kc, vc = carry
+        acc, lse, kc, vc = carry
         src = (my - s) % n  # which chunk we currently hold
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
         if causal:
-            q_pos = my * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
-            k_pos = src * Tl + jax.lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
-            logits = jnp.where((q_pos >= k_pos)[None, None], logits, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
-        p = jnp.exp(logits - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * jnp.swapaxes(alpha, 1, 2) + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
+            # 0: src < my (fully visible) · 1: diagonal · 2: src > my (none)
+            mode = (src == my).astype(jnp.int32) \
+                + 2 * (src > my).astype(jnp.int32)
+            o_s, lse_s = jax.lax.switch(
+                mode, [full_block, diag_block, masked_block], kc, vc)
+        else:
+            o_s, lse_s = full_block(kc, vc)
+        # merge normalized partials: o = Σ o_i · exp(lse_i − lse_new)
+        lse_new = jnp.logaddexp(lse, lse_s)
+        w_old = jnp.exp(lse - lse_new)
+        w_new = jnp.exp(lse_s - lse_new)
+        acc_new = (acc * jnp.swapaxes(w_old, 1, 2)
+                   + o_s.astype(jnp.float32) * jnp.swapaxes(w_new, 1, 2))
         # pass the K/V chunk to the next device in the ring
         perm = [(i, (i + 1) % n) for i in range(n)]
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (acc_new, m_new, l_new, kc, vc), None
+        return (acc_new, lse_new, kc, vc), None
 
     # pvary: the accumulators are device-varying over the seq axis (each
     # device owns different rows) — required carry typing under shard_map
     init = (
         jax.lax.pcast(jnp.zeros((B, Tl, H, Dh), jnp.float32), axis_name, to='varying'),
         jax.lax.pcast(jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32), axis_name, to='varying'),
-        jax.lax.pcast(jnp.zeros((B, H, Tl, 1), jnp.float32), axis_name, to='varying'),
         k, v,
     )
-    (acc, m, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    denom = jnp.swapaxes(jnp.maximum(l, 1e-30), 1, 2)  # [B, Tl, H, 1]
-    return (acc / denom).astype(q.dtype)
+    (acc, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return acc.astype(q.dtype)
 
 
 def make_ring_attention(mesh: Mesh, causal: bool = True,
