@@ -17,12 +17,15 @@ TPU-first design choices:
 - bfloat16 activations/weights by default — the MXU's native input dtype —
   with fp32 RMSNorm accumulation and fp32 logits for a stable loss;
 - GQA (grouped-query attention) exactly as Llama-3: n_kv_heads < n_heads,
-  K/V heads repeated at attention time;
+  with K/V kept at KV heads all the way into the kernel (the flash kernel
+  is GQA-native — no repeat, no K/V bandwidth multiplier);
 - attention goes through :func:`k8s_operator_libs_tpu.ops.attention.
   flash_attention` — a Pallas fused kernel on TPU, a reference einsum path
   elsewhere;
-- optional ``jax.checkpoint`` (remat) over each block to trade FLOPs for HBM
-  when training with long sequences.
+- optional remat over each block trades FLOPs for HBM when training with
+  long sequences — with a checkpoint policy that SAVES the flash kernel's
+  output so the backward never re-runs the forward kernel (see
+  :func:`remat_block`).
 """
 
 from __future__ import annotations
@@ -34,10 +37,32 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
-from ..ops.attention import flash_attention
+from ..ops.attention import ATTN_LSE_NAME, ATTN_OUT_NAME, flash_attention
 
 Params = Dict[str, Any]
+
+# Remat policy (VERDICT r3 #2): under cfg.remat, SAVE the attention output
+# and logsumexp — tagged inside the flash custom_vjp's forward rule (the
+# residual pair the backward kernels consume) and on the block-level attn
+# output in every block flavor (_block here, composed.tp_block,
+# moe.moe_block) — instead of rematerializing the whole block. Both are
+# O(T·d)/O(T) (cheap to keep) while recomputing them means re-running the
+# flash forward kernel, the most expensive op in the block; the MLP/norm
+# intermediates stay rematerialized, which is where the HBM savings
+# actually live. tests/test_jax_stack.py pins the kernel-count claim on
+# the traced jaxpr.
+ATTN_OUT_CKPT = ATTN_OUT_NAME
+
+
+def remat_block(block_fn):
+    """jax.checkpoint with the save-attention-output policy — the one remat
+    wrapper every scanned block in the framework uses."""
+    return jax.checkpoint(
+        block_fn,
+        policy=jax.checkpoint_policies.save_only_these_names(
+            ATTN_OUT_NAME, ATTN_LSE_NAME))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,11 +190,10 @@ def _block(cfg: LlamaConfig, attn_fn, x: jax.Array, layer: Params,
     v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    if KV != H:  # GQA: repeat K/V heads to match query heads
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    attn = attn_fn(q, k, v)
+    # GQA K/V stay at KV heads — the flash kernel consumes them natively
+    # (ops/attention.py folds the query group into its q-block; the old
+    # jnp.repeat here cost H/KV x the K/V bandwidth + VMEM every step)
+    attn = checkpoint_name(attn_fn(q, k, v), ATTN_OUT_CKPT)
     x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
 
     h = rms_norm(x, layer["mlp_norm"])
@@ -199,7 +223,7 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
     block_fn = partial(_block, cfg, attn_fn or _default_attn)
     if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+        block_fn = remat_block(block_fn)
 
     def scan_body(carry, layer):
         return block_fn(carry, layer, positions), None

@@ -31,9 +31,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.attention import flash_attention
-from .llama import LlamaConfig, rms_norm, rope
+from .llama import ATTN_OUT_CKPT, LlamaConfig, remat_block, rms_norm, rope
 
 Params = Dict[str, Any]
 
@@ -218,11 +219,9 @@ def moe_block(x: jax.Array, layer: Params, cfg: MoEConfig,
     k = rope((h @ layer["wk"]).reshape(B, T, KV, Dh), positions,
              cfg.rope_theta)
     v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
-    if KV != H:
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    attn = flash_attention(q, k, v, causal=True)
+    # GQA handled inside the flash kernel (no K/V repeat)
+    attn = checkpoint_name(flash_attention(q, k, v, causal=True),
+                           ATTN_OUT_CKPT)
     x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
     h2 = rms_norm(x, layer["mlp_norm"])
     if ffn_fn is not None:
@@ -256,7 +255,7 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
                          experts_slice=experts_slice, ep_axis=ep_axis,
                          ffn_fn=ffn_fn)
 
-    block_fn = jax.checkpoint(block) if cfg.remat else block
+    block_fn = remat_block(block) if cfg.remat else block
 
     def scan_body(carry, layer):
         x, aux_total = carry

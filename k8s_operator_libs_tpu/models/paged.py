@@ -75,24 +75,34 @@ def plan_blocks(seq_capacities: Sequence[int],
                 ) -> Tuple[np.ndarray, int]:
     """Host-side allocation plan: per-sequence capacities (prompt +
     max_new_tokens each) → (block table [B, MB], pool size NB). Sequences
-    get exactly ``ceil(cap / block_size)`` blocks; unused table slots point
-    at block 0 but are never addressed (masked by lengths)."""
+    get exactly ``ceil(cap / block_size)`` blocks; unused table slots —
+    and, via index clamping, writes past a sequence's capacity (a
+    right-padded prompt batch where one sequence's capacity is shorter
+    than the padded prompt) — route to a dedicated SCRATCH block appended
+    at pool index NB-1. Reads never see it: scratch-backed logical
+    positions sit at ``n_blocks·block_size > q_pos`` so the validity mask
+    hides them. Before r4 unused slots pointed at block 0, so a ragged
+    batch's padding writes corrupted sequence 0's cache."""
     n_blocks = [max(1, -(-int(c) // block_size)) for c in seq_capacities]
     mb = max(n_blocks)
-    table = np.zeros((len(seq_capacities), mb), dtype=np.int32)
     nxt = 0
-    for b, n in enumerate(n_blocks):
-        table[b, :n] = np.arange(nxt, nxt + n, dtype=np.int32)
+    spans = []
+    for n in n_blocks:
+        spans.append((nxt, n))
         nxt += n
-    return table, nxt
+    scratch = nxt
+    table = np.full((len(seq_capacities), mb), scratch, dtype=np.int32)
+    for b, (start, n) in enumerate(spans):
+        table[b, :n] = np.arange(start, start + n, dtype=np.int32)
+    return table, scratch + 1
 
 
 def init_paged_cache(cfg: LlamaConfig, seq_capacities: Sequence[int],
                      block_size: int = DEFAULT_BLOCK_SIZE,
                      dtype=None) -> PagedKVCache:
     """Pool sized to the SUM of per-sequence capacities (rounded up to
-    blocks) — a ragged batch of short sequences costs what it uses, not
-    ``B x max``."""
+    blocks, plus the shared scratch block — see :func:`plan_blocks`) — a
+    ragged batch of short sequences costs what it uses, not ``B x max``."""
     L, KV, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     dtype = dtype or cfg.dtype
     table, nb = plan_blocks(seq_capacities, block_size)
@@ -123,6 +133,139 @@ def _paged_view(pool: jax.Array, table: jax.Array) -> jax.Array:
     bs = pool.shape[1]
     gathered = pool[table]  # [B, MB, BS, KV, Dh]
     return gathered.reshape(B, mb * bs, *pool.shape[2:])
+
+
+# Mirrors ops.attention.INTERPRET: run the paged decode kernel in Pallas
+# interpret mode on any backend (CPU equivalence tests).
+INTERPRET = False
+
+
+def _use_paged_kernel(q: jax.Array) -> bool:
+    """Decode steps (Tq == 1) on TPU with lane-aligned head_dim go through
+    the Pallas block-walk kernel; prefill and CPU keep the gather path."""
+    if q.shape[1] != 1 or q.shape[3] % 128:
+        return False
+    return INTERPRET or jax.default_backend() == "tpu"
+
+
+def _paged_decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+                         k_buf, v_buf, sem, *, block_size: int, n_kv: int):
+    """One sequence's single-token paged attention: walk the block table
+    IN PLACE — the pools stay in HBM (memory_space=ANY) and the kernel
+    batch-starts one async copy per LIVE table entry into a contiguous
+    VMEM buffer, waits once, then runs one fused masked-softmax
+    attention over it. Each pool byte is read exactly once (same traffic
+    as the contiguous cache) and nothing is materialized in HBM —
+    VERDICT r3 #3: the gather path (pool[table] → [B, cap] copy) paid
+    read-pool + write-copy + read-copy and measured 20% slower than
+    contiguous. Batched starts matter: a serial start→wait walk leaves
+    the ~µs per-DMA latency exposed on every 8 KB block; batched, the
+    copies overlap and the latency is paid once.
+
+    GQA is grouped (cache never repeated): per K/V head, the G query
+    heads attend via one [G, cap] score tile.
+
+    Grid (B,); scalar-prefetched table [B, MB] / lengths [B]; q/o blocks
+    [1, H, Dh]; k/v pools [NB, BS, KV, Dh] unblocked; scratch: one
+    [MB·BS, KV, Dh] buffer per pool + one shared DMA semaphore."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    H, Dh = q_ref.shape[1], q_ref.shape[2]
+    G = H // n_kv
+    cap = k_buf.shape[0]
+    scale = 1.0 / math.sqrt(Dh)
+    q_pos = len_ref[b]                       # decode position = cache len
+    n_live = q_pos // block_size + 1         # blocks with visible keys
+
+    def copies(mb):
+        dst = pl.ds(mb * block_size, block_size)
+        idx = table_ref[b, mb]
+        return (pltpu.make_async_copy(kp_ref.at[idx], k_buf.at[dst], sem),
+                pltpu.make_async_copy(vp_ref.at[idx], v_buf.at[dst], sem))
+
+    def start(mb, _):
+        ck, cv = copies(mb)
+        ck.start()
+        cv.start()
+        return 0
+
+    def wait(mb, _):
+        ck, cv = copies(mb)
+        ck.wait()
+        cv.wait()
+        return 0
+
+    jax.lax.fori_loop(0, n_live, start, 0)
+
+    # dead blocks (≥ n_live) hold stale/uninitialized buffer contents.
+    # K is safe (its scores are masked before use, independent of value)
+    # but V rides the p·V contraction where masked p is exactly 0 and
+    # 0 · garbage can be NaN — zero the dead V blocks while the DMAs fly
+    def zero_dead(mb, _):
+        v_buf[pl.ds(mb * block_size, block_size)] = jnp.zeros(
+            (block_size,) + v_buf.shape[1:], v_buf.dtype)
+        return 0
+
+    n_blocks = cap // block_size
+    jax.lax.fori_loop(n_live, n_blocks, zero_dead, 0)
+    jax.lax.fori_loop(0, n_live, wait, 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, cap), 1)
+    valid = k_pos <= q_pos                   # [1, cap], lane-major
+    outs = []
+    for kv in range(n_kv):                   # static loop, KV is small
+        q_kv = q_ref[0, kv * G:(kv + 1) * G, :]            # [G, Dh]
+        s = jax.lax.dot_general(
+            q_kv, k_buf[:, kv, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [G, cap]
+        s = jnp.where(valid, s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        outs.append(jax.lax.dot_general(
+            (p / l).astype(v_buf.dtype), v_buf[:, kv, :],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))            # [G, Dh]
+    o_ref[0] = jnp.concatenate(outs, axis=0).astype(o_ref.dtype)
+
+
+def _attend_paged_kernel(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                         table: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Dispatch :func:`_paged_decode_kernel`. q [B, 1, H, Dh]; pools
+    [NB, BS, KV, Dh]; table [B, MB]; lengths [B] (the per-sequence decode
+    position). Returns [B, 1, H, Dh]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, _, H, Dh = q.shape
+    NB, BS, KV, _ = k_pool.shape
+    MB = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda b, t, ln: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda b, t, ln: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((MB * BS, KV, Dh), k_pool.dtype),
+            pltpu.VMEM((MB * BS, KV, Dh), v_pool.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = partial(_paged_decode_kernel, block_size=BS, n_kv=KV)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        interpret=INTERPRET,
+    )(table, lengths, q[:, 0], k_pool, v_pool)
+    return out[:, None]
 
 
 def _attend_paged(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
@@ -171,8 +314,16 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
         k = rope(k, pos, cfg.rope_theta)
         k_pool_l = _paged_write(k_pool_l, cache.table, cache.lengths, k)
         v_pool_l = _paged_write(v_pool_l, cache.table, cache.lengths, v)
-        attn = _attend_paged(cfg, q, _paged_view(k_pool_l, cache.table),
-                             _paged_view(v_pool_l, cache.table), pos)
+        cap_bytes = (2 * cache.capacity_per_seq * KV * Dh
+                     * jnp.dtype(k_pool_l.dtype).itemsize)
+        if _use_paged_kernel(q) and cap_bytes <= 8 * 1024 * 1024:
+            # decode: walk the block table in place (no gathered copy)
+            attn = _attend_paged_kernel(q, k_pool_l, v_pool_l,
+                                        cache.table, cache.lengths)
+        else:
+            # prefill / CPU: gather view + masked reference attention
+            attn = _attend_paged(cfg, q, _paged_view(k_pool_l, cache.table),
+                                 _paged_view(v_pool_l, cache.table), pos)
         x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
         h2 = rms_norm(x, layer["mlp_norm"])
         gate = jax.nn.silu((h2 @ layer["w_gate"]).astype(jnp.float32)

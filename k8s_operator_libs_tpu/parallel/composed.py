@@ -40,7 +40,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.llama import LlamaConfig, rms_norm, rope
+from jax.ad_checkpoint import checkpoint_name
+
+from ..models.llama import ATTN_OUT_CKPT, LlamaConfig, remat_block, rms_norm, rope
 from ..ops.attention import flash_attention
 from .fsdp import TrainState, init_train_state, make_train_step_from_loss
 from .pipeline import gpipe_schedule
@@ -113,11 +115,9 @@ def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
         v = (h @ layer["wv"]).reshape(Bm, T, KVl, Dh)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        if KV != H:
-            rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = flash_attention(q, k, v, causal=True)
+        # GQA handled inside the flash kernel (KVl local heads, no repeat)
+        attn = checkpoint_name(flash_attention(q, k, v, causal=True),
+                               ATTN_OUT_CKPT)
         x = x + jax.lax.psum(
             attn.reshape(Bm, T, Hl * Dh) @ layer["wo"], "tensor")
         h = rms_norm(x, layer["mlp_norm"])
@@ -172,7 +172,7 @@ def make_composed_loss(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int
             rows = jnp.where(owned[..., None], embed[idx], 0)
             return jax.lax.psum(rows, "tensor")
 
-        block_fn = jax.checkpoint(tp_block) if cfg.remat else tp_block
+        block_fn = remat_block(tp_block) if cfg.remat else tp_block
 
         def stage_apply(x):
             def body(carry, layer):
@@ -301,7 +301,7 @@ def make_moe_composed_loss(cfg, mesh: Mesh, num_microbatches: int
         Bd, T = inputs.shape
         Bm = Bd // M
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (Bm, T))
-        block_fn = jax.checkpoint(block) if cfg.remat else block
+        block_fn = remat_block(block) if cfg.remat else block
 
         def stage_apply(x):
             def body(carry, layer):

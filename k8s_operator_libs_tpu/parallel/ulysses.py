@@ -31,6 +31,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import flash_attention
@@ -43,9 +44,24 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     [B, Tl, H, Dh], sequence-sharded over ``axis_name``; requires the axis
     size to divide H (each device computes H/n full-sequence heads)."""
     n = jax.lax.psum(1, axis_name)
-    H = q.shape[2]
+    H, KV = q.shape[2], k.shape[2]
     if H % n:
         raise ValueError(f"ulysses needs head count {H} divisible by "
+                         f"seq-axis size {n}")
+    if KV < n:
+        # GQA K/V arrive with KV < H heads (the flash kernel is GQA-native
+        # so no repeat happened upstream). The head-split all_to_all needs
+        # at least one K/V head per device: repeat K/V up to exactly n
+        # heads — factor n/KV, strictly less traffic than the old
+        # repeat-to-H path — and let the kernel handle the residual
+        # H/n : KV'/n grouping per device.
+        if n % KV:
+            raise ValueError(f"ulysses needs K/V head count {KV} to divide "
+                             f"the seq-axis size {n}")
+        k = jnp.repeat(k, n // KV, axis=2)
+        v = jnp.repeat(v, n // KV, axis=2)
+    elif KV % n:
+        raise ValueError(f"ulysses needs K/V head count {KV} divisible by "
                          f"seq-axis size {n}")
     # tiled all_to_all: split the head axis n ways (group i -> device i),
     # concatenate received chunks along the sequence axis in device order —

@@ -277,8 +277,12 @@ def test_paged_pool_sized_by_true_capacity():
     from k8s_operator_libs_tpu.models.paged import init_paged_cache, plan_blocks
 
     table, nb = plan_blocks([5, 9, 32], block_size=4)
-    assert nb == 2 + 3 + 8  # ceil(5/4) + ceil(9/4) + ceil(32/4)
+    assert nb == 2 + 3 + 8 + 1  # ceil(5/4)+ceil(9/4)+ceil(32/4) + scratch
     assert table.shape == (3, 8)
+    scratch = nb - 1
+    assert (table[0, 2:] == scratch).all()   # unused slots -> scratch
+    assert (table[1, 3:] == scratch).all()
+    assert (table[2] != scratch).all()       # full row owns every slot
     cfg = LlamaConfig.tiny()
     cache = init_paged_cache(cfg, [5, 9, 32], block_size=4)
     assert cache.k.shape[1] == nb            # pool, not 3 x 8 blocks
@@ -342,3 +346,67 @@ def test_resume_continues_exact_data_stream(tmp_path):
                                       ds.sample_at(4, 33, seed=7, step=5))
     finally:
         ds.close()
+
+
+def test_paged_write_ragged_capacity_routes_to_scratch():
+    """ADVICE r3 (medium): with ragged capacities and a right-padded
+    prompt, a sequence whose capacity is smaller than the padded prompt
+    length must NOT write its padding rows through unused table slots
+    into another sequence's blocks. plan_blocks routes those writes to
+    the shared scratch block instead."""
+    import jax.numpy as jnp
+    from k8s_operator_libs_tpu.models.paged import _paged_write, plan_blocks
+
+    bs, KV, Dh = 16, 2, 8
+    table, nb = plan_blocks([32, 16], block_size=bs)   # seq1 cap < T=32
+    pool = jnp.zeros((nb, bs, KV, Dh), jnp.float32)
+    # padded batch: T=32 rows for both sequences, written from length 0
+    vals = jnp.ones((2, 32, KV, Dh), jnp.float32)
+    vals = vals.at[1].set(2.0)                          # seq1 rows marked
+    out = _paged_write(pool, jnp.asarray(table),
+                       jnp.zeros((2,), jnp.int32), vals)
+    out = np.asarray(out)
+    # seq0 owns blocks 0-1: all rows written with 1s, untouched by seq1
+    np.testing.assert_array_equal(out[0], np.ones((bs, KV, Dh)))
+    np.testing.assert_array_equal(out[1], np.ones((bs, KV, Dh)))
+    # seq1's real block holds its first 16 rows
+    np.testing.assert_array_equal(out[2], 2.0 * np.ones((bs, KV, Dh)))
+    # the overflow landed in the scratch block (last), nowhere else
+    assert (out[3] == 2.0).all()
+
+
+def test_paged_decode_kernel_matches_gather_path():
+    """The Pallas block-walk decode kernel (interpret mode) is a pure
+    layout/traffic change: greedy tokens equal the gather-path decode and
+    the contiguous cache, including ragged prompts."""
+    import jax
+    import jax.numpy as jnp
+    from k8s_operator_libs_tpu.models import paged
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+    # head_dim must be 128 for the kernel gate; keep everything else tiny
+    cfg = LlamaConfig.tiny(d_model=512, n_heads=4, n_kv_heads=2,
+                           vocab_size=256)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    ref = generate(params, prompt, cfg, max_new_tokens=6)
+    paged.INTERPRET = True
+    try:
+        out = paged.paged_generate(params, prompt, cfg, max_new_tokens=6,
+                                   block_size=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        # ragged: each padded sequence matches its solo decode
+        p0 = prompt[:1, :5]
+        padded = jnp.concatenate(
+            [jnp.pad(p0, ((0, 0), (0, 4))), prompt[1:]], axis=0)
+        out_r = paged.paged_generate(
+            params, padded, cfg, max_new_tokens=6,
+            prompt_lengths=jnp.array([5, 9], jnp.int32), block_size=4)
+        solo0 = paged.paged_generate(params, p0, cfg, max_new_tokens=6,
+                                     block_size=4)
+        np.testing.assert_array_equal(np.asarray(out_r[0, 9:]),
+                                      np.asarray(solo0[0, 5:]))
+    finally:
+        paged.INTERPRET = False

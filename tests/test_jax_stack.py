@@ -391,3 +391,36 @@ def test_make_mesh_uses_each_device_once_any_assignment():
             assert mesh.axis_names == AXES
             ids = sorted(d.id for d in mesh.devices.flat)
             assert ids == sorted(d.id for d in jax.devices())
+
+
+# ------------------------------------------------------- remat kernel count
+
+
+def test_remat_policy_does_not_recompute_flash_forward(monkeypatch):
+    """VERDICT r3 #2: cfg.remat must SAVE the flash kernel's (out, lse) —
+    tagged via checkpoint_name in the custom_vjp fwd rule — so the backward
+    never re-runs the forward kernel. Pinned on the traced jaxpr: the grad
+    of a remat forward contains exactly as many pallas_calls as the
+    no-remat grad (fwd + dq + dkv = 3 per block trace), where the old bare
+    jax.checkpoint produced one extra forward-kernel call."""
+    from k8s_operator_libs_tpu.ops import attention
+
+    monkeypatch.setattr(attention, "INTERPRET", True)
+    monkeypatch.setattr(attention, "_use_pallas", lambda q, k=None: True)
+    cfg_r = LlamaConfig.tiny(remat=True, n_heads=4, n_kv_heads=2,
+                             d_model=512, vocab_size=256, max_seq_len=256)
+    cfg_n = LlamaConfig.tiny(remat=False, n_heads=4, n_kv_heads=2,
+                             d_model=512, vocab_size=256, max_seq_len=256)
+    params = init_params(jax.random.PRNGKey(0), cfg_r)
+    toks = jnp.zeros((2, 256), jnp.int32)
+
+    def count(cfg):
+        jaxpr = jax.make_jaxpr(
+            jax.grad(lambda p: jnp.sum(forward(p, toks, cfg))))(params)
+        return jaxpr.pretty_print(source_info=False).count("pallas_call")
+
+    n_remat, n_plain = count(cfg_r), count(cfg_n)
+    assert n_plain == 3  # fwd + bwd-dq + bwd-dkv, each traced once
+    assert n_remat == n_plain, (
+        f"remat grad traces {n_remat} pallas_calls vs {n_plain} without "
+        f"remat — the backward is re-running the flash forward kernel")

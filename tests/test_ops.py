@@ -109,3 +109,141 @@ def test_flash_backward_bf16_tolerance():
 def test_dispatch_uses_reference_on_cpu():
     # production gate: CPU backend → reference path regardless of shape
     assert not attention._use_pallas(jnp.zeros((1, 256, 2, 128)))
+
+
+# ------------------------------------------------------------------- GQA
+# The kernels consume K/V with KV < H heads natively (VERDICT r3 #1): the
+# query-group dim folds into the q-block so K/V is fetched once per group.
+# Equivalence oracle: reference_attention, which repeats K/V heads — the
+# exact path the models used before the kernel went GQA-native.
+
+
+def _gqa_qkv(key, B=2, T=256, H=4, KV=2, Dh=128, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, T, H, Dh), dtype),
+            jax.random.normal(kk, (B, T, KV, Dh), dtype),
+            jax.random.normal(kv, (B, T, KV, Dh), dtype))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_forward_matches_reference(causal):
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(4))
+    out = attention._flash_attention(q, k, v, causal)
+    ref = attention.reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gqa_forward_multiblock():
+    # multi-q-block grid under GQA: the folded row positions (r mod blk)
+    # must mask causally per ROW, not per folded-row index
+    old = attention.MAX_BLOCK
+    attention.MAX_BLOCK = 128
+    try:
+        q, k, v = _gqa_qkv(jax.random.PRNGKey(5), T=512)
+        out = attention._flash_attention(q, k, v, True)
+        ref = attention.reference_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+    finally:
+        attention.MAX_BLOCK = old
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_backward_matches_reference(causal):
+    # dk/dv must come out with KV heads = the group-sum of the per-q-head
+    # gradients (the dkv kernel folds that sum into its dot_generals)
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(6))
+
+    def flash_loss(q, k, v):
+        return jnp.sum(jnp.sin(attention._flash_attention(q, k, v, causal)))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(jnp.sin(attention.reference_attention(q, k, v, causal)))
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    assert g_flash[1].shape == k.shape and g_flash[2].shape == v.shape
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"d{name} mismatch")
+
+
+def test_flash_gqa_backward_multiblock():
+    old = attention.MAX_BLOCK
+    attention.MAX_BLOCK = 128
+    try:
+        q, k, v = _gqa_qkv(jax.random.PRNGKey(7), T=384, H=8, KV=2)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, True)))
+
+        g_flash = jax.grad(loss(attention._flash_attention),
+                           argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(attention.reference_attention),
+                         argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+                err_msg=f"d{name} mismatch")
+    finally:
+        attention.MAX_BLOCK = old
+
+
+def test_flash_gqa_with_lse_matches_reference():
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(8))
+    out, lse = attention._flash_forward(q, k, v, True)
+    ref, ref_lse = attention.reference_attention_with_lse(q, k, v, True)
+    B, T, H, _ = q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(lse.reshape(B, H, T, 1)), np.asarray(ref_lse),
+        atol=2e-5, rtol=2e-5)
+
+
+def test_dispatch_rejects_ragged_gqa():
+    # H not divisible by KV → reference path even with kernel-worthy shapes
+    q = jnp.zeros((1, 256, 3, 128))
+    k = jnp.zeros((1, 256, 2, 128))
+    assert not attention._use_pallas(q, k)
+
+
+def test_flash_multi_superblock_state_handoff():
+    """The streamed kernels carry online-softmax / gradient state across
+    SUPERBLOCK grid steps through VMEM scratch (init at step 0, finalize
+    at the last step, clamped index maps on the causal upper triangle).
+    Production SUPERBLOCK (4096) exceeds every test T, so without pinning
+    it the whole suite runs single-superblock and a broken handoff would
+    only surface at the 8k/32k shapes. Pin SUPERBLOCK=128 at T=512 →
+    4 superblocks per side, GQA on, fwd + both backward kernels."""
+    old_super, old_block = attention.SUPERBLOCK, attention.MAX_BLOCK
+    attention.SUPERBLOCK = 128
+    attention.MAX_BLOCK = 128
+    try:
+        q, k, v = _gqa_qkv(jax.random.PRNGKey(9), T=512, H=4, KV=2)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, True)))
+
+        out = attention._flash_attention(q, k, v, True)
+        ref = attention.reference_attention(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        g_flash = jax.grad(loss(attention._flash_attention),
+                           argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(attention.reference_attention),
+                         argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+                err_msg=f"d{name} mismatch")
+        # non-causal exercises the unclamped full-grid maps
+        out_nc = attention._flash_attention(q, k, v, False)
+        ref_nc = attention.reference_attention(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(out_nc), np.asarray(ref_nc),
+                                   atol=2e-5, rtol=2e-5)
+    finally:
+        attention.SUPERBLOCK = old_super
+        attention.MAX_BLOCK = old_block
