@@ -2,8 +2,17 @@
 # test / cov-report — reference Makefile:29,76-78,114-125), Python-native.
 
 PYTHON ?= python
+DOCKER ?= docker
+BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 
-.PHONY: all native test test-fast lint cov-report bench dryrun apply-crds-dry clean
+# hermetic containerized runs: `make docker-lint`, `make docker-test`, ...
+# (any goal) execute inside docker/Dockerfile.devel with the repo bind-
+# mounted — the reference's docker-% passthrough (Makefile:114-125)
+DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
+  docker-lint docker-cov-report docker-bench docker-dryrun
+
+.PHONY: all native test test-fast lint cov-report bench dryrun apply-crds-dry clean \
+  $(DOCKER_TARGETS) .build-image
 
 all: lint native test
 
@@ -49,3 +58,18 @@ apply-crds-dry:
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
 	rm -rf .pytest_cache
+
+.build-image: docker/Dockerfile.devel
+	$(DOCKER) build --tag $(BUILDIMAGE) -f docker/Dockerfile.devel docker
+
+$(DOCKER_TARGETS): docker-%: .build-image  ## Run `make %` hermetically in the devel image
+	@echo "Running 'make $(*)' in docker container $(BUILDIMAGE)"
+	$(DOCKER) run \
+		--rm \
+		-e JAX_PLATFORMS=cpu \
+		-e XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		-v $(PWD):$(PWD) \
+		-w $(PWD) \
+		--user $$(id -u):$$(id -g) \
+		$(BUILDIMAGE) \
+			make $(*)

@@ -351,6 +351,9 @@ class CachedClient(Client):
     def patch_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
         return self._live.patch_node_unschedulable(name, unschedulable)
 
+    def patch_node_taints(self, name: str, taint_patch) -> Node:
+        return self._live.patch_node_taints(name, taint_patch)
+
     def create_pod(self, pod: Pod) -> Pod:
         return self._live.create_pod(pod)
 

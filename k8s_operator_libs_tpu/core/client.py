@@ -89,6 +89,12 @@ class Client(abc.ABC):
     def patch_node_unschedulable(self, name: str, unschedulable: bool) -> Node: ...
 
     @abc.abstractmethod
+    def patch_node_taints(self, name: str, taint_patch) -> Node:
+        """Strategic-merge-patch the taints list: entries merge BY KEY
+        (patchMergeKey) field-by-field, ``{"$patch": "delete", "key": K}``
+        removes one — the upstream NodeSpec.Taints patch contract."""
+
+    @abc.abstractmethod
     def delete_pod(self, namespace: str, name: str,
                    grace_period_seconds: Optional[int] = None) -> None: ...
 

@@ -557,6 +557,38 @@ class _FakeClient(Client):
             node.spec.unschedulable = unschedulable
             return self._c.update(node)
 
+    def patch_node_taints(self, name: str, taint_patch) -> Node:
+        """Strategic-merge-patch the taints LIST with the real apiserver's
+        semantics for ``patchStrategy: merge, patchMergeKey: key``
+        (NodeSpec.Taints in the upstream API): entries update-in-place by
+        key, unknown keys append, and a ``{"$patch": "delete", "key": K}``
+        directive removes the K entry. ``taint_patch`` is the raw patch
+        list (dicts as they appear on the wire)."""
+        from .objects import Taint
+        with self._c._lock:
+            node = self._c.get("Node", "", name)
+            taints = list(node.spec.taints)
+            for entry in taint_patch:
+                key = entry.get("key", "")
+                if entry.get("$patch") == "delete":
+                    taints = [t for t in taints if t.key != key]
+                    continue
+                for i, t in enumerate(taints):
+                    if t.key == key:
+                        # SMP merges the MATCHED entry field-by-field:
+                        # absent fields keep their current values
+                        taints[i] = Taint(
+                            key=key,
+                            value=entry.get("value", t.value),
+                            effect=entry.get("effect", t.effect))
+                        break
+                else:
+                    taints.append(Taint(key=key,
+                                        value=entry.get("value", ""),
+                                        effect=entry.get("effect", "")))
+            node.spec.taints = taints
+            return self._c.update(node)
+
     def create_pod(self, pod: Pod) -> Pod:
         created = self._c.create(pod)
         self._c.flush_cache()

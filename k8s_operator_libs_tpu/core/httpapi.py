@@ -338,6 +338,18 @@ class _Handler(BaseHTTPRequestHandler):
             if "unschedulable" in spec:
                 node = client.patch_node_unschedulable(
                     name, bool(spec["unschedulable"]))
+            if "taints" in spec:
+                if spec["taints"] is None:
+                    # explicit JSON null deletes the FIELD (clears the
+                    # list) — same SMP edge as the null-map handling above
+                    node = self.cluster.get("Node", "", name)
+                    node = client.patch_node_taints(
+                        name, [{"$patch": "delete", "key": t.key}
+                               for t in node.spec.taints])
+                else:
+                    # list field with patchStrategy=merge/patchMergeKey=
+                    # key — merge-by-key + $patch:delete, NOT replace
+                    node = client.patch_node_taints(name, spec["taints"])
         except KeyError:
             return self._error(404, "NotFound", f"node {name} not found")
         self._send(200, serde.node_to_json(node))

@@ -479,6 +479,16 @@ class LiveClient(Client):
             body={"spec": {"unschedulable": unschedulable}},
             content_type="application/strategic-merge-patch+json"))
 
+    def patch_node_taints(self, name: str, taint_patch) -> Node:
+        """Strategic-merge-patch the node's taints list. ``taint_patch``
+        entries are wire-format dicts ({key, value, effect}, or
+        {"$patch": "delete", "key": K} to remove one) — the server merges
+        by ``key`` (patchMergeKey), it does NOT replace the list."""
+        return serde.node_from_json(self._http.request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            body={"spec": {"taints": taint_patch}},
+            content_type="application/strategic-merge-patch+json"))
+
     def create_pod(self, pod: Pod) -> Pod:
         """POST a pod (the SliceScheduler's placement write)."""
         ns = pod.metadata.namespace or "default"

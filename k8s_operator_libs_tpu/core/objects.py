@@ -56,8 +56,16 @@ class ObjectMeta:
 
 
 @dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
 class NodeSpec:
     unschedulable: bool = False
+    taints: List["Taint"] = field(default_factory=list)
 
 
 @dataclass

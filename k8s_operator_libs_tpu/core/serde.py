@@ -24,7 +24,7 @@ from .objects import (ContainerStatus, ControllerRevision, DaemonSet,
                       DaemonSetStatus, Job, JobStatus, Lease, LeaseSpec, Node,
                       NodeCondition, NodeSpec, NodeStatus, ObjectMeta,
                       OwnerReference, Pod, PodCondition, PodSpec, PodStatus,
-                      Service, ServicePort, ServiceSpec, Volume)
+                      Service, ServicePort, ServiceSpec, Taint, Volume)
 
 RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
 
@@ -112,20 +112,28 @@ def meta_from_json(j: Dict) -> ObjectMeta:
 # ------------------------------------------------------------------ node
 
 def node_to_json(n: Node) -> Dict:
+    spec: Dict = {"unschedulable": n.spec.unschedulable}
+    if n.spec.taints:  # real apiserver omits the field when empty
+        spec["taints"] = [{"key": t.key, "value": t.value,
+                           "effect": t.effect} for t in n.spec.taints]
     return {
         "apiVersion": "v1", "kind": "Node",
         "metadata": meta_to_json(n.metadata),
-        "spec": {"unschedulable": n.spec.unschedulable},
+        "spec": spec,
         "status": {"conditions": [{"type": c.type, "status": c.status}
                                   for c in n.status.conditions]},
     }
 
 
 def node_from_json(j: Dict) -> Node:
+    spec_j = j.get("spec") or {}
     return Node(
         metadata=meta_from_json(j.get("metadata") or {}),
-        spec=NodeSpec(unschedulable=bool(
-            (j.get("spec") or {}).get("unschedulable", False))),
+        spec=NodeSpec(
+            unschedulable=bool(spec_j.get("unschedulable", False)),
+            taints=[Taint(key=t.get("key", ""), value=t.get("value", ""),
+                          effect=t.get("effect", ""))
+                    for t in spec_j.get("taints") or []]),
         status=NodeStatus(conditions=[
             NodeCondition(type=c.get("type", ""), status=c.get("status", ""))
             for c in (j.get("status") or {}).get("conditions") or []]),

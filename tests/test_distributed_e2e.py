@@ -1,0 +1,85 @@
+"""Two-process jax.distributed end-to-end (VERDICT r3 #6).
+
+parallel/distributed.py was previously tested only through the
+``_initialize`` seam; nothing proved that the env the SliceScheduler
+injects (tpu/scheduler.py — TPU_WORKER_ID / TPU_WORKER_HOSTNAMES /
+JAX_COORDINATOR_ADDRESS) actually forms a working cluster. Here two REAL
+subprocesses carry exactly that env, run the same
+``maybe_initialize_from_env()`` entry ``cmd/train.py`` runs, and execute a
+cross-process psum over a 2-device global mesh on the CPU backend (gloo
+collectives) — the full distributed-init path minus the TPU hardware.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r'''
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from k8s_operator_libs_tpu.parallel.distributed import (
+    maybe_initialize_from_env)
+assert maybe_initialize_from_env(), "distributed init did not engage"
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 2          # one CPU device per process
+mesh = Mesh(np.array(jax.devices()), ("d",))
+
+# each process contributes process_index + 1; psum must see both
+local = np.array([float(jax.process_index()) + 1.0], np.float32)
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("d")), local, (2,))
+
+@jax.jit
+def allsum(x):
+    return jax.shard_map(lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+                         in_specs=P("d"), out_specs=P())(x)
+
+out = allsum(x)
+val = float(np.asarray(out.addressable_data(0))[0])
+assert val == 3.0, val
+print(f"PSUM_OK process={jax.process_index()} value={val}", flush=True)
+'''
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_psum_through_operator_env():
+    port = _free_port()
+    # exactly the variables tpu/scheduler.py injects into workload pods
+    injected = {
+        "TPU_WORKER_HOSTNAMES": "localhost,localhost",
+        "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+    }
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # no virtual 8-device mesh here
+        env.update(injected)
+        env["TPU_WORKER_ID"] = str(wid)
+        env["PYTHONPATH"] = REPO
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=220)
+        results.append((i, p.returncode, out, err))
+    for i, rc, out, err in results:
+        assert rc == 0, (f"worker {i} failed rc={rc}\n"
+                         f"stdout:\n{out}\nstderr:\n{err[-2000:]}")
+        assert f"PSUM_OK process={i} value=3.0" in out

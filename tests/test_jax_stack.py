@@ -424,3 +424,40 @@ def test_remat_policy_does_not_recompute_flash_forward(monkeypatch):
     assert n_remat == n_plain, (
         f"remat grad traces {n_remat} pallas_calls vs {n_plain} without "
         f"remat — the backward is re-running the flash forward kernel")
+
+
+def test_bf16_first_moment_checkpoint_roundtrip(rng, tmp_path):
+    """default_optimizer(moment_dtype=bf16) stores adamw's mu in bf16 (an
+    HBM-traffic/state-size lever — see bench.py mfu_trainer ladder); the
+    dtype must survive init, stepping, and an orbax save/restore."""
+    import optax
+    from k8s_operator_libs_tpu.parallel.fsdp import default_optimizer
+
+    opt = default_optimizer(moment_dtype=jnp.bfloat16)
+    trainer = CheckpointingTrainer(CFG, str(tmp_path / "ck"), mesh=None,
+                                   optimizer=opt, checkpoint_interval=1)
+    state = trainer.init_or_resume(rng)
+    adam_state = state.opt_state[1][0]
+    mu_dtypes = {p.dtype for p in jax.tree_util.tree_leaves(adam_state.mu)}
+    assert mu_dtypes == {jnp.dtype(jnp.bfloat16)}
+    # nu is untouched by moment_dtype: it mirrors each param's dtype
+    # (bf16 mats, fp32 norms)
+    nu_dtypes = {p.dtype for p in jax.tree_util.tree_leaves(adam_state.nu)}
+    param_dtypes = {p.dtype for p in jax.tree_util.tree_leaves(state.params)}
+    assert nu_dtypes == param_dtypes
+
+    batch = next(batches())
+    state, m = trainer._step_fn(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    trainer.save(state, wait=True)
+    trainer.close()
+
+    trainer2 = CheckpointingTrainer(CFG, str(tmp_path / "ck"), mesh=None,
+                                    optimizer=opt, checkpoint_interval=1)
+    restored = trainer2.init_or_resume(rng)
+    r_adam = restored.opt_state[1][0]
+    assert {p.dtype for p in jax.tree_util.tree_leaves(r_adam.mu)} == {
+        jnp.dtype(jnp.bfloat16)}
+    np.testing.assert_array_equal(np.asarray(restored.step),
+                                  np.asarray(state.step))
+    trainer2.close()

@@ -769,3 +769,159 @@ def test_status_cli_reports_table_and_exit_codes(tmp_path, capsys):
         import json as _json
         data = _json.loads(capsys.readouterr().out)
         assert data["libtpu"][0]["state"] == "upgrade-failed"
+
+
+# ------------------------- r4 wire-fixture corpus (VERDICT r3 #10):
+# strategic-merge-patch of LIST fields, watch re-establishment after the
+# server's timeout window, and 409 conflict bodies — asserted on the wire
+# against the fake, through the LiveClient path.
+
+
+def test_taint_list_patch_merges_by_key_like_real_apiserver(live):
+    """NodeSpec.taints carries ``patchStrategy: merge, patchMergeKey:
+    key`` upstream: a strategic-merge PATCH of the list must merge
+    entries BY KEY (update-in-place, unknown keys append) and honor the
+    ``{"$patch": "delete", "key": K}`` directive — NOT replace the whole
+    list, which is what a naive JSON merge would do. Fixtures follow
+    kubectl taint behavior against kind v1.32."""
+    cluster, cli = live
+    cluster.add_node("n0")
+    recorded = []
+    orig = cli.http.request
+
+    def recording(method, path, body=None, params=None, **kw):
+        if method == "PATCH":
+            recorded.append((path, body, kw.get("content_type")))
+        return orig(method, path, body=body, params=params, **kw)
+
+    cli.http.request = recording
+
+    cli.patch_node_taints("n0", [
+        {"key": "tpu", "value": "present", "effect": "NoSchedule"},
+        {"key": "upgrade", "value": "pending", "effect": "NoExecute"}])
+    # merge: same-key entry updates in place, new key appends
+    n = cli.patch_node_taints("n0", [
+        {"key": "upgrade", "value": "active", "effect": "NoExecute"},
+        {"key": "drain", "value": "now", "effect": "NoSchedule"}])
+    assert [(t.key, t.value) for t in n.spec.taints] == [
+        ("tpu", "present"), ("upgrade", "active"), ("drain", "now")]
+    # partial patch of a matched entry merges FIELD-BY-FIELD: the
+    # unspecified effect keeps its current value (a naive entry replace
+    # would reset it)
+    n = cli.patch_node_taints("n0", [{"key": "drain", "value": "soon"}])
+    d = next(t for t in n.spec.taints if t.key == "drain")
+    assert (d.value, d.effect) == ("soon", "NoSchedule")
+    # $patch: delete removes exactly the named key
+    n = cli.patch_node_taints("n0", [{"$patch": "delete", "key": "upgrade"}])
+    assert [t.key for t in n.spec.taints] == ["tpu", "drain"]
+
+    assert recorded == [
+        ("/api/v1/nodes/n0",
+         {"spec": {"taints": [
+             {"key": "tpu", "value": "present", "effect": "NoSchedule"},
+             {"key": "upgrade", "value": "pending",
+              "effect": "NoExecute"}]}},
+         "application/strategic-merge-patch+json"),
+        ("/api/v1/nodes/n0",
+         {"spec": {"taints": [
+             {"key": "upgrade", "value": "active", "effect": "NoExecute"},
+             {"key": "drain", "value": "now", "effect": "NoSchedule"}]}},
+         "application/strategic-merge-patch+json"),
+        ("/api/v1/nodes/n0",
+         {"spec": {"taints": [{"key": "drain", "value": "soon"}]}},
+         "application/strategic-merge-patch+json"),
+        ("/api/v1/nodes/n0",
+         {"spec": {"taints": [{"$patch": "delete", "key": "upgrade"}]}},
+         "application/strategic-merge-patch+json"),
+    ]
+    # taints survive the full serde round-trip on an unrelated GET
+    again = cli.get_node("n0")
+    assert [(t.key, t.effect) for t in again.spec.taints] == [
+        ("tpu", "NoSchedule"), ("drain", "NoSchedule")]
+    # explicit JSON null for the whole list deletes the field
+    cli.http.request("PATCH", "/api/v1/nodes/n0",
+                     body={"spec": {"taints": None}},
+                     content_type="application/strategic-merge-patch+json")
+    assert cli.get_node("n0").spec.taints == []
+
+
+def test_watch_reestablishes_after_timeout_without_loss(live):
+    """client-go's informer loops watch windows: the server CLOSES the
+    stream at timeoutSeconds, and the client re-establishes from the last
+    RV it observed. Events landing between the windows must arrive exactly
+    once on the next window (no loss, no duplicates)."""
+    cluster, cli = live
+    cluster.add_node("w0")
+    # informer protocol: LIST pins the resume point, watches start there
+    last_rv = max(int(n.metadata.resource_version)
+                  for n in cli.list_nodes())
+
+    cluster.add_node("w1")        # lands inside window 1
+    seen = []
+    # window 1: short timeout; drain to exhaustion (the server closes)
+    for etype, node in cli.watch_nodes(timeout_seconds=1,
+                                       resource_version=str(last_rv)):
+        seen.append((etype, node.metadata.name))
+        last_rv = max(last_rv, int(node.metadata.resource_version))
+    assert seen == [("ADDED", "w1")]
+
+    # between windows: events the closed stream never saw
+    cluster.add_node("w2")
+    cluster.client.direct().patch_node_unschedulable("w0", True)
+
+    seen2 = []
+    for etype, node in cli.watch_nodes(timeout_seconds=1,
+                                       resource_version=str(last_rv)):
+        seen2.append((etype, node.metadata.name,
+                      node.spec.unschedulable))
+    # exactly the two missed events, in order — nothing from window 1
+    # replays (no duplicates), nothing is lost
+    assert seen2 == [("ADDED", "w2", False), ("MODIFIED", "w0", True)]
+
+
+def test_conflict_response_body_is_apiserver_status(live):
+    """A stale-resourceVersion write returns the real apiserver's 409
+    Status body ({kind: Status, status: Failure, reason: Conflict,
+    code: 409}) on the wire, and the client maps it to ConflictError —
+    the compare-and-swap contract leader election rides on."""
+    import dataclasses as dc
+    import json as _json
+    import urllib.request
+
+    from k8s_operator_libs_tpu.core.client import ConflictError
+    from k8s_operator_libs_tpu.core.objects import Lease, LeaseSpec, ObjectMeta
+    from k8s_operator_libs_tpu.core import serde as _serde
+
+    cluster, cli = live
+    lease = cli.create_lease(Lease(
+        metadata=ObjectMeta(name="ha", namespace="default"),
+        spec=LeaseSpec(holder_identity="a", lease_duration_seconds=15)))
+    # a second writer wins the CAS race
+    current = cli.get_lease("default", "ha")
+    current.spec.holder_identity = "b"
+    cli.update_lease(current)
+
+    # stale writer: the ORIGINAL resourceVersion → 409 on the wire
+    stale = dc.replace(lease, spec=LeaseSpec(holder_identity="c"))
+    with pytest.raises(ConflictError):
+        cli.update_lease(stale)
+
+    # raw wire shape of the 409 body, independent of client mapping
+    url = (cli.http.config.server
+           + "/apis/coordination.k8s.io/v1/namespaces/default/leases/ha")
+    req = urllib.request.Request(
+        url, method="PUT",
+        data=_json.dumps(_serde.lease_to_json(stale)).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("stale PUT unexpectedly succeeded")
+    except urllib.error.HTTPError as err:
+        assert err.code == 409
+        body = _json.loads(err.read())
+        assert body["kind"] == "Status"
+        assert body["apiVersion"] == "v1"
+        assert body["status"] == "Failure"
+        assert body["reason"] == "Conflict"
+        assert body["code"] == 409
+        assert body["message"]

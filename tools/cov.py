@@ -142,6 +142,19 @@ def report(hits: Dict[str, Set[int]], out_path: Path) -> float:
 
 def main(argv) -> int:
     os.chdir(REPO)
+    # --min-pct N: fail (exit 2) when total coverage lands below N — the
+    # CI gate the reference gets from Coveralls (ci.yaml:60-69). Parsed
+    # here so the rest of argv passes through to pytest untouched.
+    min_pct = None
+    argv = list(argv)
+    if "--min-pct" in argv:
+        i = argv.index("--min-pct")
+        try:
+            min_pct = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: tools/cov.py [pytest args...] --min-pct N")
+            return 2
+        del argv[i:i + 2]
     # `python -m pytest` puts the cwd on sys.path; in-process pytest.main
     # does not, so the measured package must be made importable here
     if str(REPO) not in sys.path:
@@ -153,7 +166,11 @@ def main(argv) -> int:
         rc = pytest.main(argv or ["tests/", "-q"])
     finally:
         collector.stop()
-    report(collector.hits, REPO / "cov.json")
+    pct = report(collector.hits, REPO / "cov.json")
+    if rc == 0 and min_pct is not None and pct < min_pct:
+        print(f"FAIL: coverage {pct:.1f}% below the --min-pct {min_pct}% "
+              f"floor")
+        return 2
     return int(rc)
 
 
