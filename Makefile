@@ -36,13 +36,10 @@ lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/F
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
-cov-report:  ## coverage: pytest-cov when installed, else the stdlib tools/cov.py (sys.monitoring)
-	@if $(PYTHON) -c "import pytest_cov" 2>/dev/null; then \
-	  $(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu --cov-report=term; \
-	else \
-	  echo "pytest-cov not installed; using tools/cov.py (sys.monitoring)"; \
-	  $(PYTHON) tools/cov.py tests/ -q; \
-	fi
+COV_MIN ?= 80
+
+cov-report:  ## coverage via the stdlib tools/cov.py (sys.monitoring); fails under COV_MIN%
+	$(PYTHON) tools/cov.py tests/ -q --min-pct $(COV_MIN)
 
 bench:
 	$(PYTHON) bench.py

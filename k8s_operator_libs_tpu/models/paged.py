@@ -316,7 +316,14 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
         v_pool_l = _paged_write(v_pool_l, cache.table, cache.lengths, v)
         cap_bytes = (2 * cache.capacity_per_seq * KV * Dh
                      * jnp.dtype(k_pool_l.dtype).itemsize)
-        if _use_paged_kernel(q) and cap_bytes <= 8 * 1024 * 1024:
+        # dispatch by measured crossover (v5e): per-sequence kernel
+        # programs beat the one fused XLA gather+einsum only once the
+        # per-seq cache is big enough to amortize them (+13% at the 760M
+        # serving shape, cap_bytes 2.6 MB; -25% at the 125M toy shape,
+        # 0.2 MB); above ~8 MB the VMEM buffers stop fitting
+        big_enough = cap_bytes >= 1024 * 1024 or INTERPRET  # tests: tiny
+        if (_use_paged_kernel(q) and big_enough
+                and cap_bytes <= 8 * 1024 * 1024):
             # decode: walk the block table in place (no gathered copy)
             attn = _attend_paged_kernel(q, k_pool_l, v_pool_l,
                                         cache.table, cache.lengths)

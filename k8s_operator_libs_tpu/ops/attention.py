@@ -122,6 +122,18 @@ def _super_size(T: int, rows_per_col: int = 1) -> int:
 # exercise the real kernel logic without TPU hardware.
 INTERPRET = False
 
+
+def _compiler_params():
+    """Mosaic hints shared by all three kernels: the first two grid dims
+    (batch·kv-head, outer block) are embarrassingly parallel, only the
+    streamed dim carries state through scratch. None under interpret
+    (the interpreter rejects TPU compiler params)."""
+    if INTERPRET:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
 # checkpoint_name tags on the forward kernel's outputs (out, lse) — the
 # exact residual set the backward kernels consume. A remat policy that
 # saves these names (models/llama.py:remat_block) keeps the backward from
@@ -381,6 +393,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((rows, 1), jnp.float32),    # running max m
             pltpu.VMEM((rows, 1), jnp.float32),    # running denom l
         ],
+        compiler_params=_compiler_params(),
         interpret=INTERPRET,
     )(_fold(q), _fold(k), _fold(v))
     return _unfold(out, B, H), lse
@@ -572,6 +585,7 @@ def _flash_backward(q, k, v, o, lse, g, causal):
         out_specs=qb3,
         out_shape=jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((rows, Dh), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=INTERPRET,
     )(qf, kf, vf, gf, lse, delta)
 
@@ -585,6 +599,7 @@ def _flash_backward(q, k, v, o, lse, g, causal):
                    jax.ShapeDtypeStruct((B * KV, T, Dh), v.dtype)],
         scratch_shapes=[pltpu.VMEM((kblk, Dh), jnp.float32),
                         pltpu.VMEM((kblk, Dh), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=INTERPRET,
     )(qf, kf, vf, gf, lse, delta)
 

@@ -393,9 +393,16 @@ def test_paged_decode_kernel_matches_gather_path():
                                 cfg.vocab_size)
     ref = generate(params, prompt, cfg, max_new_tokens=6)
     paged.INTERPRET = True
-    try:
-        out = paged.paged_generate(params, prompt, cfg, max_new_tokens=6,
-                                   block_size=4)
+    jax.clear_caches()  # the spy counts trace-time calls; a cached
+    try:                # executable for this signature would show 0
+        from unittest import mock
+        with mock.patch.object(paged, "_attend_paged_kernel",
+                               side_effect=paged._attend_paged_kernel) as spy:
+            out = paged.paged_generate(params, prompt, cfg, max_new_tokens=6,
+                                       block_size=4)
+        # the KERNEL path must actually engage (the cap-size dispatch
+        # floor once silently routed these tiny test shapes to gather)
+        assert spy.call_count > 0
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
         # ragged: each padded sequence matches its solo decode
         p0 = prompt[:1, :5]
