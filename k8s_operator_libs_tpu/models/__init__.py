@@ -1,6 +1,9 @@
 """Model zoo for the TPU workload harness (flagship: Llama-3-style LM;
 second family: Mixtral-style MoE). Decode paths: contiguous KV
 (:mod:`.generate`), paged/block KV (:mod:`.paged`), int8 weight-only
-(:mod:`.quant`), MoE (:func:`.moe.moe_generate`)."""
+(:mod:`.quant`), MoE (:func:`.moe.moe_generate`), greedy speculative
+decoding with a draft model (:mod:`.speculative` — token-identical to
+target-only greedy decode by construction)."""
 
 from .llama import LlamaConfig, forward, init_params  # noqa: F401
+from .speculative import speculative_generate  # noqa: F401

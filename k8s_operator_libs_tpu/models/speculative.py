@@ -1,0 +1,174 @@
+"""Greedy speculative decoding, TPU-first.
+
+Decode is bandwidth-bound: every generated token streams the whole target
+model once (see bench.py's roofline). Speculative decoding breaks that
+bind — a cheap DRAFT model proposes ``k`` tokens autoregressively, then
+the target verifies all ``k`` in ONE forward pass (one weight stream for
+up to ``k+1`` emitted tokens). The scheme here is the greedy variant of
+Leviathan et al. / Chen et al. speculative sampling: with temperature 0
+the accept rule ("accept while the draft token equals the target's
+argmax, then emit the target's correction") makes the output stream
+**token-identical to vanilla greedy decoding of the target** — the
+speedup is pure systems, zero quality drift, and the equivalence is a
+testable invariant (tests/test_data_and_generate.py) rather than a
+statistical claim. Precision caveat, measured on v5e: the guarantee is
+exact up to argmax TIES — the verify pass evaluates the target at
+T=k+1 while vanilla decode evaluates at T=1, and when two logits are
+exactly equal (common with random weights, rare with trained ones)
+bf16's shape-dependent rounding can break the tie differently. fp32 is
+bitwise exact (the CPU suite pins it); a diagnosed on-chip divergence
+showed a 0.0 top-2 margin.
+
+TPU-first mechanics:
+
+- everything runs inside one ``jax.lax.while_loop`` under jit — static
+  shapes throughout. Rounds emit a VARIABLE number of tokens (1..k+1),
+  handled by writing a fixed ``k+1``-wide slab into an over-allocated
+  output buffer at a traced column offset: unconfirmed slots are simply
+  overwritten by later rounds.
+- both models reuse :func:`~.generate._forward_cached` and the
+  contiguous :class:`~.generate.KVCache` — verification is just a
+  ``T=k+1`` cached forward, and **rejection is a cache-length rewind**
+  (the same trick paged_generate uses for ragged prefills): rows written
+  for rejected draft tokens stay in HBM but sit past ``cache.length``,
+  masked off and overwritten by the next round.
+- batching: acceptance is synchronized to the batch MINIMUM each round
+  (the contiguous cache has one scalar length). This never changes the
+  output — tokens past the minimum are re-verified next round — it only
+  reduces the speedup as B grows; speculative decoding is a LATENCY
+  (small-B) optimization everywhere, and B=1 is its canonical setting.
+
+Only temperature 0 is supported: the sampled variant needs the
+rejection-sampling accept ratio and residual-distribution draws, whose
+output is distribution-equal but not token-equal — a different (harder
+to test) contract. The reference repo has no serving stack at all; this
+module is part of the TPU-native framework half.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .generate import KVCache, _forward_cached, init_cache
+from .llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+@partial(jax.jit, static_argnames=("target_cfg", "draft_cfg",
+                                   "max_new_tokens", "k", "draft_forward"))
+def speculative_generate(target_params: Params, draft_params: Params,
+                         prompt: jax.Array, target_cfg: LlamaConfig,
+                         draft_cfg: LlamaConfig,
+                         max_new_tokens: int = 32, k: int = 4,
+                         draft_forward=None) -> jax.Array:
+    """Greedy decode of the TARGET model, accelerated by a draft model.
+    prompt [B, Tp] int32 → [B, Tp + max_new_tokens], token-identical to
+    ``generate(target_params, prompt, target_cfg, max_new_tokens)``
+    (see the precision caveat in the module docstring).
+
+    ``k`` is the speculation depth: each round costs k draft steps + one
+    (k+1)-token target verify, and emits 1..k+1 confirmed tokens.
+
+    ``draft_forward`` overrides the draft's cached forward — signature
+    ``(params, tokens, cache, cfg) -> (logits, cache)``. The int8
+    quantized-SELF-draft (:func:`quantized_self_draft`) rides this hook:
+    the target's own weights in int8 propose tokens at roughly half the
+    weight traffic with near-1 acceptance, no second model needed."""
+    d_fwd = draft_forward or _forward_cached
+    B, Tp = prompt.shape
+    cap = Tp + max_new_tokens + k + 1   # rounds may overhang; trimmed below
+    t_cache = init_cache(target_cfg, B, cap)
+    d_cache = init_cache(draft_cfg, B, cap)
+
+    # prefill both models; token #1 is the target's greedy pick
+    t_logits, t_cache = _forward_cached(target_params, prompt, t_cache,
+                                        target_cfg)
+    _, d_cache = d_fwd(draft_params, prompt, d_cache, draft_cfg)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+
+    out = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def round_body(carry):
+        t_cache, d_cache, last, out, n = carry
+
+        # ---- draft proposes k tokens autoregressively (cheap steps)
+        def draft_step(dc, tok):
+            logits, dc = d_fwd(draft_params, tok[:, None], dc, draft_cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return dc, nxt
+
+        def draft_scan(carry, _):
+            dc, tok = carry
+            dc, nxt = draft_step(dc, tok)
+            return (dc, nxt), nxt
+
+        # k+1 steps: the extra step's PROPOSAL is discarded, but its
+        # feed writes d_k's cache row — without it a full-accept round
+        # leaves a zero row inside the draft's valid prefix and quietly
+        # degrades later acceptance (output stays exact either way; the
+        # target's correction is always authoritative)
+        (d_cache, _), proposals = jax.lax.scan(
+            draft_scan, (d_cache, last), None, length=k + 1)
+        drafts = jnp.moveaxis(proposals, 0, 1)[:, :k]  # [B, k]
+
+        # ---- target verifies the whole window in ONE forward
+        window = jnp.concatenate([last[:, None], drafts], axis=1)  # [B,k+1]
+        t_len0 = t_cache.length
+        v_logits, t_cache = _forward_cached(target_params, window, t_cache,
+                                            target_cfg)
+        greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)   # [B,k+1]
+        # greedy[:, i] is the target's pick AFTER window[:, :i+1] — the
+        # draft token drafts[:, i] is accepted iff it matches greedy[:, i]
+        match = drafts == greedy[:, :k]                            # [B, k]
+        acc_per_seq = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
+                                          axis=1), axis=1)         # [B]
+        a = jnp.min(acc_per_seq)        # batch-synchronized acceptance
+        a = jnp.minimum(a, jnp.int32(k))
+
+        # emitted this round: drafts[:, :a] then the correction
+        # greedy[:, a] — build the fixed k+1 slab; slots past a are
+        # provisional and get overwritten by later rounds
+        idx = jnp.arange(k + 1, dtype=jnp.int32)
+        slab = jnp.where(idx[None, :] < a,
+                         jnp.pad(drafts, ((0, 0), (0, 1))),
+                         jnp.take_along_axis(
+                             greedy, jnp.broadcast_to(a, (B, 1)),
+                             axis=1))                              # [B,k+1]
+        out = jax.lax.dynamic_update_slice(out, slab, (0, n))
+
+        # rewind: confirmed rows = old length + last token + a accepted
+        new_len = t_len0 + 1 + a
+        t_cache = KVCache(k=t_cache.k, v=t_cache.v, length=new_len)
+        d_cache = KVCache(k=d_cache.k, v=d_cache.v, length=new_len)
+        last_new = jnp.where(idx[None, :] == a, slab, 0).sum(axis=1)
+        return (t_cache, d_cache, last_new.astype(jnp.int32), out,
+                n + 1 + a)
+
+    def cond(carry):
+        return carry[-1] < max_new_tokens
+
+    init = (t_cache, d_cache, first, out, jnp.int32(1))
+    _, _, _, out, _ = jax.lax.while_loop(cond, round_body, init)
+    return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+
+
+def quantized_self_draft(target_params: Params):
+    """(draft_params, draft_forward) for speculation WITHOUT a second
+    model: the target's own weights quantized to int8 propose the draft
+    tokens; pass both to :func:`speculative_generate` with the target's
+    own config as ``draft_cfg``. Acceptance tracks how often int8 and
+    bf16 agree on the argmax, i.e. the target's top-2 logit margins vs
+    quantization noise — measured HONESTLY on the v5e: with random
+    (untrained) weights margins are near zero, acceptance is poor and
+    the end-to-end win is only ~1.06x over vanilla greedy at B=1; the
+    configuration exists for trained checkpoints, whose margins are
+    wide, and because it needs no second model. A genuinely small
+    trained draft remains the high-win setup."""
+    from .quant import _forward_quant, quantize_params
+    return quantize_params(target_params), _forward_quant

@@ -417,3 +417,43 @@ def test_paged_decode_kernel_matches_gather_path():
                                       np.asarray(solo0[0, 5:]))
     finally:
         paged.INTERPRET = False
+
+
+def test_speculative_generate_token_exact():
+    """Greedy speculative decoding's contract is EXACTNESS, not
+    similarity: for any draft model (good, bad, or the target itself) the
+    output must be token-identical to vanilla greedy decoding of the
+    target — the draft only moves the speed, never the tokens."""
+    import jax
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    from k8s_operator_libs_tpu.models.speculative import speculative_generate
+
+    import jax.numpy as jnp
+    # fp32 pins the guarantee exactly; bf16's shape-dependent rounding
+    # may break exact-tie argmaxes (module docstring caveat)
+    tcfg = LlamaConfig.tiny(dtype=jnp.float32)
+    tparams = init_params(jax.random.PRNGKey(0), tcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                tcfg.vocab_size)
+    ref = generate(tparams, prompt, tcfg, max_new_tokens=11)
+
+    # a WORSE draft (fewer layers, different init) — low acceptance path
+    dcfg = LlamaConfig.tiny(n_layers=1, dtype=jnp.float32)
+    dparams = init_params(jax.random.PRNGKey(7), dcfg)
+    for k in (1, 3):
+        out = speculative_generate(tparams, dparams, prompt, tcfg, dcfg,
+                                   max_new_tokens=11, k=k)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref),
+                                      err_msg=f"k={k}")
+    # the target as its own draft — full-acceptance path (k per round)
+    out = speculative_generate(tparams, tparams, prompt, tcfg, tcfg,
+                               max_new_tokens=11, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # int8 quantized-SELF-draft through the draft_forward hook: a lossy
+    # draft must still yield the target's exact tokens
+    from k8s_operator_libs_tpu.models.speculative import quantized_self_draft
+    qdraft, qfwd = quantized_self_draft(tparams)
+    out = speculative_generate(tparams, qdraft, prompt, tcfg, tcfg,
+                               max_new_tokens=11, k=3, draft_forward=qfwd)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
