@@ -3,7 +3,9 @@ second family: Mixtral-style MoE). Decode paths: contiguous KV
 (:mod:`.generate`), paged/block KV (:mod:`.paged`), int8 weight-only
 (:mod:`.quant`), MoE (:func:`.moe.moe_generate`), greedy speculative
 decoding with a draft model (:mod:`.speculative` — token-identical to
-target-only greedy decode by construction)."""
+target-only greedy decode by construction), continuous batching over the
+paged pool (:class:`.serve.ContinuousBatcher`)."""
 
 from .llama import LlamaConfig, forward, init_params  # noqa: F401
+from .serve import ContinuousBatcher  # noqa: F401
 from .speculative import speculative_generate  # noqa: F401

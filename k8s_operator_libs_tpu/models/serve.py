@@ -1,0 +1,222 @@
+"""Continuous batching over the paged KV cache — the serving loop the
+block-pool layout exists for.
+
+Static batching wastes the accelerator twice: short requests pad to the
+longest prompt, and finished sequences idle their batch slot until the
+whole batch drains. Continuous batching (Orca / vLLM) admits and retires
+requests mid-flight. This module re-designs that idea for XLA's
+static-shape world:
+
+- a fixed fleet of ``max_slots`` decode SLOTS shares one paged block
+  pool (:mod:`.paged`); per-slot block tables + lengths make slot state
+  fully independent, so admitting or retiring one request never touches
+  another's cache — the no-interference property the tests pin;
+- **two compiled programs total**: one single-request prefill per prompt
+  BUCKET (prompts pad to a power-of-two bucket, so a handful of
+  compilations cover all lengths) and ONE fused decode step that
+  advances every slot — active or not — each tick. Inactive slots
+  compute garbage into their own blocks and are ignored; that is the
+  static-shape tax, and it is exactly what a fixed-batch server pays
+  anyway;
+- block accounting is a HOST-side free list (ints), mirroring
+  :func:`~.paged.plan_blocks`: the device never allocates. Freed slots
+  return their blocks for reuse by later requests.
+
+The loop is deliberately synchronous and host-driven (submit → step* →
+poll): schedulers, priorities and streaming land on top of this core
+without touching the device programs. The reference repo has no serving
+stack; this is part of the TPU-native framework half.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+from .paged import DEFAULT_BLOCK_SIZE, PagedKVCache, _forward_paged
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray          # [Tp] int32
+    max_new: int
+    slot: int = -1
+    generated: Optional[List[int]] = None
+
+
+def _bucket(n: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class ContinuousBatcher:
+    """Greedy continuous-batching server over one model replica.
+
+    ``capacity_per_slot`` bounds prompt+generation per request; the pool
+    holds ``max_slots`` x that many tokens (rounded up to blocks) plus
+    the shared scratch block. Usage::
+
+        srv = ContinuousBatcher(params, cfg, max_slots=8)
+        rid = srv.submit(prompt_ids, max_new_tokens=64)
+        while not srv.idle:
+            srv.step()
+        tokens = srv.poll()[rid]
+    """
+
+    def __init__(self, params: Params, cfg: LlamaConfig, max_slots: int = 8,
+                 capacity_per_slot: int = 512,
+                 block_size: int = DEFAULT_BLOCK_SIZE):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.blocks_per_slot = -(-capacity_per_slot // block_size)
+        self.capacity = self.blocks_per_slot * block_size
+
+        L, KV, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        n_blocks = max_slots * self.blocks_per_slot + 1  # + scratch
+        self._scratch = n_blocks - 1
+        shape = (L, n_blocks, block_size, KV, Dh)
+        self._k = jnp.zeros(shape, cfg.dtype)
+        self._v = jnp.zeros(shape, cfg.dtype)
+        # host-side mirrors: tables/lengths upload with each device call
+        self._table = np.full((max_slots, self.blocks_per_slot),
+                              self._scratch, np.int32)
+        self._lengths = np.zeros((max_slots,), np.int32)
+        self._free_blocks = list(range(n_blocks - 1))
+        self._free_slots = list(range(max_slots))
+
+        self._queue: List[_Request] = []
+        self._running: Dict[int, _Request] = {}
+        self._done: Dict[int, np.ndarray] = {}
+        self._next_rid = 0
+        self._last_tok = np.zeros((max_slots,), np.int32)
+
+        self._prefill_cache: Dict[int, Any] = {}
+        self._decode_fn = self._build_decode()
+
+    # ------------------------------------------------------------ compiled
+
+    def _build_decode(self):
+        cfg = self.cfg
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode(params, k, v, table, lengths, toks):
+            cache = PagedKVCache(k=k, v=v, table=table, lengths=lengths)
+            logits, cache = _forward_paged(params, toks[:, None], cache, cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return cache.k, cache.v, nxt
+
+        return decode
+
+    def _prefill_fn(self, bucket: int):
+        if bucket not in self._prefill_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def prefill(params, k, v, table, prompt, length):
+                # one request: batch of 1 over the SHARED pool; its table
+                # row confines every write to its own blocks (+ scratch)
+                cache = PagedKVCache(k=k, v=v, table=table[None],
+                                     lengths=jnp.zeros((1,), jnp.int32))
+                logits, cache = _forward_paged(params, prompt[None], cache,
+                                               cfg)
+                last = jnp.take_along_axis(
+                    logits, (length - 1)[None, None, None], axis=1)[0, 0]
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                return cache.k, cache.v, nxt
+
+            self._prefill_cache[bucket] = prefill
+        return self._prefill_cache[bucket]
+
+    # ------------------------------------------------------------- public
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.capacity:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"slot capacity {self.capacity}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        return rid
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self._running
+
+    def poll(self) -> Dict[int, np.ndarray]:
+        """Completed request id → full token array (prompt + generated);
+        each result is returned once."""
+        out, self._done = self._done, {}
+        return out
+
+    def step(self) -> None:
+        """One server tick: admit queued requests into free slots
+        (prefill), then advance every slot one decode step."""
+        while self._queue and self._free_slots:
+            self._admit(self._queue.pop(0))
+        if not self._running:
+            return
+        k, v, nxt = self._decode_fn(
+            self.params, self._k, self._v, jnp.asarray(self._table),
+            jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
+        self._k, self._v = k, v
+        nxt = np.asarray(nxt)
+        finished = []
+        for rid, req in self._running.items():
+            s = req.slot
+            req.generated.append(int(self._last_tok[s]))
+            self._lengths[s] += 1          # the decode wrote last_tok's row
+            if len(req.generated) >= req.max_new:
+                finished.append(rid)
+            else:
+                self._last_tok[s] = nxt[s]
+        for rid in finished:
+            self._retire(self._running.pop(rid))
+
+    # ------------------------------------------------------------ internal
+
+    def _admit(self, req: _Request) -> None:
+        slot = self._free_slots.pop(0)
+        n_blk = self.blocks_per_slot
+        blocks = [self._free_blocks.pop(0) for _ in range(n_blk)]
+        self._table[slot, :] = np.asarray(blocks, np.int32)
+        Tp = len(req.prompt)
+        bucket = _bucket(Tp)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:Tp] = req.prompt
+        k, v, nxt = self._prefill_fn(bucket)(
+            self.params, self._k, self._v,
+            jnp.asarray(self._table[slot]), jnp.asarray(padded),
+            jnp.asarray(Tp, jnp.int32))
+        self._k, self._v = k, v
+        # padding rows were written past Tp — rewind, decode overwrites
+        self._lengths[slot] = Tp
+        self._last_tok[slot] = int(nxt)
+        req.slot = slot
+        req.generated = []
+        self._running[req.rid] = req
+
+    def _retire(self, req: _Request) -> None:
+        s = req.slot
+        self._done[req.rid] = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)])
+        self._free_blocks.extend(int(b) for b in self._table[s])
+        self._table[s, :] = self._scratch
+        self._lengths[s] = 0
+        self._free_slots.append(s)
