@@ -101,6 +101,7 @@ class ContinuousBatcher:
         self._running: Dict[int, _Request] = {}
         self._done: Dict[int, np.ndarray] = {}
         self._next_rid = 0
+        self._draining = False
         self._last_tok = np.zeros((max_slots,), np.int32)
 
         self._prefill_cache: Dict[int, Any] = {}
@@ -157,7 +158,29 @@ class ContinuousBatcher:
 
     @property
     def idle(self) -> bool:
+        if self._draining:
+            return not self._running
         return not self._queue and not self._running
+
+    def drain(self) -> None:
+        """Stop admitting work; in-flight requests run to completion.
+        The inference-side half of the operator's drain contract: when
+        the node is cordoned for a driver upgrade (SIGTERM via the pod's
+        grace period), the server finishes what it holds — bounded by
+        max_new_tokens — and queued requests hand off to a peer replica
+        via :meth:`handoff` instead of dying mid-decode. Mirrors the
+        training side, where the drain triggers a checkpoint
+        (train/harness.py); decode state is cheap to re-create, so the
+        serving story is finish + requeue, not save."""
+        self._draining = True
+
+    def handoff(self):
+        """(prompt, max_new_tokens) pairs never admitted — the caller
+        requeues them on another replica. Only meaningful after
+        :meth:`drain`; empties the queue."""
+        out = [(r.prompt, r.max_new) for r in self._queue]
+        self._queue.clear()
+        return out
 
     def poll(self) -> Dict[int, np.ndarray]:
         """Completed request id → full token array (prompt + generated);
@@ -168,7 +191,7 @@ class ContinuousBatcher:
     def step(self) -> None:
         """One server tick: admit queued requests into free slots
         (prefill), then advance every slot one decode step."""
-        while self._queue and self._free_slots:
+        while self._queue and self._free_slots and not self._draining:
             self._admit(self._queue.pop(0))
         if not self._running:
             return

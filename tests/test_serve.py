@@ -101,3 +101,37 @@ def test_submit_rejects_zero_new_tokens():
     import pytest
     with pytest.raises(ValueError, match="max_new_tokens"):
         srv.submit(np.zeros(4, np.int32), 0)
+
+
+def test_drain_finishes_in_flight_and_hands_off_queue():
+    """The upgrade-coordination contract for serving: after drain(), no
+    new admissions happen, every in-flight request completes exactly as
+    its solo decode, and the untouched queue is returned for requeueing
+    on a peer replica."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = ContinuousBatcher(params, CFG, max_slots=1,
+                            capacity_per_slot=48, block_size=8)
+    rng = np.random.default_rng(11)
+    p_run = rng.integers(0, CFG.vocab_size, size=6).astype(np.int32)
+    p_q1 = rng.integers(0, CFG.vocab_size, size=4).astype(np.int32)
+    p_q2 = rng.integers(0, CFG.vocab_size, size=5).astype(np.int32)
+    r_run = srv.submit(p_run, 5)
+    srv.step()                      # admits r_run into the only slot
+    srv.submit(p_q1, 3)             # stuck behind it
+    srv.submit(p_q2, 2)
+
+    srv.drain()
+    done = {}
+    for _ in range(20):
+        if srv.idle:
+            break
+        srv.step()
+        done.update(srv.poll())
+    done.update(srv.poll())
+    assert srv.idle
+    np.testing.assert_array_equal(done[r_run], _solo(params, p_run, 5))
+
+    handed = srv.handoff()
+    assert [(list(p), n) for p, n in handed] == [
+        (list(p_q1), 3), (list(p_q2), 2)]
+    assert srv._queue == [] and len(srv._free_slots) == 1
