@@ -144,6 +144,10 @@ class ContinuousBatcher:
     # ------------------------------------------------------------- public
 
     def submit(self, prompt, max_new_tokens: int) -> int:
+        if self._draining:
+            # a drained server will never admit this — failing fast lets
+            # the client reroute to a peer instead of polling forever
+            raise RuntimeError("server is draining; submit to a peer")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -175,10 +179,14 @@ class ContinuousBatcher:
         self._draining = True
 
     def handoff(self):
-        """(prompt, max_new_tokens) pairs never admitted — the caller
-        requeues them on another replica. Only meaningful after
-        :meth:`drain`; empties the queue."""
-        out = [(r.prompt, r.max_new) for r in self._queue]
+        """(rid, prompt, max_new_tokens) triples never admitted — the
+        caller requeues them on another replica and can map the old rids
+        to the peer's fresh ones. Only valid after :meth:`drain` (a live
+        server would silently lose its queue); empties the queue."""
+        if not self._draining:
+            raise RuntimeError("handoff() before drain() would drop a "
+                               "live queue")
+        out = [(r.rid, r.prompt, r.max_new) for r in self._queue]
         self._queue.clear()
         return out
 

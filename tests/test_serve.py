@@ -132,6 +132,21 @@ def test_drain_finishes_in_flight_and_hands_off_queue():
     np.testing.assert_array_equal(done[r_run], _solo(params, p_run, 5))
 
     handed = srv.handoff()
-    assert [(list(p), n) for p, n in handed] == [
-        (list(p_q1), 3), (list(p_q2), 2)]
+    assert [(r, list(p), n) for r, p, n in handed] == [
+        (1, list(p_q1), 3), (2, list(p_q2), 2)]
     assert srv._queue == [] and len(srv._free_slots) == 1
+    # a drained server refuses new work (fail fast, client reroutes)
+    import pytest
+    with pytest.raises(RuntimeError, match="draining"):
+        srv.submit(p_q1, 2)
+
+
+def test_handoff_requires_drain():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = ContinuousBatcher(params, CFG, max_slots=1,
+                            capacity_per_slot=32, block_size=8)
+    srv.submit(np.zeros(4, np.int32), 2)
+    import pytest
+    with pytest.raises(RuntimeError, match="before drain"):
+        srv.handoff()
+    assert len(srv._queue) == 1   # the live queue survived
