@@ -24,8 +24,13 @@ static-shape world:
 
 The loop is deliberately synchronous and host-driven (submit → step* →
 poll): schedulers, priorities and streaming land on top of this core
-without touching the device programs. The reference repo has no serving
-stack; this is part of the TPU-native framework half.
+without touching the device programs. Each tick pays one host↔device
+round-trip (the next-token readback drives admission/retirement
+decisions) — sub-millisecond on a real TPU VM, but ~250 ms over this
+repo's tunneled bench chip, so serving throughput is only meaningful
+measured host-adjacent; correctness (the no-interference tests) is
+what the CPU suite pins. The reference repo has no serving stack; this
+is part of the TPU-native framework half.
 """
 
 from __future__ import annotations
