@@ -253,8 +253,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # MXU-native inputs: keep q/k/v in their storage dtype (bf16) and
         # let the dot accumulate in fp32 via preferred_element_type —
         # casting the OPERANDS to fp32 forces the MXU's fp32 path at ~1/4
-        # throughput (measured 3-7% of bf16 peak at 8k before this change)
-        q = q_ref[...].reshape(rows, Dh)  # G heads stacked: one tall dot
+        # throughput (measured 3-7% of bf16 peak at 8k before this change).
+        # The softmax scale folds into q ONCE per block — the kernel is
+        # VPU-bound, and s*scale was a full extra VPU pass per chunk
+        q = (q_ref[...].reshape(rows, Dh) * scale).astype(q_ref.dtype)
         q_pos = _row_positions(iq * q_block, G, q_block) if causal else None
 
         def body(j, carry):
@@ -262,8 +264,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             k_blk = k_ref[0, pl.ds(j * chunk, chunk), :]
             v_blk = v_ref[0, pl.ds(j * chunk, chunk), :]
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32
-                                    ) * scale
+                                    preferred_element_type=jnp.float32)
             if causal:
                 s = _causal_mask(s, q_pos, sb * S + j * chunk, chunk)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
