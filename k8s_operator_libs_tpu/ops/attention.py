@@ -431,7 +431,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[...].reshape(rows, Dh)
+        # scale folded into q through the SAME bf16 rounding as the
+        # forward — p = exp(s - lse) renormalizes against the forward's
+        # logsumexp, so the logits must match it bit-for-bit
+        q = (q_ref[...].reshape(rows, Dh) * scale).astype(q_ref.dtype)
         do = do_ref[...].reshape(rows, Dh)
         lse = _columns(lse_ref[0], G, q_block)
         delta = _columns(delta_ref[0], G, q_block)
@@ -442,8 +445,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             v_blk = v_ref[0, pl.ds(j * chunk, chunk), :]
             # bf16 operands, fp32 accumulation — see _flash_kernel
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32
-                                    ) * scale
+                                    preferred_element_type=jnp.float32)
             if causal:
                 s = _causal_mask(s, q_pos, sb * S + j * chunk, chunk)
             p = jnp.exp(s - lse)                                 # [rows, C]
@@ -510,10 +512,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do_blk = do_ref[sl3].reshape(rows, Dh)
             lse_blk = _columns(lse_ref[sl2], G, q_chunk)
             delta_blk = _columns(delta_ref[sl2], G, q_chunk)
+            # scaled q (forward's exact rounding) for the logits; the dk
+            # accumulation below keeps UNSCALED q — its scale factor is
+            # applied once in _finalize (chain rule), not twice
+            q_s = (q_blk * scale).astype(q_blk.dtype)
             # bf16 operands, fp32 accumulation — see _flash_kernel
-            s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32
-                                    ) * scale
+            s = jax.lax.dot_general(q_s, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
             if causal:
                 q_pos = _row_positions(sq * Sq + j * q_chunk, G, q_chunk)
                 s = _causal_mask(s, q_pos, k_lo, k_block)
