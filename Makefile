@@ -11,8 +11,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-cov-report docker-bench docker-dryrun
 
-.PHONY: all native test test-fast lint cov-report bench dryrun apply-crds-dry clean \
-  $(DOCKER_TARGETS) .build-image
+.PHONY: all native test test-fast lint cov-report cov-artifact bench dryrun \
+  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint native test
 
@@ -40,6 +40,9 @@ COV_MIN ?= 80
 
 cov-report:  ## coverage via the stdlib tools/cov.py (sys.monitoring); fails under COV_MIN%
 	$(PYTHON) tools/cov.py tests/ -q --min-pct $(COV_MIN)
+
+cov-artifact:  ## full-suite run that REFRESHES the committed cov.json
+	$(PYTHON) tools/cov.py tests/ -q --min-pct $(COV_MIN) --update-artifact
 
 bench:
 	$(PYTHON) bench.py

@@ -33,6 +33,12 @@ class ConflictError(RuntimeError):
     """resourceVersion conflict on update (apierrors.IsConflict analog)."""
 
 
+class InvalidError(ValueError):
+    """HTTP 422 Unprocessable Entity: the object failed apiserver
+    validation (apierrors.IsInvalid analog) — e.g. a taint appended
+    without an effect."""
+
+
 class WatchError(RuntimeError):
     """A watch stream delivered an ERROR event (e.g. 410 Gone: the resource
     version expired). Consumers must re-list and re-establish the watch —

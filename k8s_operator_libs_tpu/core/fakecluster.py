@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
 from .client import (Client, ConflictError, EventRecorder, ExpiredError,
-                     NotFoundError,
+                     InvalidError, NotFoundError,
                      TooManyRequestsError, make_event)
 from .objects import (
     ContainerStatus,
@@ -586,6 +586,19 @@ class _FakeClient(Client):
                     taints.append(Taint(key=key,
                                         value=entry.get("value", ""),
                                         effect=entry.get("effect", "")))
+            # the real apiserver validates the MERGED object and 422s
+            # `spec.taints[i].effect: Required value` — this catches both
+            # an appended entry missing effect AND an explicit empty
+            # effect patched onto an existing key (ADVICE r4: the fake
+            # used to default "" and accept payloads the live path
+            # rejects). Raised before any store mutation, so a 422
+            # leaves the node untouched.
+            for t in taints:
+                if not t.effect:
+                    raise InvalidError(
+                        f"Node {name!r} is invalid: spec.taints: "
+                        f"Invalid value: taint {t.key!r}: effect: "
+                        "Required value")
             node.spec.taints = taints
             return self._c.update(node)
 

@@ -38,7 +38,7 @@ from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from . import serde
-from .client import ConflictError
+from .client import ConflictError, InvalidError
 from .fakecluster import FakeCluster
 
 _TO_JSON = {"Node": serde.node_to_json, "Pod": serde.pod_to_json,
@@ -318,6 +318,24 @@ class _Handler(BaseHTTPRequestHandler):
     def _patch_node(self, name: str, patch: Dict) -> None:
         client = self.cluster.client.direct()
         try:
+            # taints FIRST: it is the only sub-patch that can fail
+            # validation, and the real apiserver validates the merged
+            # object atomically — a 422 must leave the node fully
+            # untouched, so the validating operation runs before any
+            # other mutation lands
+            spec = patch.get("spec") or {}
+            node = self.cluster.get("Node", "", name)
+            if "taints" in spec:
+                if spec["taints"] is None:
+                    # explicit JSON null deletes the FIELD (clears the
+                    # list) — same SMP edge as the null-map handling below
+                    node = client.patch_node_taints(
+                        name, [{"$patch": "delete", "key": t.key}
+                               for t in node.spec.taints])
+                else:
+                    # list field with patchStrategy=merge/patchMergeKey=
+                    # key — merge-by-key + $patch:delete, NOT replace
+                    node = client.patch_node_taints(name, spec["taints"])
             meta = patch.get("metadata") or {}
             labels, annotations = meta.get("labels"), meta.get("annotations")
             # strategic-merge edge: an explicit JSON null for the whole MAP
@@ -332,26 +350,13 @@ class _Handler(BaseHTTPRequestHandler):
             if "labels" in meta or "annotations" in meta:
                 node = client.patch_node_metadata(
                     name, labels=labels, annotations=annotations)
-            else:
-                node = self.cluster.get("Node", "", name)
-            spec = patch.get("spec") or {}
             if "unschedulable" in spec:
                 node = client.patch_node_unschedulable(
                     name, bool(spec["unschedulable"]))
-            if "taints" in spec:
-                if spec["taints"] is None:
-                    # explicit JSON null deletes the FIELD (clears the
-                    # list) — same SMP edge as the null-map handling above
-                    node = self.cluster.get("Node", "", name)
-                    node = client.patch_node_taints(
-                        name, [{"$patch": "delete", "key": t.key}
-                               for t in node.spec.taints])
-                else:
-                    # list field with patchStrategy=merge/patchMergeKey=
-                    # key — merge-by-key + $patch:delete, NOT replace
-                    node = client.patch_node_taints(name, spec["taints"])
         except KeyError:
             return self._error(404, "NotFound", f"node {name} not found")
+        except InvalidError as exc:
+            return self._error(422, "Invalid", str(exc))
         self._send(200, serde.node_to_json(node))
 
     def _create_pod(self, ns: str, body: Dict) -> None:

@@ -42,7 +42,8 @@ from typing import Dict, List, Optional, Tuple
 import yaml
 
 from . import serde
-from .client import (Client, ConflictError, ExpiredError, NotFoundError,
+from .client import (Client, ConflictError, ExpiredError, InvalidError,
+                     NotFoundError,
                      TooManyRequestsError,
                      WatchError)  # noqa: F401  (WatchError re-export)
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
@@ -289,6 +290,9 @@ class KubeHTTP:
                     raise NotFoundError(f"{method} {path}: {detail}") from exc
                 if exc.code == 409:
                     raise ConflictError(f"{method} {path}: {detail}") from exc
+                if exc.code == 422:
+                    raise InvalidError(
+                        f"{method} {path}: {detail}") from exc
                 if exc.code == 429:
                     # PDB-blocked eviction; drain retries until timeout
                     raise TooManyRequestsError(

@@ -233,7 +233,16 @@ class ContinuousBatcher:
         blocks = [self._free_blocks.pop(0) for _ in range(n_blk)]
         self._table[slot, :] = np.asarray(blocks, np.int32)
         Tp = len(req.prompt)
-        bucket = _bucket(Tp)
+        # cap at capacity: a power-of-two bucket above a non-power-of-two
+        # capacity pads past the slot's table row. Those writes were
+        # surviving only by JAX's OOB defaults (take_along_axis fills
+        # INT_MIN, the scatter then DROPS the update) — correct today but
+        # implicit; the cap makes in-bounds writes a structural property
+        # and stops prefilling wider than the slot can hold. capacity is
+        # a whole number of blocks and submit() guarantees Tp < capacity,
+        # so every padded position lands in the slot's own blocks and the
+        # length rewind discards the pad rows.
+        bucket = min(_bucket(Tp), self.capacity)
         padded = np.zeros((bucket,), np.int32)
         padded[:Tp] = req.prompt
         k, v, nxt = self._prefill_fn(bucket)(

@@ -9,7 +9,8 @@ import yaml
 
 from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
                                                 DriverUpgradePolicySpec)
-from k8s_operator_libs_tpu.core.client import ConflictError, NotFoundError
+from k8s_operator_libs_tpu.core.client import (ConflictError, InvalidError,
+                                               NotFoundError)
 from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
 from k8s_operator_libs_tpu.core.httpapi import FakeAPIServer
 from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
@@ -843,6 +844,41 @@ def test_taint_list_patch_merges_by_key_like_real_apiserver(live):
                      body={"spec": {"taints": None}},
                      content_type="application/strategic-merge-patch+json")
     assert cli.get_node("n0").spec.taints == []
+
+
+def test_taint_append_without_effect_is_422(live):
+    """ADVICE r4: a NEW taint missing ``effect`` fails apiserver
+    validation (`spec.taints[i].effect: Required value`) — the fake used
+    to default it to "" and silently accept wire payloads the live path
+    422s. The wire round-trip must surface InvalidError, and the node
+    must be untouched. (Field-merge of an EXISTING key may still omit
+    effect — the matched entry supplies it; the merge test above pins
+    that.)"""
+    cluster, cli = live
+    cluster.add_node("n0")
+    cli.patch_node_taints(
+        "n0", [{"key": "tpu", "value": "v", "effect": "NoSchedule"}])
+    before = [(t.key, t.effect) for t in cli.get_node("n0").spec.taints]
+    with pytest.raises(InvalidError, match="effect: Required value"):
+        cli.patch_node_taints("n0", [{"key": "no-effect", "value": "x"}])
+    assert [(t.key, t.effect)
+            for t in cli.get_node("n0").spec.taints] == before
+    # the MERGED object is what validates: an explicit empty effect
+    # patched onto an existing key is just as invalid as a bare append
+    with pytest.raises(InvalidError, match="effect: Required value"):
+        cli.patch_node_taints("n0", [{"key": "tpu", "effect": ""}])
+    assert [(t.key, t.effect)
+            for t in cli.get_node("n0").spec.taints] == before
+    # composite PATCH atomicity: a 422 on the taints half must leave the
+    # metadata half unapplied too (the real apiserver validates the whole
+    # merged object before persisting anything)
+    with pytest.raises(InvalidError):
+        cli.http.request(
+            "PATCH", "/api/v1/nodes/n0",
+            body={"metadata": {"labels": {"leaked": "yes"}},
+                  "spec": {"taints": [{"key": "bad2", "value": "x"}]}},
+            content_type="application/strategic-merge-patch+json")
+    assert "leaked" not in cli.get_node("n0").metadata.labels
 
 
 def test_watch_reestablishes_after_timeout_without_loss(live):

@@ -94,6 +94,33 @@ def test_more_requests_than_slots_queue_and_complete():
         np.testing.assert_array_equal(done[rid], _solo(params, p, 4))
 
 
+def test_non_power_of_two_capacity_long_prompt():
+    """Regression (ADVICE r4): with a non-power-of-two capacity, a prompt
+    whose power-of-two pad bucket exceeds capacity pads PAST the slot's
+    table row. (Probed: those writes were dropped, not clamped —
+    take_along_axis fills OOB with INT_MIN and the scatter drops it —
+    but the pad width is now capped at capacity so in-bounds writes are
+    structural, not an OOB-default accident.) Tp=35 in
+    (capacity-block_size, capacity-max_new] = (32, 36] with capacity 40
+    hits exactly that window (_bucket(35)=64 > 40)."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = ContinuousBatcher(params, CFG, max_slots=1,
+                            capacity_per_slot=40, block_size=8)
+    assert srv.capacity == 40
+    rng = np.random.default_rng(17)
+    p = rng.integers(0, CFG.vocab_size, size=35).astype(np.int32)
+    rid = srv.submit(p, 4)
+    done = {}
+    for _ in range(20):
+        if srv.idle:
+            break
+        srv.step()
+        done.update(srv.poll())
+    np.testing.assert_array_equal(
+        done[rid], _solo(params, p, 4),
+        err_msg="over-capacity pad bucket corrupted the slot's KV blocks")
+
+
 def test_submit_rejects_zero_new_tokens():
     params = init_params(jax.random.PRNGKey(0), CFG)
     srv = ContinuousBatcher(params, CFG, max_slots=1,

@@ -17,8 +17,10 @@ for them; ``global``/``nonlocal`` likewise).
 Usage:
     python tools/cov.py [pytest args...]     # default: tests/ -q
 prints per-file coverage for the worst-covered files plus the package
-total, writes the full per-file table to ``cov.json``, and exits with
-pytest's exit code (so CI still fails on test failures, not coverage).
+total, writes the full per-file table to the untracked
+``cov.partial.json`` (pass ``--update-artifact`` on a full-suite run to
+refresh the committed ``cov.json``), and exits with pytest's exit code
+(so CI still fails on test failures, not coverage).
 """
 
 from __future__ import annotations
@@ -126,7 +128,7 @@ def report(hits: Dict[str, Set[int]], out_path: Path) -> float:
     for rel, got, exe, _missing in rows[:12]:
         print(f"  {100.0 * got / exe:5.1f}%  {got:>5}/{exe:<5}  {rel}")
     if len(rows) > 12:
-        print(f"  ... {len(rows) - 12} more files in cov.json")
+        print(f"  ... {len(rows) - 12} more files in {out_path.name}")
     print(f"TOTAL: {pct:.1f}% ({total_hit}/{total_exec} lines, "
           f"{len(rows)} files)")
     out_path.write_text(json.dumps({
@@ -155,6 +157,18 @@ def main(argv) -> int:
             print("usage: tools/cov.py [pytest args...] --min-pct N")
             return 2
         del argv[i:i + 2]
+    update_artifact = "--update-artifact" in argv
+    if update_artifact:
+        argv.remove("--update-artifact")
+    # filtered runs refuse --update-artifact BEFORE running anything: a
+    # partial suite must not masquerade as the full-suite artifact, and
+    # failing after minutes of tests would waste the run
+    partial = any(a == "-k" or "::" in a or a.endswith(".py")
+                  for a in argv)
+    if update_artifact and partial:
+        print("--update-artifact requires a full-suite run "
+              "(no -k/::/file filters)")
+        return 2
     # `python -m pytest` puts the cwd on sys.path; in-process pytest.main
     # does not, so the measured package must be made importable here
     if str(REPO) not in sys.path:
@@ -166,12 +180,19 @@ def main(argv) -> int:
         rc = pytest.main(argv or ["tests/", "-q"])
     finally:
         collector.stop()
-    # the tracked cov.json is the FULL-suite artifact (CI gate input);
-    # filtered runs (-k, ::node, single files) write cov.partial.json so
-    # they can't silently dirty the committed number
-    partial = any(a == "-k" or "::" in a or a.endswith(".py")
-                  for a in argv)
-    out_name = "cov.partial.json" if partial else "cov.json"
+    # the tracked cov.json is the FULL-suite artifact; it refreshes ONLY
+    # under --update-artifact on a PASSING run — by default every run
+    # (full or filtered) writes the untracked cov.partial.json, so a
+    # local run or a CI checkout never dirties the committed number as a
+    # side effect (ADVICE r4; the old partial-run heuristic only
+    # protected -k/:: runs). A failing/truncated run (-x, --maxfail, or
+    # plain failures) downgrades to the partial file: its coverage is
+    # not the full suite's.
+    if update_artifact and rc != 0:
+        print("--update-artifact: run did not pass cleanly; writing "
+              "cov.partial.json instead of the committed artifact")
+        update_artifact = False
+    out_name = "cov.json" if update_artifact else "cov.partial.json"
     pct = report(collector.hits, REPO / out_name)
     if rc == 0 and min_pct is not None and pct < min_pct:
         print(f"FAIL: coverage {pct:.1f}% below the --min-pct {min_pct}% "
