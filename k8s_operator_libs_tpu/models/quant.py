@@ -5,16 +5,25 @@ inference the HBM bytes/token — not FLOPs — set the ceiling (bench.py's
 decode roofline). Per-output-channel symmetric int8 halves the dominant
 params term versus bf16 (4x vs fp32) while keeping the matmul MXU-shaped.
 
-What this buys, measured honestly (v5e, 125M model, batch 8): the
-quantized tree is 1.7x smaller end-to-end (4x on the quantized mats;
-embed/norms stay float), which is the *capacity* win — a chip serves a
-~2x larger model or a deeper KV budget. Throughput at this small,
-latency-bound size is ~12% LOWER than the float path (6.8k vs 7.7k
-tok/s): the per-step int8→float convert is not free, and at 125M the
-decode step is dispatch/latency-bound, not bandwidth-bound, so saved
-bytes don't pay yet. The crossover is where weight streaming dominates —
-larger models and bigger batches — exactly where capacity pressure forces
-quantization anyway.
+What this buys, measured honestly (v5e, r5 two-point protocol — the r4
+1.007x "tie" at 760M was a measurement artifact: the old single-loop
+timing folded a ~0.1 s constant tunnel-sync cost into every rep, and
+r4's "165 GB/s platform streaming ceiling" was the same artifact):
+
+- the quantized tree is 2x smaller on the streamed mats (embed/norms
+  stay float) — the *capacity* win;
+- a matmul-only stream probe moves int8 weights at ~830 GB/s
+  (near-spec HBM) vs the identical bf16 pass at ~230 GB/s effective —
+  i.e. the int8→bf16 convert FUSES into the dot's operand read (no
+  dequantized copy is materialized);
+- end-to-end 760M greedy decode: **1.29x vs bf16 at B=16** (1.13x at
+  B=32, where weight streaming amortizes). The residual gap to the 2x
+  byte ratio is the decode step's non-weight time (attention over the
+  KV cache, norms/rope/cache updates, the 32k-vocab argmax), which
+  quantization does not touch;
+- at the 125M latency-bound shape int8 still LOSES ~12% — the
+  crossover argument (win where weight streaming dominates) now has
+  its honest demonstration at 760M.
 
 Scheme: for each 2-D weight slab ``w[in, out]`` (stacked ``[L, in, out]``
 for the scanned blocks), scale ``s[out] = max(|w[:, out]|) / 127`` and

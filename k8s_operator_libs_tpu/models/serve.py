@@ -11,13 +11,13 @@ static-shape world:
   pool (:mod:`.paged`); per-slot block tables + lengths make slot state
   fully independent, so admitting or retiring one request never touches
   another's cache — the no-interference property the tests pin;
-- **two compiled programs total**: one single-request prefill per prompt
-  BUCKET (prompts pad to a power-of-two bucket, so a handful of
-  compilations cover all lengths) and ONE fused decode step that
-  advances every slot — active or not — each tick. Inactive slots
-  compute garbage into their own blocks and are ignored; that is the
-  static-shape tax, and it is exactly what a fixed-batch server pays
-  anyway;
+- **a handful of compiled programs total**: one single-request prefill
+  per prompt BUCKET (prompts pad to a power-of-two bucket, so a few
+  compilations cover all lengths) and one fused decode scan per chunk
+  size ``n`` (``step(n)`` advances every slot — active or not — n ticks
+  per device call). Inactive slots compute garbage into their own
+  blocks and are ignored; that is the static-shape tax, and it is
+  exactly what a fixed-batch server pays anyway;
 - block accounting is a HOST-side free list (ints), mirroring
   :func:`~.paged.plan_blocks`: the device never allocates. Freed slots
   return their blocks for reuse by later requests.
@@ -110,20 +110,38 @@ class ContinuousBatcher:
         self._last_tok = np.zeros((max_slots,), np.int32)
 
         self._prefill_cache: Dict[int, Any] = {}
-        self._decode_fn = self._build_decode()
+        self._decode_cache: Dict[int, Any] = {}
+        self._build_decode(1)   # warm the common single-tick program
 
     # ------------------------------------------------------------ compiled
 
-    def _build_decode(self):
+    def _build_decode(self, n: int):
+        """One compiled program advancing every slot ``n`` decode steps
+        (a device-side ``lax.scan``), returning the [n, slots] next-token
+        matrix. n > 1 amortizes the per-tick host round-trip — the ~250 ms
+        tunnel tax documented in the module docstring — over n tokens;
+        the host applies the n tokens afterwards, so a request finishing
+        mid-chunk simply discards its tail (bounded overshoot, see
+        :meth:`step`)."""
+        if n in self._decode_cache:
+            return self._decode_cache[n]
         cfg = self.cfg
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode(params, k, v, table, lengths, toks):
-            cache = PagedKVCache(k=k, v=v, table=table, lengths=lengths)
-            logits, cache = _forward_paged(params, toks[:, None], cache, cfg)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return cache.k, cache.v, nxt
+            def body(carry, _):
+                k, v, lengths, toks = carry
+                cache = PagedKVCache(k=k, v=v, table=table, lengths=lengths)
+                logits, cache = _forward_paged(params, toks[:, None], cache,
+                                               cfg)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (cache.k, cache.v, cache.lengths, nxt), nxt
 
+            (k, v, _, _), toks_seq = jax.lax.scan(
+                body, (k, v, lengths, toks), None, length=n)
+            return k, v, toks_seq
+
+        self._decode_cache[n] = decode
         return decode
 
     def _prefill_fn(self, bucket: int):
@@ -201,27 +219,60 @@ class ContinuousBatcher:
         out, self._done = self._done, {}
         return out
 
-    def step(self) -> None:
-        """One server tick: admit queued requests into free slots
-        (prefill), then advance every slot one decode step."""
+    def step(self, n: int = 1) -> None:
+        """Advance the server ``n`` decode ticks in ONE device call:
+        admit queued requests into free slots (prefill), then run the
+        fused all-slots decode scan. ``n > 1`` amortizes the per-tick
+        host round-trip (the module docstring's ~250 ms tunnel tax) over
+        n tokens. A request reaching max_new mid-chunk retires there and
+        its remaining iterations are discarded — they wrote rows past
+        the request's end, which the per-sequence lengths mask and the
+        next occupant's prefill overwrites in-order. Admission happens
+        only at chunk boundaries, so large n trades admission latency
+        for round-trip savings; per-request OUTPUTS are identical to the
+        n=1 loop (pinned in tests)."""
+        if n < 1:
+            raise ValueError("step(n) needs n >= 1")
         while self._queue and self._free_slots and not self._draining:
             self._admit(self._queue.pop(0))
         if not self._running:
             return
-        k, v, nxt = self._decode_fn(
+        # structural in-bounds guarantee: the scan writes n rows into
+        # EVERY running slot, and a request retiring mid-chunk keeps
+        # being stepped to the chunk's end — so cap the chunk at the
+        # tightest remaining slot capacity. A retiring request may then
+        # overshoot its own max_new (tail discarded) but never its
+        # block-table row; without this the overshoot rows would ride
+        # JAX's OOB clamp semantics, exactly what _admit's bucket cap
+        # was added to stop relying on. When the cap bites, shrink to
+        # an ALREADY-COMPILED chunk size (n=1 is always warm) instead
+        # of compiling a one-off scan for every distinct tail value.
+        # Running slots always have length < capacity (submit enforces
+        # Tp + max_new <= capacity), so the cap is >= 1.
+        cap = min(self.capacity - int(self._lengths[r.slot])
+                  for r in self._running.values())
+        if n > cap:
+            n = max((c for c in self._decode_cache if c <= cap),
+                    default=1)
+        k, v, toks = self._build_decode(n)(
             self.params, self._k, self._v, jnp.asarray(self._table),
             jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
         self._k, self._v = k, v
-        nxt = np.asarray(nxt)
+        toks = np.asarray(toks)              # [n, slots]
         finished = []
         for rid, req in self._running.items():
             s = req.slot
-            req.generated.append(int(self._last_tok[s]))
-            self._lengths[s] += 1          # the decode wrote last_tok's row
-            if len(req.generated) >= req.max_new:
-                finished.append(rid)
+            # iteration i writes the token that entered it: last_tok for
+            # i=0, then each iteration's own next-token output
+            for i in range(n):
+                written = (self._last_tok[s] if i == 0 else toks[i - 1, s])
+                req.generated.append(int(written))
+                self._lengths[s] += 1
+                if len(req.generated) >= req.max_new:
+                    finished.append(rid)
+                    break
             else:
-                self._last_tok[s] = nxt[s]
+                self._last_tok[s] = toks[n - 1, s]
         for rid in finished:
             self._retire(self._running.pop(rid))
 

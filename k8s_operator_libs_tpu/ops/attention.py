@@ -66,11 +66,24 @@ import jax.numpy as jnp
 MAX_BLOCK = 512
 # Streamed-side columns per grid step: k/v (fwd, dq) or q/do (dkv) arrive
 # in slabs this wide (double-buffered ≈ 4 MB of VMEM at Dh=128) and the
-# inner fori covers SUPERBLOCK/MAX_BLOCK chunks per step, amortizing the
-# per-grid-step fixed cost that made one-chunk-per-step streaming 2.7x
-# slower (measured 21% → 56% of peak at 8k).
+# inner fori covers SUPERBLOCK-width worth of chunks per step, amortizing
+# the per-grid-step fixed cost that made one-chunk-per-step streaming
+# 2.7x slower. r5 honest numbers (full-gradient sync, two-point timing
+# that cancels the tunnel's constant ~0.1 s host-sync cost): fwd+bwd at
+# 8k runs 44% of bf16 peak equal-heads / 47% at Llama-3 GQA 32q/8kv, and
+# 55% at 32k — the r1-r3 56% figure was sync-inflated (SURVEY §8).
 SUPERBLOCK = 4096
 NEG_INF = -1e30
+# Base-2 softmax: exp(x) lowers to exp2(x·log2e) on the VPU, so folding
+# log2e into the q scale (free — it rides the existing scale multiply)
+# and running the online softmax in base 2 deletes one full [rows, chunk]
+# VPU multiply per chunk from every kernel. All three kernels must agree
+# (the backward renormalizes against the forward's logsumexp); lse is
+# STORED in base e (ring attention's merge consumes it), converted at the
+# kernel boundary where it is a [rows, 1] column — noise next to the
+# score tile.
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
 
 
 def _block_size(T: int) -> int:
@@ -95,15 +108,20 @@ def _q_block_size(T: int, G: int) -> int:
     return b
 
 
-def _k_chunk_size(T: int, rows: int) -> int:
+def _k_chunk_size(T: int, rows: int, cap_mb: int = 4) -> int:
     """Inner-loop chunk width on the streamed side: wider chunks amortize
-    the fori-loop and VPU-reduction overheads (measured fwd 14% → 21% of
-    peak going 512 → 1024 at 8k), capped so the fp32 score tile
-    [rows, chunk] stays ≤ 2 MB and by divisibility of T. Target is
-    2·MAX_BLOCK so tests that pin MAX_BLOCK=128 still exercise
-    chunk > q_block."""
-    c = 2 * MAX_BLOCK
-    while c > 128 and (rows * c * 4 > 2 * 1024 * 1024 or T % c):
+    the per-chunk FIXED cost (fori-loop iteration + dot issue + the
+    [rows, 1] running-stat updates), which an r5 on-chip ablation showed
+    dominates — not exp, not the mask: fwd at 8k measured 14.7% of peak
+    at chunk 512, 18.8% at 1024, 24.4% at 2048 with identical math. The
+    fp32 score tile [rows, chunk] is capped at ``cap_mb`` and chunk
+    divides T. The cap is per-kernel: the forward holds ONE fp32
+    [rows, chunk] temporary and takes 4 MB (8 MB OOM'd scoped VMEM next
+    to the double-buffered slabs); the backward kernels hold three
+    (s/p/dp + ds) and OOM'd at 4 MB, so they pass 2. Target 4·MAX_BLOCK
+    so tests that pin MAX_BLOCK=128 still exercise chunk > q_block."""
+    c = 4 * MAX_BLOCK
+    while c > 128 and (rows * c * 4 > cap_mb * 1024 * 1024 or T % c):
         c //= 2
     return c
 
@@ -254,35 +272,51 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # let the dot accumulate in fp32 via preferred_element_type —
         # casting the OPERANDS to fp32 forces the MXU's fp32 path at ~1/4
         # throughput (measured 3-7% of bf16 peak at 8k before this change).
-        # The softmax scale folds into q ONCE per block — the kernel is
-        # VPU-bound, and s*scale was a full extra VPU pass per chunk
-        q = (q_ref[...].reshape(rows, Dh) * scale).astype(q_ref.dtype)
+        # The softmax scale AND the base-2 factor fold into q ONCE per
+        # block — the kernel is VPU-bound; s*scale was a full extra VPU
+        # pass per chunk, and exp-vs-exp2 another (see LOG2E)
+        q = (q_ref[...].reshape(rows, Dh)
+             * (scale * LOG2E)).astype(q_ref.dtype)
         q_pos = _row_positions(iq * q_block, G, q_block) if causal else None
 
-        def body(j, carry):
+        def body(j, carry, masked):
             acc, m, l = carry  # registers across the slab's chunks
             k_blk = k_ref[0, pl.ds(j * chunk, chunk), :]
             v_blk = v_ref[0, pl.ds(j * chunk, chunk), :]
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            if causal:
+            if masked:
                 s = _causal_mask(s, q_pos, sb * S + j * chunk, chunk)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m - m_new)
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc_new = acc * alpha + jax.lax.dot_general(
                 p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             return acc_new, m_new, l_new
 
-        if causal:
-            # diagonal superblock: only chunks at or before q_end
-            ch_hi = jnp.clip((q_end - sb * S) // chunk + 1, 0, n_ch)
-        else:
-            ch_hi = n_ch
         carry = (acc_ref[...], m_ref[...], l_ref[...])
-        acc, m, l = jax.lax.fori_loop(0, ch_hi, body, carry)
+        if causal:
+            # the kernel is VPU-bound (the MXU dots are ~1/3 of a chunk's
+            # cycles), so the mask's iota+compare+select per [rows, chunk]
+            # tile is real money — but only chunks STRADDLING the diagonal
+            # need it. A chunk is fully visible iff its last column
+            # sb·S + (j+1)·chunk − 1 ≤ the block's first query row iq·qb;
+            # run those unmasked, mask only the straddlers, skip the rest
+            # (measured fwd 14% → 19% of peak at 8k from this split alone)
+            ch_nomask = jnp.clip((iq * q_block + 1 - sb * S) // chunk,
+                                 0, n_ch)
+            ch_hi = jnp.clip((q_end - sb * S) // chunk + 1, 0, n_ch)
+            carry = jax.lax.fori_loop(
+                0, ch_nomask, functools.partial(body, masked=False), carry)
+            carry = jax.lax.fori_loop(
+                ch_nomask, ch_hi, functools.partial(body, masked=True),
+                carry)
+        else:
+            carry = jax.lax.fori_loop(
+                0, n_ch, functools.partial(body, masked=False), carry)
+        acc, m, l = carry
         acc_ref[...] = acc
         m_ref[...] = m
         l_ref[...] = l
@@ -292,7 +326,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[...] = (acc_ref[...] / l).reshape(
             G, q_block, Dh).astype(o_ref.dtype)
-        lse_ref[0] = _rows_from_column(m_ref[...] + jnp.log(l),
+        # m is a base-2 running max (s carries log2e); lse is stored in
+        # base e for the ring-attention merge consumers
+        lse_ref[0] = _rows_from_column(m_ref[...] * LN2 + jnp.log(l),
                                        G, q_block)
 
 
@@ -431,24 +467,26 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        # scale folded into q through the SAME bf16 rounding as the
-        # forward — p = exp(s - lse) renormalizes against the forward's
-        # logsumexp, so the logits must match it bit-for-bit
-        q = (q_ref[...].reshape(rows, Dh) * scale).astype(q_ref.dtype)
+        # scale·log2e folded into q through the SAME bf16 rounding as the
+        # forward — p = exp2(s₂ - lse₂) renormalizes against the
+        # forward's logsumexp, so the base-2 logits must match it
+        # bit-for-bit; lse converts to base 2 on its [rows, 1] column
+        q = (q_ref[...].reshape(rows, Dh)
+             * (scale * LOG2E)).astype(q_ref.dtype)
         do = do_ref[...].reshape(rows, Dh)
-        lse = _columns(lse_ref[0], G, q_block)
+        lse = _columns(lse_ref[0], G, q_block) * LOG2E
         delta = _columns(delta_ref[0], G, q_block)
         q_pos = _row_positions(iq * q_block, G, q_block) if causal else None
 
-        def body(j, dq_acc):
+        def body(j, dq_acc, masked):
             k_blk = k_ref[0, pl.ds(j * chunk, chunk), :]
             v_blk = v_ref[0, pl.ds(j * chunk, chunk), :]
             # bf16 operands, fp32 accumulation — see _flash_kernel
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            if causal:
+            if masked:
                 s = _causal_mask(s, q_pos, sb * S + j * chunk, chunk)
-            p = jnp.exp(s - lse)                                 # [rows, C]
+            p = jnp.exp2(s - lse)                                # [rows, C]
             dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             ds = (p * (dp - delta)).astype(k_blk.dtype)
@@ -456,9 +494,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 ds, k_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        ch_hi = (jnp.clip((q_end - sb * S) // chunk + 1, 0, n_ch)
-                 if causal else n_ch)
-        dq_acc_ref[...] = jax.lax.fori_loop(0, ch_hi, body, dq_acc_ref[...])
+        if causal:
+            # mask only the diagonal straddlers — see _flash_kernel
+            ch_nomask = jnp.clip((iq * q_block + 1 - sb * S) // chunk,
+                                 0, n_ch)
+            ch_hi = jnp.clip((q_end - sb * S) // chunk + 1, 0, n_ch)
+            dq_acc = jax.lax.fori_loop(
+                0, ch_nomask, functools.partial(body, masked=False),
+                dq_acc_ref[...])
+            dq_acc_ref[...] = jax.lax.fori_loop(
+                ch_nomask, ch_hi, functools.partial(body, masked=True),
+                dq_acc)
+        else:
+            dq_acc_ref[...] = jax.lax.fori_loop(
+                0, n_ch, functools.partial(body, masked=False),
+                dq_acc_ref[...])
 
     @pl.when(sb == n_sb - 1)
     def _finalize():
@@ -504,25 +554,26 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        def body(j, carry):
+        def body(j, carry, masked):
             dk_acc, dv_acc = carry
             sl3 = (slice(None), pl.ds(j * q_chunk, q_chunk), slice(None))
             sl2 = (0, slice(None), pl.ds(j * q_chunk, q_chunk))
             q_blk = q_ref[sl3].reshape(rows, Dh)
             do_blk = do_ref[sl3].reshape(rows, Dh)
-            lse_blk = _columns(lse_ref[sl2], G, q_chunk)
+            lse_blk = _columns(lse_ref[sl2], G, q_chunk) * LOG2E
             delta_blk = _columns(delta_ref[sl2], G, q_chunk)
-            # scaled q (forward's exact rounding) for the logits; the dk
-            # accumulation below keeps UNSCALED q — its scale factor is
-            # applied once in _finalize (chain rule), not twice
-            q_s = (q_blk * scale).astype(q_blk.dtype)
+            # scale·log2e-folded q (forward's exact rounding) for the
+            # base-2 logits; the dk accumulation below keeps UNSCALED q —
+            # its scale factor is applied once in _finalize (chain rule),
+            # not twice
+            q_s = (q_blk * (scale * LOG2E)).astype(q_blk.dtype)
             # bf16 operands, fp32 accumulation — see _flash_kernel
             s = jax.lax.dot_general(q_s, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            if causal:
+            if masked:
                 q_pos = _row_positions(sq * Sq + j * q_chunk, G, q_chunk)
                 s = _causal_mask(s, q_pos, k_lo, k_block)
-            p = jnp.exp(s - lse_blk)                             # [rows, Bk]
+            p = jnp.exp2(s - lse_blk)                            # [rows, Bk]
             p_lo = p.astype(do_blk.dtype)
             dv_new = dv_acc + jax.lax.dot_general(
                 p_lo, do_blk, (((0,), (0,)), ((), ())),
@@ -535,11 +586,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)              # [Bk, Dh]
             return dk_new, dv_new
 
-        # diagonal superblock: skip chunks fully before this k-block
-        ch_lo = (jnp.clip((k_lo - sq * Sq) // q_chunk, 0, n_ch)
-                 if causal else 0)
         carry = (dk_acc_ref[...], dv_acc_ref[...])
-        dk, dv = jax.lax.fori_loop(ch_lo, n_ch, body, carry)
+        if causal:
+            # diagonal superblock: skip chunks fully before this k-block;
+            # mask only the straddlers (a chunk whose FIRST query row
+            # sq·Sq + j·q_chunk is at or past the k-block's last column is
+            # fully visible) — see _flash_kernel on why the mask is worth
+            # skipping on a VPU-bound kernel
+            ch_lo = jnp.clip((k_lo - sq * Sq) // q_chunk, 0, n_ch)
+            ch_mid = jnp.clip(
+                (k_lo + k_block - 1 - sq * Sq + q_chunk - 1) // q_chunk,
+                ch_lo, n_ch)
+            carry = jax.lax.fori_loop(
+                ch_lo, ch_mid, functools.partial(body, masked=True), carry)
+            dk, dv = jax.lax.fori_loop(
+                ch_mid, n_ch, functools.partial(body, masked=False), carry)
+        else:
+            dk, dv = jax.lax.fori_loop(
+                0, n_ch, functools.partial(body, masked=False), carry)
         dk_acc_ref[...] = dk
         dv_acc_ref[...] = dv
 
@@ -571,8 +635,9 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     rows = G * qblk
     S = _super_size(T)          # k/v slab for the dq grid
     Sq = _super_size(T, G)      # q/do slab for the dkv grid (G rows/col)
-    # dq inner chunk AND dkv outer block (≤ S when tests pin SUPERBLOCK)
-    kblk = min(_k_chunk_size(T, rows), S)
+    # dq inner chunk AND dkv outer block (≤ S when tests pin SUPERBLOCK);
+    # 2 MB tile cap — the backwards hold 3 fp32 [rows, chunk] temps
+    kblk = min(_k_chunk_size(T, rows, cap_mb=2), S)
     vspec = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     kv_stream = vspec((1, S, Dh), _kv_index_map(causal, qblk, S))
     q_map = _q_index_map(causal, Sq, kblk)

@@ -37,6 +37,13 @@ logger = logging.getLogger(__name__)
 AXES = ("stage", "data", "fsdp", "seq", "tensor")
 
 
+def _topology_aware_capable(devices) -> bool:
+    """mesh_utils can only lay axes onto an ICI torus on real TPU
+    devices; virtual CPU meshes take the reshape path. Split out so the
+    CPU suite can exercise the physical-assignment branch."""
+    return devices[0].platform == "tpu"
+
+
 def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
               tensor: int = 1, stage: int = 1, devices=None,
               physical: bool = True) -> Mesh:
@@ -61,7 +68,7 @@ def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
     shape = (stage, data, fsdp, seq, tensor)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh {shape} needs {np.prod(shape)} devices, have {n}")
-    if physical and n > 1 and devices[0].platform == "tpu":
+    if physical and n > 1 and _topology_aware_capable(devices):
         try:
             from jax.experimental import mesh_utils
             dev_array = mesh_utils.create_device_mesh(

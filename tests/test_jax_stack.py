@@ -461,3 +461,76 @@ def test_bf16_first_moment_checkpoint_roundtrip(rng, tmp_path):
     np.testing.assert_array_equal(np.asarray(restored.step),
                                   np.asarray(state.step))
     trainer2.close()
+
+
+def test_make_mesh_fsdp_absorbs_remaining_devices():
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(data=2)              # fsdp=None -> 8/2 = 4
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "stage": 1, "data": 2, "fsdp": 4, "seq": 1, "tensor": 1}
+
+
+def test_make_mesh_rejects_bad_factorizations():
+    import pytest
+
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+    with pytest.raises(ValueError, match="not divisible"):
+        make_mesh(data=3)                 # 8 % 3
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(data=8, fsdp=2)         # 16 > 8 devices
+
+
+def test_make_mesh_physical_assignment_and_fallback(monkeypatch, caplog):
+    """The ICI-topology branch: when mesh_utils succeeds its device
+    array is used; when it raises, the reshape fallback engages WITH a
+    loud warning (a silent fallback can park the tensor axis on the
+    slowest ICI dim)."""
+    import logging
+
+    import numpy as np
+    from jax.experimental import mesh_utils
+
+    import k8s_operator_libs_tpu.parallel.mesh as mesh_mod
+
+    monkeypatch.setattr(mesh_mod, "_topology_aware_capable", lambda d: True)
+    calls = {}
+
+    def fake_create(shape, devices=None, allow_split_physical_axes=None):
+        calls["shape"] = tuple(shape)
+        return np.asarray(devices)[::-1].reshape(shape)  # distinct order
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+    mesh = mesh_mod.make_mesh(fsdp=4, tensor=2)
+    assert calls["shape"] == (1, 1, 4, 1, 2)
+    # the physical assignment's (reversed) order was honored
+    import jax
+    assert mesh.devices.flatten()[0] == jax.devices()[-1]
+
+    def broken_create(*a, **k):
+        raise RuntimeError("no topology for virtual devices")
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", broken_create)
+    with caplog.at_level(logging.WARNING,
+                         logger="k8s_operator_libs_tpu.parallel.mesh"):
+        mesh = mesh_mod.make_mesh(fsdp=4, tensor=2)
+    assert "falling back to device-order reshape" in caplog.text
+    assert mesh.devices.shape == (1, 1, 4, 1, 2)   # fallback still correct
+
+
+def test_shard_params_places_per_specs(rng):
+    import jax
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    from k8s_operator_libs_tpu.parallel.mesh import (make_mesh, param_specs,
+                                                     shard_params)
+    cfg = LlamaConfig.tiny()
+    params = init_params(rng, cfg)
+    mesh = make_mesh(fsdp=8)
+    sharded = shard_params(params, mesh)
+    specs = param_specs(params)
+    embed_shard = sharded["embed"].sharding
+    assert embed_shard.mesh.axis_names == mesh.axis_names
+    assert embed_shard.spec == specs["embed"]
+    # really distributed: the fsdp dim is split 8 ways
+    assert (sharded["blocks"]["wq"].addressable_shards[0].data.shape[1]
+            == params["blocks"]["wq"].shape[1] // 8)
