@@ -81,7 +81,20 @@ class ContinuousBatcher:
 
     def __init__(self, params: Params, cfg: LlamaConfig, max_slots: int = 8,
                  capacity_per_slot: int = 512,
-                 block_size: int = DEFAULT_BLOCK_SIZE):
+                 block_size: int = DEFAULT_BLOCK_SIZE,
+                 shared_prefix=None):
+        """``shared_prefix`` (int32 tokens) is a system prompt every
+        request shares: its KV is computed ONCE at construction into
+        dedicated pool blocks that every slot's table row references
+        read-only — the paged layout's structural win (vLLM prefix
+        caching, simplified to the one-static-prefix case that needs no
+        copy-on-write). Storage: one copy instead of ``max_slots``;
+        compute: one prefill instead of one per request. Only whole
+        blocks are shared; the sub-block remainder is transparently
+        prepended to each request's own prompt (sharing a partial block
+        would let one slot's prefill write into another's visible rows).
+        ``capacity_per_slot`` still bounds each request's PRIVATE tokens
+        (remainder + prompt + generation)."""
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -89,17 +102,36 @@ class ContinuousBatcher:
         self.blocks_per_slot = -(-capacity_per_slot // block_size)
         self.capacity = self.blocks_per_slot * block_size
 
+        if shared_prefix is None:
+            shared_prefix = np.zeros((0,), np.int32)
+        shared_prefix = np.asarray(shared_prefix, np.int32).reshape(-1)
+        n_pb = len(shared_prefix) // block_size       # whole blocks shared
+        self._prefix_blocks = n_pb
+        self._prefix_aligned = n_pb * block_size
+        self._prefix_rem = shared_prefix[self._prefix_aligned:]
+        # absolute position where a slot's private region starts/ends
+        self._slot_limit = self._prefix_aligned + self.capacity
+
         L, KV, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        n_blocks = max_slots * self.blocks_per_slot + 1  # + scratch
+        n_blocks = n_pb + max_slots * self.blocks_per_slot + 1  # + scratch
         self._scratch = n_blocks - 1
         shape = (L, n_blocks, block_size, KV, Dh)
         self._k = jnp.zeros(shape, cfg.dtype)
         self._v = jnp.zeros(shape, cfg.dtype)
-        # host-side mirrors: tables/lengths upload with each device call
-        self._table = np.full((max_slots, self.blocks_per_slot),
+        # host-side mirrors: tables/lengths upload with each device call.
+        # Row layout: [prefix blocks 0..n_pb) | private slots, scratch
+        # when free] — position p maps to row index p // block_size, so
+        # the shared prefix occupies positions [0, prefix_aligned).
+        self._table = np.full((max_slots, n_pb + self.blocks_per_slot),
                               self._scratch, np.int32)
-        self._lengths = np.zeros((max_slots,), np.int32)
-        self._free_blocks = list(range(n_blocks - 1))
+        self._table[:, :n_pb] = np.arange(n_pb, dtype=np.int32)[None, :]
+        # idle slots park at the aligned prefix boundary, NOT zero: the
+        # fused decode still steps them, and a write at position 0 would
+        # scatter into shared prefix block 0 — parked at the boundary it
+        # lands in the scratch-backed private region instead
+        self._lengths = np.full((max_slots,), self._prefix_aligned,
+                                np.int32)
+        self._free_blocks = list(range(n_pb, n_blocks - 1))
         self._free_slots = list(range(max_slots))
 
         self._queue: List[_Request] = []
@@ -112,6 +144,25 @@ class ContinuousBatcher:
         self._prefill_cache: Dict[int, Any] = {}
         self._decode_cache: Dict[int, Any] = {}
         self._build_decode(1)   # warm the common single-tick program
+        if n_pb:
+            self._prefill_shared_prefix(shared_prefix[:self._prefix_aligned])
+
+    def _prefill_shared_prefix(self, tokens: np.ndarray) -> None:
+        """One forward over the aligned prefix writes its K/V into the
+        shared blocks; logits are discarded (the first request token's
+        context is re-evaluated by that request's own prefill)."""
+        cfg = self.cfg
+        table = jnp.arange(self._prefix_blocks, dtype=jnp.int32)[None]
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefix_fill(params, k, v, prompt):
+            cache = PagedKVCache(k=k, v=v, table=table,
+                                 lengths=jnp.zeros((1,), jnp.int32))
+            _, cache = _forward_paged(params, prompt[None], cache, cfg)
+            return cache.k, cache.v
+
+        self._k, self._v = prefix_fill(self.params, self._k, self._v,
+                                       jnp.asarray(tokens))
 
     # ------------------------------------------------------------ compiled
 
@@ -149,11 +200,13 @@ class ContinuousBatcher:
             cfg = self.cfg
 
             @partial(jax.jit, donate_argnums=(1, 2))
-            def prefill(params, k, v, table, prompt, length):
+            def prefill(params, k, v, table, prompt, length, start):
                 # one request: batch of 1 over the SHARED pool; its table
-                # row confines every write to its own blocks (+ scratch)
+                # row confines every write to its own blocks (+ scratch).
+                # ``start`` = absolute position of the prompt's first
+                # token (the aligned shared-prefix length, 0 without one)
                 cache = PagedKVCache(k=k, v=v, table=table[None],
-                                     lengths=jnp.zeros((1,), jnp.int32))
+                                     lengths=start[None])
                 logits, cache = _forward_paged(params, prompt[None], cache,
                                                cfg)
                 last = jnp.take_along_axis(
@@ -174,9 +227,11 @@ class ContinuousBatcher:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.capacity:
+        private = len(self._prefix_rem) + len(prompt) + max_new_tokens
+        if private > self.capacity:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"prefix remainder {len(self._prefix_rem)} + prompt "
+                f"{len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"slot capacity {self.capacity}")
         rid = self._next_rid
         self._next_rid += 1
@@ -247,9 +302,10 @@ class ContinuousBatcher:
         # was added to stop relying on. When the cap bites, shrink to
         # an ALREADY-COMPILED chunk size (n=1 is always warm) instead
         # of compiling a one-off scan for every distinct tail value.
-        # Running slots always have length < capacity (submit enforces
-        # Tp + max_new <= capacity), so the cap is >= 1.
-        cap = min(self.capacity - int(self._lengths[r.slot])
+        # Running slots always have length < the slot limit (submit
+        # enforces remainder + Tp + max_new <= capacity), so the cap
+        # is >= 1.
+        cap = min(self._slot_limit - int(self._lengths[r.slot])
                   for r in self._running.values())
         if n > cap:
             n = max((c for c in self._decode_cache if c <= cap),
@@ -282,8 +338,14 @@ class ContinuousBatcher:
         slot = self._free_slots.pop(0)
         n_blk = self.blocks_per_slot
         blocks = [self._free_blocks.pop(0) for _ in range(n_blk)]
-        self._table[slot, :] = np.asarray(blocks, np.int32)
-        Tp = len(req.prompt)
+        self._table[slot, self._prefix_blocks:] = np.asarray(blocks,
+                                                             np.int32)
+        # the sub-block remainder of the shared prefix rides each
+        # request's own prefill (see __init__); positions below the
+        # aligned prefix are served by the shared blocks
+        eff_prompt = (np.concatenate([self._prefix_rem, req.prompt])
+                      if len(self._prefix_rem) else req.prompt)
+        Tp = len(eff_prompt)
         # cap at capacity: a power-of-two bucket above a non-power-of-two
         # capacity pads past the slot's table row. Those writes were
         # surviving only by JAX's OOB defaults (take_along_axis fills
@@ -295,14 +357,15 @@ class ContinuousBatcher:
         # length rewind discards the pad rows.
         bucket = min(_bucket(Tp), self.capacity)
         padded = np.zeros((bucket,), np.int32)
-        padded[:Tp] = req.prompt
+        padded[:Tp] = eff_prompt
         k, v, nxt = self._prefill_fn(bucket)(
             self.params, self._k, self._v,
             jnp.asarray(self._table[slot]), jnp.asarray(padded),
-            jnp.asarray(Tp, jnp.int32))
+            jnp.asarray(Tp, jnp.int32),
+            jnp.asarray(self._prefix_aligned, jnp.int32))
         self._k, self._v = k, v
         # padding rows were written past Tp — rewind, decode overwrites
-        self._lengths[slot] = Tp
+        self._lengths[slot] = self._prefix_aligned + Tp
         self._last_tok[slot] = int(nxt)
         req.slot = slot
         req.generated = []
@@ -312,7 +375,9 @@ class ContinuousBatcher:
         s = req.slot
         self._done[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
-        self._free_blocks.extend(int(b) for b in self._table[s])
-        self._table[s, :] = self._scratch
-        self._lengths[s] = 0
+        # free the PRIVATE blocks only; the shared-prefix columns stay
+        self._free_blocks.extend(
+            int(b) for b in self._table[s, self._prefix_blocks:])
+        self._table[s, self._prefix_blocks:] = self._scratch
+        self._lengths[s] = self._prefix_aligned   # idle park (see __init__)
         self._free_slots.append(s)

@@ -131,13 +131,51 @@ def init_train_state(rng: jax.Array, cfg: LlamaConfig,
 
 
 def _train_step_body(loss_fn: Callable,
-                     optimizer: optax.GradientTransformation) -> Callable:
+                     optimizer: optax.GradientTransformation,
+                     grad_accum: int = 1) -> Callable:
     """The one step body every parallel path shares: value_and_grad →
-    optimizer update → TrainState + {loss, grad_norm, step} metrics."""
+    optimizer update → TrainState + {loss, grad_norm, step} metrics.
+
+    ``grad_accum=A`` splits the batch's leading dim into A equal
+    microbatches walked by a ``lax.scan`` — activation memory is ONE
+    microbatch's, so the effective batch scales A× past what HBM fits in
+    one pass, at the cost of A sequential passes (the standard
+    large-batch recipe; the reference-free TPU half's analog of
+    DDP no_sync accumulation). Gradients accumulate in fp32 regardless
+    of param dtype — summing A bf16 grad trees loses low bits exactly
+    where accumulation is supposed to add them — and the mean equals the
+    full-batch mean exactly because microbatches are equal-sized. One
+    optimizer update per step, so optimizer state and step counters are
+    unchanged by A."""
+
+    def compute_grads(params, tokens):
+        if grad_accum == 1:
+            return jax.value_and_grad(loss_fn)(params, tokens)
+        if tokens.shape[0] % grad_accum:
+            raise ValueError(f"batch {tokens.shape[0]} not divisible by "
+                             f"grad_accum={grad_accum}")
+        micro = tokens.reshape(grad_accum, tokens.shape[0] // grad_accum,
+                               *tokens.shape[1:])
+
+        def body(carry, mb):
+            loss_acc, grad_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, grads)
+            return (loss_acc + loss.astype(jnp.float32), grad_acc), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), zeros), micro)
+        scale = 1.0 / grad_accum
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g * scale).astype(p.dtype), grad_sum, params)
+        return loss_sum * scale, grads
 
     def train_step(state: TrainState, tokens: jax.Array
                    ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        loss, grads = compute_grads(state.params, tokens)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -151,26 +189,29 @@ def _train_step_body(loss_fn: Callable,
 
 def make_train_step_from_loss(loss_fn: Callable,
                               optimizer: Optional[
-                                  optax.GradientTransformation] = None
-                              ) -> Callable:
+                                  optax.GradientTransformation] = None,
+                              grad_accum: int = 1) -> Callable:
     """Jitted, donated ``train_step(state, tokens)`` around any
     ``loss(params, tokens)`` — used by the pp/ep/3d paths, whose losses are
     already shard_map'd (the sharding lives in the loss, not the jit)."""
-    return jax.jit(_train_step_body(loss_fn, optimizer or default_optimizer()),
+    return jax.jit(_train_step_body(loss_fn, optimizer or default_optimizer(),
+                                    grad_accum),
                    donate_argnums=(0,))
 
 
 def make_train_step(cfg: LlamaConfig,
                     optimizer: Optional[optax.GradientTransformation] = None,
-                    mesh: Optional[Mesh] = None) -> Callable:
+                    mesh: Optional[Mesh] = None,
+                    grad_accum: int = 1) -> Callable:
     """Returns jitted ``train_step(state, tokens) -> (state, metrics)``.
 
     With a mesh, input batch is sharded per batch_spec and the state layout
     is pinned via in/out_shardings (donated, so params update in place in
-    HBM)."""
+    HBM). ``grad_accum`` — see :func:`_train_step_body`."""
     optimizer = optimizer or default_optimizer()
     train_step = _train_step_body(
-        lambda params, tokens: causal_lm_loss(params, tokens, cfg), optimizer)
+        lambda params, tokens: causal_lm_loss(params, tokens, cfg), optimizer,
+        grad_accum)
 
     if mesh is None:
         return jax.jit(train_step, donate_argnums=(0,))

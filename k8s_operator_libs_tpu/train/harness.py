@@ -89,11 +89,15 @@ class CheckpointingTrainer:
                  checkpoint_interval: int = 100,
                  keep: int = 3,
                  step_fn: Optional[Callable] = None,
-                 init_fn: Optional[Callable] = None):
+                 init_fn: Optional[Callable] = None,
+                 grad_accum: int = 1):
         """``step_fn(state, batch) -> (state, metrics)`` and
         ``init_fn(rng) -> TrainState`` default to the Llama FSDP pair; pass
         both to train another model family (MoE) or parallelism (sp/pp/ep)
-        through the same checkpoint/drain machinery."""
+        through the same checkpoint/drain machinery. ``grad_accum=A``
+        splits each batch into A sequential microbatches (activation
+        memory of one, effective batch of all — parallel/fsdp.py
+        _train_step_body)."""
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
@@ -107,7 +111,8 @@ class CheckpointingTrainer:
                 # lands); only the drain-triggered save is synchronous
                 # via save(wait=True) → wait_until_finished
                 enable_async_checkpointing=True))
-        self._step_fn = step_fn or make_train_step(cfg, optimizer, mesh)
+        self._step_fn = step_fn or make_train_step(cfg, optimizer, mesh,
+                                                  grad_accum)
         self._init_fn = init_fn or (
             lambda rng: init_train_state(rng, self.cfg, self.optimizer,
                                          self.mesh))

@@ -534,3 +534,49 @@ def test_shard_params_places_per_specs(rng):
     # really distributed: the fsdp dim is split 8 ways
     assert (sharded["blocks"]["wq"].addressable_shards[0].data.shape[1]
             == params["blocks"]["wq"].shape[1] // 8)
+
+
+def test_grad_accum_matches_full_batch(rng):
+    """grad_accum=A must produce the same update as the one-pass step on
+    the same total batch: equal-sized microbatches make the accumulated
+    mean exact, and fp32 accumulation keeps it so."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.parallel.fsdp import (init_train_state,
+                                                     make_train_step)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    opt = optax.sgd(1e-2)
+    s_one = init_train_state(rng, cfg, opt)
+    s_acc = init_train_state(rng, cfg, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (4, 33), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    step_one = make_train_step(cfg, optimizer=opt)
+    step_acc = make_train_step(cfg, optimizer=opt, grad_accum=2)
+    s_one, m_one = step_one(s_one, tokens)
+    s_acc, m_acc = step_acc(s_acc, tokens)
+    assert abs(float(m_one["loss"]) - float(m_acc["loss"])) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(s_one.params),
+                    jax.tree_util.tree_leaves(s_acc.params)):
+        assert jnp.allclose(a, b, atol=1e-6), "accumulated update diverged"
+    assert int(s_acc.step) == 1   # one optimizer step, not A
+
+
+def test_grad_accum_rejects_ragged_batch(rng):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.parallel.fsdp import (init_train_state,
+                                                     make_train_step)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    opt = optax.sgd(1e-2)
+    state = init_train_state(rng, cfg, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (3, 33), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_train_step(cfg, optimizer=opt, grad_accum=2)(state, tokens)

@@ -358,3 +358,25 @@ def test_state_diagram_svg_is_current(tmp_path):
     assert svg.read_text() == fresh.read_text(), (
         "docs/images/driver-upgrade-state-diagram.svg is stale; run "
         "python tools/gen_state_diagram.py and commit the result")
+
+
+def test_e712_true_false_comparison(tmp_path):
+    assert codes(run_lint(tmp_path, "x = 1\ny = x == True\n")) == ["E712"]
+    assert codes(run_lint(tmp_path, "x = 1\ny = False != x\n")) == ["E712"]
+    # comparing to other constants is fine; `is True` is fine
+    assert codes(run_lint(tmp_path, "x = 1\ny = x == 1\nz = x is True\n")) \
+        == []
+
+
+def test_f632_is_with_literal(tmp_path):
+    assert codes(run_lint(tmp_path, "x = 'a'\ny = x is 'a'\n")) == ["F632"]
+    assert codes(run_lint(tmp_path, "x = 3\ny = x is not 3\n")) == ["F632"]
+    # is None / is True are the idiomatic uses — silent
+    assert codes(run_lint(tmp_path,
+                          "x = None\ny = x is None\nz = x is True\n")) == []
+
+
+def test_f632_is_with_tuple_display(tmp_path):
+    # tuple displays parse as ast.Tuple, not ast.Constant
+    assert codes(run_lint(tmp_path, "x = (1, 2)\ny = x is (1, 2)\n")) \
+        == ["F632"]

@@ -221,3 +221,58 @@ def test_step_rejects_bad_chunk():
     import pytest
     with pytest.raises(ValueError, match="n >= 1"):
         srv.step(0)
+
+
+def test_shared_prefix_matches_solo_and_shares_blocks():
+    """A shared system prefix is prefilled ONCE into pool blocks every
+    slot references read-only; each request's output must equal a solo
+    decode of prefix+prompt. Prefix 19 with block_size 8 exercises both
+    the whole-block sharing (2 blocks) and the sub-block remainder (3
+    tokens riding each request's own prefill). Three requests on two
+    slots force retirement + slot recycling OVER the shared blocks."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    rng = np.random.default_rng(31)
+    prefix = rng.integers(0, CFG.vocab_size, size=19).astype(np.int32)
+    srv = ContinuousBatcher(params, CFG, max_slots=2,
+                            capacity_per_slot=48, block_size=8,
+                            shared_prefix=prefix)
+    assert srv._prefix_blocks == 2 and len(srv._prefix_rem) == 3
+    prompts = [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 7)]
+    news = [6, 4, 5]
+    rids = [srv.submit(p, n) for p, n in zip(prompts, news)]
+    done = {}
+    ticks = 0
+    while not srv.idle:
+        srv.step(3)
+        done.update(srv.poll())
+        ticks += 1
+        assert ticks < 60
+    done.update(srv.poll())
+
+    for rid, p, n in zip(rids, prompts, news):
+        full = np.concatenate([prefix, p])
+        solo = _solo(params, full, n)
+        np.testing.assert_array_equal(
+            done[rid][len(p):], solo[len(full):],
+            err_msg=f"request {rid} diverged from prefix+prompt solo")
+        np.testing.assert_array_equal(done[rid][:len(p)], p)
+
+    # shared blocks were never freed into the private pool
+    assert all(b >= srv._prefix_blocks for b in srv._free_blocks)
+    assert len(srv._free_blocks) == 2 * (48 // 8)
+    # and every slot's row still references the shared blocks
+    assert (srv._table[:, :2] == np.arange(2)[None, :]).all()
+
+
+def test_shared_prefix_capacity_accounts_for_remainder():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prefix = np.zeros((19,), np.int32)   # remainder 3 with block_size 8
+    srv = ContinuousBatcher(params, CFG, max_slots=1,
+                            capacity_per_slot=16, block_size=8,
+                            shared_prefix=prefix)
+    import pytest
+    # 11 + 3 remainder + 3 new = 17 > 16
+    with pytest.raises(ValueError, match="remainder"):
+        srv.submit(np.zeros(11, np.int32), 3)
+    srv.submit(np.zeros(10, np.int32), 3)   # 16 exactly: fits

@@ -23,6 +23,8 @@ of that surface:
   E722  bare `except:`
   F541  f-string without any placeholders
   F601  `== None` / `!= None` comparison (use `is`)
+  E712  `== True` / `!= False` comparison (use the value or `is`)
+  F632  `is` / `is not` comparison against a str/number/tuple literal
   F631  assert on a non-empty tuple literal (always true)
   F602  duplicate literal key in a dict display
   W605  invalid escape sequence in a plain (non-raw) string literal
@@ -569,6 +571,26 @@ class Checker(ast.NodeVisitor):
                         for side in (operands[i], operands[i + 1])):
                     self.report(node.lineno, "F601",
                                 "comparison to None with ==/!= (use is)")
+                if isinstance(op, (ast.Eq, ast.NotEq)) and any(
+                        isinstance(side, ast.Constant)
+                        and isinstance(side.value, bool)
+                        for side in (operands[i], operands[i + 1])):
+                    self.report(node.lineno, "E712",
+                                "comparison to True/False with ==/!= "
+                                "(use the value or `is`)")
+                if isinstance(op, (ast.Is, ast.IsNot)) and any(
+                        # tuple DISPLAYS parse as ast.Tuple (an
+                        # ast.Constant tuple only arises from constant
+                        # folding) — match both
+                        isinstance(side, ast.Tuple)
+                        or (isinstance(side, ast.Constant)
+                            and isinstance(side.value, (str, int, float,
+                                                        bytes, tuple))
+                            and not isinstance(side.value, bool))
+                        for side in (operands[i], operands[i + 1])):
+                    self.report(node.lineno, "F632",
+                                "is/is not comparison with a literal "
+                                "(use ==/!=)")
         if isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple) \
                 and node.test.elts:
             self.report(node.lineno, "F631",
