@@ -153,19 +153,55 @@ def _forward_cached(params: Params, tokens: jax.Array, cache: KVCache,
     return logits, new_cache
 
 
+def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Top-k / nucleus (top-p) filtering on [B, V] logits, static-shaped
+    for jit: masked-out entries become -inf, so a downstream categorical
+    renormalizes over the survivors. top_k keeps the k highest logits;
+    top_p keeps the smallest prefix of the descending-probability order
+    whose cumulative mass reaches p (the first token is always kept).
+    Both may combine (k-filter first, then p over the survivors)."""
+    if top_k is None and top_p is None:
+        return logits
+    # ONE descending sort serves both filters — this runs on every token
+    # of the jitted decode scan, and a second O(V log V) pass for the
+    # combined case would double the hot path's sort cost
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k is not None:
+        kth = desc[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+        desc = jnp.where(jnp.arange(desc.shape[-1]) < top_k, desc,
+                         -jnp.inf)
+    if top_p is not None:
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep a token iff the mass BEFORE it is < p (so the boundary
+        # token completing the nucleus is included)
+        keep = (cum - probs) < top_p
+        thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 def scan_decode(forward_fn, params: Params, prompt: jax.Array, cache,
                 last_logits: jax.Array, max_new_tokens: int,
-                temperature: float, rng: jax.Array) -> jax.Array:
+                temperature: float, rng: jax.Array,
+                top_k: Optional[int] = None,
+                top_p: Optional[float] = None) -> jax.Array:
     """THE decode tail every cache layout shares: sample the first token
     from the prefill's last logits, then a ``lax.scan`` of single-token
     ``forward_fn(params, tok[:, None], cache) -> (logits, cache)`` steps.
     Single-device, tensor-parallel, paged, int8 and MoE decoding all call
-    this — the sampling/rng protocol lives in exactly one place."""
+    this — the sampling/rng protocol lives in exactly one place.
+    Sampling order (the HF convention): temperature scales the logits,
+    then top_k/top_p filter, then categorical."""
     def sample(logits_last, key):
         if temperature == 0.0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits_last / temperature, axis=-1).astype(jnp.int32)
+        scaled = filter_logits(logits_last / temperature, top_k, top_p)
+        return jax.random.categorical(key, scaled,
+                                      axis=-1).astype(jnp.int32)
 
     # split BEFORE the first sample — reusing rng as both a sampling key and
     # the split root correlates the first token with later draws
@@ -186,28 +222,35 @@ def scan_decode(forward_fn, params: Params, prompt: jax.Array, cache,
 
 def _decode_loop(params: Params, prompt: jax.Array, cache: KVCache,
                  cfg: LlamaConfig, max_new_tokens: int, temperature: float,
-                 rng: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+                 rng: jax.Array, tp_axis: Optional[str] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jax.Array:
     """Prefill + :func:`scan_decode` for the contiguous cache (single-device
     and tensor-parallel — only the cache layout and tp_axis psums differ)."""
     logits, cache = _forward_cached(params, prompt, cache, cfg, tp_axis)
     fwd = partial(_forward_cached, cfg=cfg, tp_axis=tp_axis)
     return scan_decode(fwd, params, prompt, cache, logits[:, -1],
-                       max_new_tokens, temperature, rng)
+                       max_new_tokens, temperature, rng,
+                       top_k=top_k, top_p=top_p)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k", "top_p"))
 def generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
              max_new_tokens: int = 32, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jax.Array:
-    """Greedy (temperature=0) or sampled decoding. prompt: [B, Tp] int32 →
-    [B, Tp + max_new_tokens]. One prefill pass + scanned single-token decode
-    steps, all inside one jit."""
+             rng: Optional[jax.Array] = None,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled decoding, with optional top-k /
+    nucleus filtering (:func:`filter_logits`). prompt: [B, Tp] int32 →
+    [B, Tp + max_new_tokens]. One prefill pass + scanned single-token
+    decode steps, all inside one jit."""
     B, Tp = prompt.shape
     cache = init_cache(cfg, B, Tp + max_new_tokens)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _decode_loop(params, prompt, cache, cfg, max_new_tokens,
-                        temperature, rng)
+                        temperature, rng, top_k=top_k, top_p=top_p)
 
 
 def tp_generate_param_specs():

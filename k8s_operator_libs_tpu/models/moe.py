@@ -291,10 +291,26 @@ def _forward_cached_moe(params: Params, tokens: jax.Array, cache,
         ffn=lambda h2, layer: moe_ffn(h2, layer, cfg)[0])
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+def moe_paged_forward(params: Params, tokens: jax.Array, cache,
+                      cfg: MoEConfig):
+    """Paged-cache MoE forward: paged._forward_paged with the routed
+    expert FFN hooked in (the paged twin of :func:`_forward_cached_moe`).
+    This is the ``forward=`` hook that puts the MoE family on the
+    continuous-batching server — slots, buckets, chunks, drain/handoff
+    all reused unchanged."""
+    from .paged import _forward_paged
+    return _forward_paged(
+        params, tokens, cache, cfg,
+        ffn=lambda h2, layer: moe_ffn(h2, layer, cfg)[0])
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k", "top_p"))
 def moe_generate(params: Params, prompt: jax.Array, cfg: MoEConfig,
                  max_new_tokens: int = 32, temperature: float = 0.0,
-                 rng: Optional[jax.Array] = None) -> jax.Array:
+                 rng: Optional[jax.Array] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jax.Array:
     """Greedy/sampled KV-cached decoding for the MoE family — the same
     loop and rng protocol as generate.generate (prefill + the shared
     scan_decode tail, one jit)."""
@@ -307,4 +323,4 @@ def moe_generate(params: Params, prompt: jax.Array, cfg: MoEConfig,
     logits, cache = _forward_cached_moe(params, prompt, cache, cfg)
     return scan_decode(partial(_forward_cached_moe, cfg=cfg), params,
                        prompt, cache, logits[:, -1], max_new_tokens,
-                       temperature, rng)
+                       temperature, rng, top_k=top_k, top_p=top_p)

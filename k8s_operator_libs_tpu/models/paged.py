@@ -292,10 +292,17 @@ def _attend_paged(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
 
 
 def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
-                   cfg: LlamaConfig) -> Tuple[jax.Array, PagedKVCache]:
+                   cfg: LlamaConfig, matmul=None, ffn=None,
+                   lm_head_fn=None) -> Tuple[jax.Array, PagedKVCache]:
     """Forward [B, T] starting at per-seq cache.lengths; appends K/V into
     the block pool. Mirrors generate._forward_cached (llama scan layout)
-    with the paged write/read in place of dynamic_update_slice."""
+    with the paged write/read in place of dynamic_update_slice — and the
+    SAME three hooks, so every paged decode variant shares this one
+    cache/attention implementation: ``matmul`` (int8 dequant-fused
+    product), ``ffn`` (MoE routed experts), ``lm_head_fn``. Head counts
+    derive from product shapes so hooked weights (quant dicts) work."""
+    mm = matmul or (lambda x, layer, name: x @ layer[name])
+    lm = lm_head_fn or (lambda x, p: x @ p["lm_head"])
     B, T = tokens.shape
     Dh = cfg.head_dim
     pos = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -304,12 +311,14 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
     def body(carry, layer_in):
         x, = carry
         layer, k_pool_l, v_pool_l = layer_in
-        H = layer["wq"].shape[-1] // Dh
-        KV = layer["wk"].shape[-1] // Dh
         h = rms_norm(x, layer["attn_norm"])
-        q = (h @ layer["wq"]).reshape(B, T, H, Dh)
-        k = (h @ layer["wk"]).reshape(B, T, KV, Dh)
-        v = (h @ layer["wv"]).reshape(B, T, KV, Dh)
+        q = mm(h, layer, "wq")
+        H = q.shape[-1] // Dh
+        q = q.reshape(B, T, H, Dh)
+        k = mm(h, layer, "wk")
+        KV = k.shape[-1] // Dh
+        k = k.reshape(B, T, KV, Dh)
+        v = mm(h, layer, "wv").reshape(B, T, KV, Dh)
         q = rope(q, pos, cfg.rope_theta)
         k = rope(k, pos, cfg.rope_theta)
         k_pool_l = _paged_write(k_pool_l, cache.table, cache.lengths, k)
@@ -331,17 +340,20 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
             # prefill / CPU: gather view + masked reference attention
             attn = _attend_paged(cfg, q, _paged_view(k_pool_l, cache.table),
                                  _paged_view(v_pool_l, cache.table), pos)
-        x = x + attn.reshape(B, T, H * Dh) @ layer["wo"]
+        x = x + mm(attn.reshape(B, T, H * Dh), layer, "wo")
         h2 = rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu((h2 @ layer["w_gate"]).astype(jnp.float32)
-                           ).astype(h2.dtype)
-        x = x + (gate * (h2 @ layer["w_up"])) @ layer["w_down"]
+        if ffn is not None:
+            x = x + ffn(h2, layer)
+        else:
+            gate = jax.nn.silu((mm(h2, layer, "w_gate")
+                                ).astype(jnp.float32)).astype(h2.dtype)
+            x = x + mm(gate * mm(h2, layer, "w_up"), layer, "w_down")
         return (x,), (k_pool_l, v_pool_l)
 
     (x,), (new_k, new_v) = jax.lax.scan(
         body, (x,), (params["blocks"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = lm(x, params).astype(jnp.float32)
     new_cache = PagedKVCache(k=new_k, v=new_v, table=cache.table,
                              lengths=cache.lengths + T)
     return logits, new_cache
@@ -349,12 +361,14 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
 
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "temperature",
-                          "block_size"))
+                          "block_size", "top_k", "top_p"))
 def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
                    max_new_tokens: int = 32, temperature: float = 0.0,
                    rng: Optional[jax.Array] = None,
                    prompt_lengths: Optional[jax.Array] = None,
-                   block_size: int = DEFAULT_BLOCK_SIZE) -> jax.Array:
+                   block_size: int = DEFAULT_BLOCK_SIZE,
+                   top_k: Optional[int] = None,
+                   top_p: Optional[float] = None) -> jax.Array:
     """Greedy/sampled decode over the paged cache. prompt [B, Tp] int32
     (right-padded when ragged; pass ``prompt_lengths`` [B] so each
     sequence decodes from its own offset) → [B, Tp + max_new_tokens].
@@ -382,4 +396,5 @@ def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
                          lengths=prompt_lengths)
     from .generate import scan_decode
     return scan_decode(partial(_forward_paged, cfg=cfg), params, prompt,
-                       cache, last_logits, max_new_tokens, temperature, rng)
+                       cache, last_logits, max_new_tokens, temperature, rng,
+                       top_k=top_k, top_p=top_p)

@@ -114,10 +114,13 @@ def _forward_quant(params: Params, tokens: jax.Array, cache: KVCache,
         lm_head_fn=lambda x, p: _qmat(x, p["lm_head"]))
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature"))
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k", "top_p"))
 def quantized_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
                        max_new_tokens: int = 32, temperature: float = 0.0,
-                       rng: Optional[jax.Array] = None) -> jax.Array:
+                       rng: Optional[jax.Array] = None,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None) -> jax.Array:
     """Greedy/sampled decode over int8 weights (quantize_params tree).
     Same loop/rng protocol as generate.generate."""
     from .generate import scan_decode
@@ -128,4 +131,4 @@ def quantized_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
     logits, cache = _forward_quant(params, prompt, cache, cfg)
     return scan_decode(partial(_forward_quant, cfg=cfg), params, prompt,
                        cache, logits[:, -1], max_new_tokens, temperature,
-                       rng)
+                       rng, top_k=top_k, top_p=top_p)

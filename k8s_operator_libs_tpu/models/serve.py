@@ -82,8 +82,14 @@ class ContinuousBatcher:
     def __init__(self, params: Params, cfg: LlamaConfig, max_slots: int = 8,
                  capacity_per_slot: int = 512,
                  block_size: int = DEFAULT_BLOCK_SIZE,
-                 shared_prefix=None):
-        """``shared_prefix`` (int32 tokens) is a system prompt every
+                 shared_prefix=None, forward=None):
+        """``forward`` overrides the paged forward pass — signature
+        ``(params, tokens, cache, cfg) -> (logits, cache)``, default
+        :func:`~.paged._forward_paged`. The MoE family rides this hook
+        (:func:`~.moe.moe_paged_forward`), reusing the whole batcher —
+        slots, buckets, chunks, drain/handoff — unchanged.
+
+        ``shared_prefix`` (int32 tokens) is a system prompt every
         request shares: its KV is computed ONCE at construction into
         dedicated pool blocks that every slot's table row references
         read-only — the paged layout's structural win (vLLM prefix
@@ -97,6 +103,7 @@ class ContinuousBatcher:
         (remainder + prompt + generation)."""
         self.params = params
         self.cfg = cfg
+        self._forward = forward or _forward_paged
         self.max_slots = max_slots
         self.block_size = block_size
         self.blocks_per_slot = -(-capacity_per_slot // block_size)
@@ -151,14 +158,14 @@ class ContinuousBatcher:
         """One forward over the aligned prefix writes its K/V into the
         shared blocks; logits are discarded (the first request token's
         context is re-evaluated by that request's own prefill)."""
-        cfg = self.cfg
+        cfg, fwd = self.cfg, self._forward
         table = jnp.arange(self._prefix_blocks, dtype=jnp.int32)[None]
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefix_fill(params, k, v, prompt):
             cache = PagedKVCache(k=k, v=v, table=table,
                                  lengths=jnp.zeros((1,), jnp.int32))
-            _, cache = _forward_paged(params, prompt[None], cache, cfg)
+            _, cache = fwd(params, prompt[None], cache, cfg)
             return cache.k, cache.v
 
         self._k, self._v = prefix_fill(self.params, self._k, self._v,
@@ -176,15 +183,14 @@ class ContinuousBatcher:
         :meth:`step`)."""
         if n in self._decode_cache:
             return self._decode_cache[n]
-        cfg = self.cfg
+        cfg, fwd = self.cfg, self._forward
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode(params, k, v, table, lengths, toks):
             def body(carry, _):
                 k, v, lengths, toks = carry
                 cache = PagedKVCache(k=k, v=v, table=table, lengths=lengths)
-                logits, cache = _forward_paged(params, toks[:, None], cache,
-                                               cfg)
+                logits, cache = fwd(params, toks[:, None], cache, cfg)
                 nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 return (cache.k, cache.v, cache.lengths, nxt), nxt
 
@@ -197,7 +203,7 @@ class ContinuousBatcher:
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_cache:
-            cfg = self.cfg
+            cfg, fwd = self.cfg, self._forward
 
             @partial(jax.jit, donate_argnums=(1, 2))
             def prefill(params, k, v, table, prompt, length, start):
@@ -207,8 +213,7 @@ class ContinuousBatcher:
                 # token (the aligned shared-prefix length, 0 without one)
                 cache = PagedKVCache(k=k, v=v, table=table[None],
                                      lengths=start[None])
-                logits, cache = _forward_paged(params, prompt[None], cache,
-                                               cfg)
+                logits, cache = fwd(params, prompt[None], cache, cfg)
                 last = jnp.take_along_axis(
                     logits, (length - 1)[None, None, None], axis=1)[0, 0]
                 nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)

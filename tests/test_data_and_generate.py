@@ -457,3 +457,58 @@ def test_speculative_generate_token_exact():
     out = speculative_generate(tparams, qdraft, prompt, tcfg, tcfg,
                                max_new_tokens=11, k=3, draft_forward=qfwd)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_filter_logits_top_k_and_top_p():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import filter_logits
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    k2 = np.asarray(filter_logits(logits, top_k=2))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+    # nucleus 0.7: 0.5 alone misses it, 0.5+0.25 reaches it -> keep 2
+    p = np.asarray(filter_logits(logits, top_p=0.7))
+    assert np.isfinite(p[0, :2]).all() and np.isinf(p[0, 2:]).all()
+    # the top token is always kept even when p is tiny
+    tiny = np.asarray(filter_logits(logits, top_p=1e-6))
+    assert np.isfinite(tiny[0, 0]) and np.isinf(tiny[0, 1:]).all()
+    # combined: k filters first, p over the survivors
+    kp = np.asarray(filter_logits(logits, top_k=3, top_p=0.99))
+    assert np.isinf(kp[0, 3])
+
+
+def test_top_k1_sampling_equals_greedy():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    greedy = generate(params, prompt, cfg, max_new_tokens=6)
+    k1 = generate(params, prompt, cfg, max_new_tokens=6, temperature=0.7,
+                  top_k=1, rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_top_p1_equals_plain_sampling():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(3)
+    plain = generate(params, prompt, cfg, max_new_tokens=6,
+                     temperature=1.0, rng=rng)
+    p1 = generate(params, prompt, cfg, max_new_tokens=6, temperature=1.0,
+                  top_p=1.0, rng=rng)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(p1))

@@ -276,3 +276,37 @@ def test_shared_prefix_capacity_accounts_for_remainder():
     with pytest.raises(ValueError, match="remainder"):
         srv.submit(np.zeros(11, np.int32), 3)
     srv.submit(np.zeros(10, np.int32), 3)   # 16 exactly: fits
+
+
+def test_moe_on_continuous_batcher_matches_solo():
+    """The forward= hook puts the MoE family on the same batcher: every
+    request's tokens must equal its solo moe_generate decode (routing,
+    slots, block recycling and chunking all composed)."""
+    from k8s_operator_libs_tpu.models.moe import MoEConfig
+    from k8s_operator_libs_tpu.models.moe import init_params as moe_init
+    from k8s_operator_libs_tpu.models.moe import (moe_generate,
+                                                  moe_paged_forward)
+    mcfg = MoEConfig.tiny(dtype=jnp.float32)
+    mparams = moe_init(jax.random.PRNGKey(2), mcfg)
+    srv = ContinuousBatcher(mparams, mcfg, max_slots=2,
+                            capacity_per_slot=48, block_size=8,
+                            forward=moe_paged_forward)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, mcfg.vocab_size, size=n).astype(np.int32)
+               for n in (5, 8, 6)]
+    news = [5, 4, 6]
+    rids = [srv.submit(p, n) for p, n in zip(prompts, news)]
+    done = {}
+    ticks = 0
+    while not srv.idle:
+        srv.step(3)
+        done.update(srv.poll())
+        ticks += 1
+        assert ticks < 60
+    done.update(srv.poll())
+    for rid, p, n in zip(rids, prompts, news):
+        solo = np.asarray(moe_generate(mparams, jnp.asarray(p[None]), mcfg,
+                                       max_new_tokens=n))[0]
+        np.testing.assert_array_equal(
+            done[rid], solo,
+            err_msg=f"MoE request {rid} diverged from its solo decode")
