@@ -38,11 +38,25 @@ TPU-first mechanics:
   reduces the speedup as B grows; speculative decoding is a LATENCY
   (small-B) optimization everywhere, and B=1 is its canonical setting.
 
-Only temperature 0 is supported: the sampled variant needs the
-rejection-sampling accept ratio and residual-distribution draws, whose
-output is distribution-equal but not token-equal — a different (harder
-to test) contract. The reference repo has no serving stack at all; this
-module is part of the TPU-native framework half.
+Temperature 0 is the token-identical contract above. ``temperature > 0``
+(r5) runs the FULL rejection-sampling scheme of Leviathan et al.: the
+draft SAMPLES its proposals from p_d, the target accepts token x with
+probability min(1, p_t(x)/p_d(x)), and a rejection at position i draws
+the replacement from the normalized residual max(p_t − p_d, 0) — which
+makes the output stream distribution-EQUAL to sampling the target
+alone. That contract is statistical, not token-wise, so the tests pin
+it statistically (per-position marginals of 1024 independent sequences
+vs vanilla sampling, with temperature + top_k + top_p composed) plus
+structurally (temperature-0 reduction, acceptance bookkeeping). Batching note: rounds are still synchronized
+to the batch-minimum acceptance, but the emitted token at the sync
+point is PER-SEQUENCE (its accepted draft token where its own test
+passed, its residual draw where it failed) — emitting a batch-wide
+correction would silently break each sequence's distribution; only the
+greedy variant gets that for free (the correction equals the accepted
+token there). top_k/top_p compose: the filter applies to BOTH
+distributions, and the equality contract then holds against
+filtered-target sampling. The reference repo has no serving stack at
+all; this module is part of the TPU-native framework half.
 """
 
 from __future__ import annotations
@@ -53,23 +67,29 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from .generate import KVCache, _forward_cached, init_cache
+from .generate import KVCache, _forward_cached, filter_logits, init_cache
 from .llama import LlamaConfig
 
 Params = Dict[str, Any]
 
 
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg",
-                                   "max_new_tokens", "k", "draft_forward"))
+                                   "max_new_tokens", "k", "draft_forward",
+                                   "temperature", "top_k", "top_p"))
 def speculative_generate(target_params: Params, draft_params: Params,
                          prompt: jax.Array, target_cfg: LlamaConfig,
                          draft_cfg: LlamaConfig,
                          max_new_tokens: int = 32, k: int = 4,
-                         draft_forward=None) -> jax.Array:
-    """Greedy decode of the TARGET model, accelerated by a draft model.
-    prompt [B, Tp] int32 → [B, Tp + max_new_tokens], token-identical to
-    ``generate(target_params, prompt, target_cfg, max_new_tokens)``
-    (see the precision caveat in the module docstring).
+                         draft_forward=None, temperature: float = 0.0,
+                         top_k=None, top_p=None,
+                         rng: jax.Array = None) -> jax.Array:
+    """Decode of the TARGET model, accelerated by a draft model. prompt
+    [B, Tp] int32 → [B, Tp + max_new_tokens]. At ``temperature == 0``
+    the output is token-identical to
+    ``generate(target_params, prompt, target_cfg, max_new_tokens)`` (see
+    the precision caveat in the module docstring); at ``temperature >
+    0`` it is distribution-equal to target-only sampling via the
+    rejection-sampling accept/residual scheme (module docstring).
 
     ``k`` is the speculation depth: each round costs k draft steps + one
     (k+1)-token target verify, and emits 1..k+1 confirmed tokens.
@@ -80,41 +100,62 @@ def speculative_generate(target_params: Params, draft_params: Params,
     the target's own weights in int8 propose tokens at roughly half the
     weight traffic with near-1 acceptance, no second model needed."""
     d_fwd = draft_forward or _forward_cached
+    sampled = temperature != 0.0
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     B, Tp = prompt.shape
     cap = Tp + max_new_tokens + k + 1   # rounds may overhang; trimmed below
     t_cache = init_cache(target_cfg, B, cap)
     d_cache = init_cache(draft_cfg, B, cap)
 
-    # prefill both models; token #1 is the target's greedy pick
+    def dist(logits):
+        """Filtered sampling distribution [B, V] (sampled mode only)."""
+        return jax.nn.softmax(
+            filter_logits(logits / temperature, top_k, top_p), axis=-1)
+
+    # prefill both models; token #1 is the target's own pick
     t_logits, t_cache = _forward_cached(target_params, prompt, t_cache,
                                         target_cfg)
     _, d_cache = d_fwd(draft_params, prompt, d_cache, draft_cfg)
-    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+    rng, k_first = jax.random.split(rng)
+    if sampled:
+        first = jax.random.categorical(
+            k_first, jnp.log(dist(t_logits[:, -1]) + 1e-30),
+            axis=-1).astype(jnp.int32)
+    else:
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
     out = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
     out = out.at[:, 0].set(first)
 
     def round_body(carry):
-        t_cache, d_cache, last, out, n = carry
+        t_cache, d_cache, last, out, n, rng = carry
+        rng, k_draft, k_acc, k_corr = jax.random.split(rng, 4)
 
-        # ---- draft proposes k tokens autoregressively (cheap steps)
-        def draft_step(dc, tok):
-            logits, dc = d_fwd(draft_params, tok[:, None], dc, draft_cfg)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return dc, nxt
-
-        def draft_scan(carry, _):
+        # ---- draft proposes k tokens autoregressively (cheap steps);
+        # sampled mode PROPOSES from p_d (the accept ratio needs the
+        # proposal to really come from the draft's distribution) and
+        # keeps each step's full distribution for the residual math
+        def draft_scan(carry, key):
             dc, tok = carry
-            dc, nxt = draft_step(dc, tok)
-            return (dc, nxt), nxt
+            logits, dc = d_fwd(draft_params, tok[:, None], dc, draft_cfg)
+            logits = logits[:, -1]
+            if sampled:
+                p = dist(logits)
+                nxt = jax.random.categorical(
+                    key, jnp.log(p + 1e-30), axis=-1).astype(jnp.int32)
+            else:
+                p = logits  # unused in greedy mode
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (dc, nxt), (nxt, p)
 
         # k+1 steps: the extra step's PROPOSAL is discarded, but its
         # feed writes d_k's cache row — without it a full-accept round
         # leaves a zero row inside the draft's valid prefix and quietly
         # degrades later acceptance (output stays exact either way; the
         # target's correction is always authoritative)
-        (d_cache, _), proposals = jax.lax.scan(
-            draft_scan, (d_cache, last), None, length=k + 1)
+        (d_cache, _), (proposals, d_dists) = jax.lax.scan(
+            draft_scan, (d_cache, last), jax.random.split(k_draft, k + 1))
         drafts = jnp.moveaxis(proposals, 0, 1)[:, :k]  # [B, k]
 
         # ---- target verifies the whole window in ONE forward
@@ -122,24 +163,58 @@ def speculative_generate(target_params: Params, draft_params: Params,
         t_len0 = t_cache.length
         v_logits, t_cache = _forward_cached(target_params, window, t_cache,
                                             target_cfg)
-        greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)   # [B,k+1]
-        # greedy[:, i] is the target's pick AFTER window[:, :i+1] — the
-        # draft token drafts[:, i] is accepted iff it matches greedy[:, i]
-        match = drafts == greedy[:, :k]                            # [B, k]
+        idx = jnp.arange(k + 1, dtype=jnp.int32)
+        if sampled:
+            # accept x_i with prob min(1, p_t(x_i)/p_d(x_i))
+            t_probs = dist(v_logits.reshape(B * (k + 1), -1)).reshape(
+                B, k + 1, -1)                                     # [B,k+1,V]
+            d_probs = jnp.moveaxis(d_dists, 0, 1)[:, :k]          # [B,k,V]
+            p_t_at = jnp.take_along_axis(t_probs[:, :k], drafts[..., None],
+                                         axis=-1)[..., 0]          # [B,k]
+            p_d_at = jnp.take_along_axis(d_probs, drafts[..., None],
+                                         axis=-1)[..., 0]
+            u = jax.random.uniform(k_acc, p_t_at.shape)
+            match = u * p_d_at < p_t_at                            # [B,k]
+        else:
+            greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+            # greedy[:, i] is the target's pick AFTER window[:, :i+1]
+            match = drafts == greedy[:, :k]                        # [B,k]
         acc_per_seq = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
                                           axis=1), axis=1)         # [B]
         a = jnp.min(acc_per_seq)        # batch-synchronized acceptance
         a = jnp.minimum(a, jnp.int32(k))
 
-        # emitted this round: drafts[:, :a] then the correction
-        # greedy[:, a] — build the fixed k+1 slab; slots past a are
-        # provisional and get overwritten by later rounds
-        idx = jnp.arange(k + 1, dtype=jnp.int32)
-        slab = jnp.where(idx[None, :] < a,
-                         jnp.pad(drafts, ((0, 0), (0, 1))),
-                         jnp.take_along_axis(
-                             greedy, jnp.broadcast_to(a, (B, 1)),
-                             axis=1))                              # [B,k+1]
+        if sampled:
+            # the token at the sync point is PER-SEQUENCE: the accepted
+            # draft where this sequence's own test passed at position a,
+            # else a draw from the residual max(p_t − p_d, 0). Padding
+            # d_probs with zeros at position k unifies the full-accept
+            # bonus draw (residual = p_t there); padding match with
+            # False makes the bonus draw unconditional.
+            d_pad = jnp.concatenate(
+                [d_probs, jnp.zeros_like(t_probs[:, :1])], axis=1)
+            t_a = jax.lax.dynamic_index_in_dim(t_probs, a, 1, False)
+            d_a = jax.lax.dynamic_index_in_dim(d_pad, a, 1, False)
+            r = jnp.maximum(t_a - d_a, 0.0)
+            # p_t == p_d exactly → empty residual; fall back to p_t
+            r = jnp.where(jnp.sum(r, -1, keepdims=True) > 0, r, t_a)
+            res_draw = jax.random.categorical(
+                k_corr, jnp.log(r + 1e-30), axis=-1).astype(jnp.int32)
+            drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+            draft_a = jax.lax.dynamic_index_in_dim(drafts_pad, a, 1, False)
+            match_pad = jnp.pad(match, ((0, 0), (0, 1)))
+            accept_a = jax.lax.dynamic_index_in_dim(match_pad, a, 1, False)
+            corr = jnp.where(accept_a, draft_a, res_draw)          # [B]
+            slab = jnp.where(idx[None, :] < a, drafts_pad, corr[:, None])
+        else:
+            # emitted this round: drafts[:, :a] then the correction
+            # greedy[:, a] (for sequences that matched at a the two are
+            # equal, so a batch-wide correction is safe in greedy mode)
+            slab = jnp.where(idx[None, :] < a,
+                             jnp.pad(drafts, ((0, 0), (0, 1))),
+                             jnp.take_along_axis(
+                                 greedy, jnp.broadcast_to(a, (B, 1)),
+                                 axis=1))                          # [B,k+1]
         out = jax.lax.dynamic_update_slice(out, slab, (0, n))
 
         # rewind: confirmed rows = old length + last token + a accepted
@@ -148,13 +223,13 @@ def speculative_generate(target_params: Params, draft_params: Params,
         d_cache = KVCache(k=d_cache.k, v=d_cache.v, length=new_len)
         last_new = jnp.where(idx[None, :] == a, slab, 0).sum(axis=1)
         return (t_cache, d_cache, last_new.astype(jnp.int32), out,
-                n + 1 + a)
+                n + 1 + a, rng)
 
     def cond(carry):
-        return carry[-1] < max_new_tokens
+        return carry[4] < max_new_tokens
 
-    init = (t_cache, d_cache, first, out, jnp.int32(1))
-    _, _, _, out, _ = jax.lax.while_loop(cond, round_body, init)
+    init = (t_cache, d_cache, first, out, jnp.int32(1), rng)
+    _, _, _, out, _, _ = jax.lax.while_loop(cond, round_body, init)
     return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
 
 
