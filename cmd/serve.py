@@ -1,0 +1,281 @@
+#!/usr/bin/env python3
+"""tpu-serve binary: a deployable inference server over the continuous
+batcher (models/serve.py) — the serving-side peer of cmd/operator.py.
+
+The operator's rolling-upgrade contract needs a server process that
+understands DRAIN: when the node is cordoned for a libtpu upgrade, the
+pod gets SIGTERM and must finish in-flight requests, surface its
+untouched queue for a peer replica, and exit cleanly inside the grace
+period (the inference mirror of the training harness's drain-triggered
+checkpoint; tests/test_serve_upgrade_e2e.py proves the library side,
+this binary packages it).
+
+HTTP surface (stdlib ThreadingHTTPServer, JSON):
+
+- ``POST /generate``  {"tokens": [int...], "max_new": N}
+  → blocks until the request completes: {"tokens": [prompt+generated]}.
+  Returns 503 once draining (clients reroute to a peer).
+- ``POST /drain``     → stop admission, return {"handoff": [[rid,
+  [tokens...], max_new], ...]} — the queue a peer replica adopts.
+  In-flight requests still finish and their /generate calls return.
+- ``GET  /healthz``   → 200 "ok", or 503 once draining (flips the
+  readiness probe so the Service stops routing here).
+
+One background stepper thread owns the batcher (submit/poll are guarded
+by a lock — the batcher itself is deliberately single-threaded);
+``--chunk N`` runs N decode ticks per device call (serve.step(n)) to
+amortize the host round-trip. SIGTERM = POST /drain + wait idle + exit
+0. Model: ``--model tiny|small`` (random weights — smoke/serving-infra
+mode) or ``--ckpt DIR`` to restore trained params from the training
+harness's orbax checkpoints.
+"""
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+logger = logging.getLogger("tpu-serve")
+
+
+def build_params(args):
+    import jax
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    cfg = {"tiny": LlamaConfig.tiny,
+           "small": LlamaConfig.small}[args.model]()
+    if args.ckpt:
+        from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
+        trainer = CheckpointingTrainer(cfg, args.ckpt)
+        if trainer.latest_step is None:
+            # init_or_resume would silently fall back to random init — a
+            # serve binary pointed at a missing/mistyped checkpoint must
+            # fail fast, not serve garbage tokens behind a green healthz
+            trainer.close()
+            raise SystemExit(f"--ckpt {args.ckpt}: no checkpoint found")
+        state = trainer.init_or_resume(jax.random.PRNGKey(0))
+        params = state.params
+        trainer.close()
+    else:
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    return params, cfg
+
+
+class ServingRuntime:
+    """Batcher + stepper thread + completion events."""
+
+    def __init__(self, params, cfg, max_slots, capacity, block_size,
+                 chunk, shared_prefix=None):
+        from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
+        self.srv = ContinuousBatcher(params, cfg, max_slots=max_slots,
+                                     capacity_per_slot=capacity,
+                                     block_size=block_size,
+                                     shared_prefix=shared_prefix)
+        self.chunk = chunk
+        self.lock = threading.Lock()
+        self.results = {}
+        self.events = {}
+        self.draining = False
+        self.failed = False
+        self.handoff = None
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def submit(self, tokens, max_new):
+        import numpy as np
+        with self.lock:
+            if self.draining or self.failed:
+                return None
+            rid = self.srv.submit(np.asarray(tokens, np.int32), max_new)
+            ev = threading.Event()
+            self.events[rid] = ev
+        return rid, ev
+
+    def result(self, rid):
+        with self.lock:
+            return self.results.pop(rid)
+
+    def drain(self):
+        """Stop admission; expose the untouched queue for a peer. The
+        stepper keeps running until in-flight requests finish."""
+        with self.lock:
+            if self.handoff is None:
+                self.draining = True
+                self.srv.drain()
+                self.handoff = [(rid, [int(t) for t in prompt], max_new)
+                                for rid, prompt, max_new
+                                in self.srv.handoff()]
+                # queued-but-never-admitted requests will not complete
+                # here — unblock their waiters with a None result
+                for rid, _, _ in self.handoff:
+                    self.results[rid] = None
+                    ev = self.events.pop(rid, None)
+                    if ev:
+                        ev.set()
+            return self.handoff
+
+    def idle(self):
+        with self.lock:
+            return self.srv.idle
+
+    def delivered(self):
+        """True once every completed result has been handed to (and
+        popped by) its waiter — the SIGTERM path waits for this before
+        tearing the HTTP server down."""
+        with self.lock:
+            return not self.events and not self.results
+
+    def _loop(self):
+        import time
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    if not self.srv.idle:
+                        self.srv.step(self.chunk)
+                        for rid, toks in self.srv.poll().items():
+                            self.results[rid] = [int(t) for t in toks]
+                            ev = self.events.pop(rid, None)
+                            if ev:
+                                ev.set()
+                        continue
+            except Exception:
+                # a dead stepper with no diagnosis would leave every
+                # waiter blocked forever behind a green healthz — log,
+                # flip the server unhealthy, and release all waiters
+                # with the resubmit-to-peer signal
+                logger.exception("stepper crashed; failing the server")
+                with self.lock:
+                    self.failed = True
+                    for rid, ev in list(self.events.items()):
+                        self.results[rid] = None
+                        ev.set()
+                    self.events.clear()
+                return
+            time.sleep(0.01)
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(timeout=10)
+
+
+def make_handler(rt: ServingRuntime):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if rt.failed:
+                    self._json(503, {"status": "failed"})
+                elif rt.draining:
+                    self._json(503, {"status": "draining"})
+                else:
+                    self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path == "/drain":
+                self._json(200, {"handoff": rt.drain()})
+                return
+            if self.path != "/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                tokens = [int(t) for t in req["tokens"]]
+                max_new = int(req.get("max_new", 32))
+            except (ValueError, KeyError, TypeError) as exc:
+                # TypeError covers null/non-list bodies — every
+                # malformed request must get a JSON 400, not a dropped
+                # connection
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            try:
+                sub = rt.submit(tokens, max_new)
+            except (ValueError, TypeError) as exc:  # over capacity etc.
+                self._json(422, {"error": str(exc)})
+                return
+            if sub is None:
+                self._json(503, {"error": "draining or failed; submit "
+                                          "to a peer"})
+                return
+            rid, ev = sub
+            ev.wait()
+            toks = rt.result(rid)
+            if toks is None:    # drained/failed under us, never finished
+                self._json(503, {"error": "not served here; resubmit to "
+                                          "a peer"})
+            else:
+                self._json(200, {"tokens": toks})
+
+    return Handler
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="tiny", choices=("tiny", "small"))
+    ap.add_argument("--ckpt", default=None,
+                    help="orbax checkpoint dir (training harness layout)")
+    ap.add_argument("--port", type=int, default=8200)
+    ap.add_argument("--max-slots", type=int, default=8)
+    ap.add_argument("--capacity", type=int, default=512)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=4,
+                    help="decode ticks per device call (serve.step(n))")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+
+    params, cfg = build_params(args)
+    rt = ServingRuntime(params, cfg, args.max_slots, args.capacity,
+                        args.block_size, args.chunk)
+    httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(rt))
+
+    def on_term(signum, frame):
+        def drain_then_shutdown():
+            import time
+            logger.info("SIGTERM: draining (finish in-flight, hand off "
+                        "queue)")
+            handoff = rt.drain()
+            if handoff:
+                logger.info("handoff queue: %d requests", len(handoff))
+            # the HTTP server must outlive the last in-flight RESPONSE,
+            # not just the last decode: wait for every completed result
+            # to be picked up by its handler, plus a beat for the final
+            # socket writes, before tearing the listener down
+            while not (rt.idle() and rt.delivered()):
+                time.sleep(0.05)
+            time.sleep(0.5)
+            httpd.shutdown()
+
+        threading.Thread(target=drain_then_shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    logger.info("tpu-serve on :%d (%s, %d slots, chunk %d)", args.port,
+                args.model, args.max_slots, args.chunk)
+    httpd.serve_forever()
+    rt.stop()
+    logger.info("drained; exiting")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

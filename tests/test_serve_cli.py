@@ -1,0 +1,184 @@
+"""cmd/serve.py — the deployable inference server over the continuous
+batcher: HTTP generate/healthz/drain surface, concurrent correctness
+(every response equals its solo decode), and the upgrade-drain contract
+(in-flight finish, queued requests surface in the handoff, readiness
+flips)."""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_operator_libs_tpu.models.generate import generate
+from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+def _load_serve():
+    path = os.path.join(os.path.dirname(__file__), "..", "cmd", "serve.py")
+    spec = importlib.util.spec_from_file_location("tpu_serve_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def server():
+    mod = _load_serve()
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    rt = mod.ServingRuntime(params, CFG, max_slots=2, capacity=64,
+                            block_size=8, chunk=3)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), mod.make_handler(rt))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield mod, rt, base
+    httpd.shutdown()
+    rt.stop()
+
+
+def _post(base, path, obj):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _solo(params, prompt, n):
+    return [int(t) for t in np.asarray(
+        generate(params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+                 CFG, max_new_tokens=n))[0]]
+
+
+def test_concurrent_generate_matches_solo(server):
+    mod, rt, base = server
+    params = rt.srv.params
+    rng = np.random.default_rng(5)
+    prompts = [[int(x) for x in rng.integers(0, CFG.vocab_size, size=n)]
+               for n in (5, 9, 7)]
+    news = [6, 4, 5]
+    results = {}
+
+    def call(i):
+        code, body = _post(base, "/generate",
+                           {"tokens": prompts[i], "max_new": news[i]})
+        results[i] = (code, body)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i in range(3):
+        code, body = results[i]
+        assert code == 200, body
+        assert body["tokens"] == _solo(params, prompts[i], news[i]), \
+            f"request {i} diverged from its solo decode"
+
+    status, body = _get(base, "/healthz")
+    assert status == 200 and body["status"] == "ok"
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_bad_requests(server):
+    mod, rt, base = server
+    code, _ = _post(base, "/generate", {"max_new": 4})        # no tokens
+    assert code == 400
+    code, _ = _post(base, "/generate", {"tokens": None, "max_new": 2})
+    assert code == 400
+    code, _ = _post(base, "/generate", {"tokens": [1], "max_new": None})
+    assert code == 400
+    code, body = _post(base, "/generate",
+                       {"tokens": [1] * 200, "max_new": 8})   # over capacity
+    assert code == 422 and "capacity" in body["error"]
+    code, _ = _get(base, "/nope")
+    assert code == 404
+
+
+def test_drain_contract(server):
+    """In-flight requests finish and respond; queued-but-not-admitted
+    requests surface in the handoff (their waiters get the
+    resubmit-to-peer signal); readiness flips; new submits 503."""
+    mod, rt, base = server
+    params = rt.srv.params
+    rng = np.random.default_rng(9)
+    p_run = [int(x) for x in rng.integers(0, CFG.vocab_size, size=6)]
+    p_q = [int(x) for x in rng.integers(0, CFG.vocab_size, size=5)]
+
+    # fill BOTH slots with long-running requests, then queue a third
+    subs = [rt.submit([1, 2, 3], 40), rt.submit(p_run, 30)]
+    assert all(s is not None for s in subs)
+    # wait until both are admitted (no free slot remains)
+    for _ in range(200):
+        with rt.lock:
+            if len(rt.srv._running) == 2:
+                break
+        threading.Event().wait(0.01)
+    queued = rt.submit(p_q, 4)
+    assert queued is not None
+
+    code, body = _post(base, "/drain", {})
+    assert code == 200
+    assert [h[1] for h in body["handoff"]] == [p_q]
+
+    # the queued waiter is released with the resubmit signal
+    rid_q, ev_q = queued
+    assert ev_q.wait(timeout=10)
+    assert rt.result(rid_q) is None
+
+    # in-flight requests still complete correctly
+    rid1, ev1 = subs[1]
+    assert ev1.wait(timeout=120)
+    assert rt.result(rid1) == _solo(params, p_run, 30)
+
+    code, body = _get(base, "/healthz")
+    assert code == 503 and body["status"] == "draining"
+    code, body = _post(base, "/generate", {"tokens": [1], "max_new": 2})
+    assert code == 503
+    # drain is idempotent: the same handoff comes back
+    code, body2 = _post(base, "/drain", {})
+    assert code == 200
+    assert [h[1] for h in body2["handoff"]] == [p_q]
+
+
+def test_stepper_crash_fails_safe(server):
+    """A crashed stepper must not strand waiters behind a green healthz:
+    waiters get the resubmit signal, readiness flips to failed, and new
+    submissions are refused."""
+    mod, rt, base = server
+
+    def boom(n=1):
+        raise RuntimeError("device fell over")
+
+    with rt.lock:
+        rt.srv.step = boom
+    sub = rt.submit([1, 2, 3], 4)
+    assert sub is not None
+    rid, ev = sub
+    assert ev.wait(timeout=10), "waiter stranded after stepper crash"
+    assert rt.result(rid) is None
+    code, body = _get(base, "/healthz")
+    assert code == 503 and body["status"] == "failed"
+    assert rt.submit([1], 2) is None
+    code, _ = _post(base, "/generate", {"tokens": [1], "max_new": 2})
+    assert code == 503
