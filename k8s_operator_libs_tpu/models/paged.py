@@ -45,13 +45,22 @@ DEFAULT_BLOCK_SIZE = 16
 
 @dataclasses.dataclass
 class PagedKVCache:
+    """``k_scale``/``v_scale`` present (int8 mode, opt-in): the pools
+    store per-row symmetric int8 with one fp32 scale per (block row,
+    K/V head) — KV HBM bytes halve vs bf16, or equivalently the same
+    pool serves 2x the tokens. None (default): pools are the model
+    dtype and nothing changes."""
+
     k: jax.Array        # [L, NB, BS, KV, Dh] shared block pool
     v: jax.Array        # [L, NB, BS, KV, Dh]
     table: jax.Array    # [B, MB] int32 — pool block id per logical block
     lengths: jax.Array  # [B] int32 — valid tokens per sequence
+    k_scale: Optional[jax.Array] = None   # [L, NB, BS, KV] fp32
+    v_scale: Optional[jax.Array] = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.table, self.lengths), None
+        return (self.k, self.v, self.table, self.lengths,
+                self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -64,6 +73,10 @@ class PagedKVCache:
     @property
     def capacity_per_seq(self) -> int:
         return self.table.shape[1] * self.block_size
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 jax.tree_util.register_pytree_node(PagedKVCache, PagedKVCache.tree_flatten,
@@ -99,18 +112,40 @@ def plan_blocks(seq_capacities: Sequence[int],
 
 def init_paged_cache(cfg: LlamaConfig, seq_capacities: Sequence[int],
                      block_size: int = DEFAULT_BLOCK_SIZE,
-                     dtype=None) -> PagedKVCache:
+                     dtype=None, kv_int8: bool = False) -> PagedKVCache:
     """Pool sized to the SUM of per-sequence capacities (rounded up to
     blocks, plus the shared scratch block — see :func:`plan_blocks`) — a
-    ragged batch of short sequences costs what it uses, not ``B x max``."""
+    ragged batch of short sequences costs what it uses, not ``B x max``.
+    ``kv_int8=True`` stores the pools as per-row symmetric int8 with
+    fp32 scales: half the KV HBM bytes (2x tokens per pool byte), at a
+    ~1/127 relative rounding cost on attention inputs."""
     L, KV, Dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     dtype = dtype or cfg.dtype
     table, nb = plan_blocks(seq_capacities, block_size)
     shape = (L, nb, block_size, KV, Dh)
+    if kv_int8:
+        sshape = (L, nb, block_size, KV)
+        return PagedKVCache(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            table=jnp.asarray(table),
+            lengths=jnp.zeros((len(seq_capacities),), jnp.int32),
+            k_scale=jnp.zeros(sshape, jnp.float32),
+            v_scale=jnp.zeros(sshape, jnp.float32))
     return PagedKVCache(
         k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
         table=jnp.asarray(table),
         lengths=jnp.zeros((len(seq_capacities),), jnp.int32))
+
+
+def _quantize_rows(vals: jax.Array):
+    """[B, T, KV, Dh] → (int8 rows, fp32 scales [B, T, KV]): symmetric
+    per-(token, head) row quantization — one scale per attention row, so
+    the dequant folds into the score/prob columns at read time."""
+    f = vals.astype(jnp.float32)
+    s = jnp.max(jnp.abs(f), axis=-1) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(f / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
 
 
 def _paged_write(pool: jax.Array, table: jax.Array, lengths: jax.Array,
@@ -230,41 +265,129 @@ def _paged_decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
     o_ref[0] = jnp.concatenate(outs, axis=0).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel_q(table_ref, len_ref, q_ref, kp_ref, vp_ref,
+                           ksp_ref, vsp_ref, o_ref, k_buf, v_buf, ks_buf,
+                           vs_buf, sem, *, block_size: int, n_kv: int):
+    """int8 twin of :func:`_paged_decode_kernel`: the pools hold per-row
+    symmetric int8 and [NB, BS, KV] fp32 scales; the kernel DMAs HALF
+    the K/V bytes (plus 1/Dh of scales), converts the int8 slab to the
+    compute dtype once, and folds the dequant scales into the score and
+    probability COLUMNS — one [1, cap] multiply each, instead of
+    rescaling the [cap, Dh] rows."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    H, Dh = q_ref.shape[1], q_ref.shape[2]
+    G = H // n_kv
+    cap = k_buf.shape[0]
+    scale = 1.0 / math.sqrt(Dh)
+    q_pos = len_ref[b]
+    n_live = q_pos // block_size + 1
+
+    def copies(mb):
+        dst = pl.ds(mb * block_size, block_size)
+        idx = table_ref[b, mb]
+        return (pltpu.make_async_copy(kp_ref.at[idx], k_buf.at[dst], sem),
+                pltpu.make_async_copy(vp_ref.at[idx], v_buf.at[dst], sem),
+                pltpu.make_async_copy(ksp_ref.at[idx], ks_buf.at[dst], sem),
+                pltpu.make_async_copy(vsp_ref.at[idx], vs_buf.at[dst], sem))
+
+    def start(mb, _):
+        for c in copies(mb):
+            c.start()
+        return 0
+
+    def wait(mb, _):
+        for c in copies(mb):
+            c.wait()
+        return 0
+
+    jax.lax.fori_loop(0, n_live, start, 0)
+
+    # dead blocks: zero V and its scales (masked p is exactly 0, but
+    # 0 · garbage can be NaN); K scores are masked before use
+    def zero_dead(mb, _):
+        sl = pl.ds(mb * block_size, block_size)
+        v_buf[sl] = jnp.zeros((block_size,) + v_buf.shape[1:], v_buf.dtype)
+        vs_buf[sl] = jnp.zeros((block_size,) + vs_buf.shape[1:],
+                               vs_buf.dtype)
+        return 0
+
+    n_blocks = cap // block_size
+    jax.lax.fori_loop(n_live, n_blocks, zero_dead, 0)
+    jax.lax.fori_loop(0, n_live, wait, 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, cap), 1)
+    valid = k_pos <= q_pos
+    outs = []
+    for kv in range(n_kv):
+        q_kv = q_ref[0, kv * G:(kv + 1) * G, :]                 # [G, Dh]
+        k_bf = k_buf[:, kv, :].astype(q_kv.dtype)               # [cap, Dh]
+        ks_col = jnp.swapaxes(ks_buf[:, kv:kv + 1], 0, 1)       # [1, cap]
+        vs_col = jnp.swapaxes(vs_buf[:, kv:kv + 1], 0, 1)
+        s = jax.lax.dot_general(
+            q_kv, k_bf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale * ks_col
+        s = jnp.where(valid, s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        w = ((p / l) * vs_col).astype(q_kv.dtype)               # [G, cap]
+        v_bf = v_buf[:, kv, :].astype(q_kv.dtype)
+        outs.append(jax.lax.dot_general(
+            w, v_bf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))                # [G, Dh]
+    o_ref[0] = jnp.concatenate(outs, axis=0).astype(o_ref.dtype)
+
+
 def _attend_paged_kernel(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
-                         table: jax.Array, lengths: jax.Array) -> jax.Array:
-    """Dispatch :func:`_paged_decode_kernel`. q [B, 1, H, Dh]; pools
-    [NB, BS, KV, Dh]; table [B, MB]; lengths [B] (the per-sequence decode
-    position). Returns [B, 1, H, Dh]."""
+                         table: jax.Array, lengths: jax.Array,
+                         k_scale=None, v_scale=None) -> jax.Array:
+    """Dispatch :func:`_paged_decode_kernel` (or its int8 twin when
+    scale pools are given). q [B, 1, H, Dh]; pools [NB, BS, KV, Dh];
+    table [B, MB]; lengths [B] (the per-sequence decode position).
+    Returns [B, 1, H, Dh]."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, _, H, Dh = q.shape
     NB, BS, KV, _ = k_pool.shape
     MB = table.shape[1]
+    quant = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, H, Dh), lambda b, t, ln: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((MB * BS, KV, Dh), k_pool.dtype),
+        pltpu.VMEM((MB * BS, KV, Dh), v_pool.dtype),
+    ]
+    inputs = [table, lengths, q[:, 0], k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((MB * BS, KV), jnp.float32),
+                    pltpu.VMEM((MB * BS, KV), jnp.float32)]
+        inputs += [k_scale, v_scale]
+        kernel = partial(_paged_decode_kernel_q, block_size=BS, n_kv=KV)
+    else:
+        kernel = partial(_paged_decode_kernel, block_size=BS, n_kv=KV)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, H, Dh), lambda b, t, ln: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, Dh), lambda b, t, ln: (b, 0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((MB * BS, KV, Dh), k_pool.dtype),
-            pltpu.VMEM((MB * BS, KV, Dh), v_pool.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=scratch + [pltpu.SemaphoreType.DMA],
     )
-    kernel = partial(_paged_decode_kernel, block_size=BS, n_kv=KV)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
         interpret=INTERPRET,
-    )(table, lengths, q[:, 0], k_pool, v_pool)
+    )(*inputs)
     return out[:, None]
 
 
@@ -303,6 +426,7 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
     derive from product shapes so hooked weights (quant dicts) work."""
     mm = matmul or (lambda x, layer, name: x @ layer[name])
     lm = lm_head_fn or (lambda x, p: x @ p["lm_head"])
+    quant = cache.quantized
     B, T = tokens.shape
     Dh = cfg.head_dim
     pos = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -310,7 +434,11 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
 
     def body(carry, layer_in):
         x, = carry
-        layer, k_pool_l, v_pool_l = layer_in
+        if quant:
+            layer, k_pool_l, v_pool_l, ks_l, vs_l = layer_in
+        else:
+            layer, k_pool_l, v_pool_l = layer_in
+            ks_l = vs_l = None
         h = rms_norm(x, layer["attn_norm"])
         q = mm(h, layer, "wq")
         H = q.shape[-1] // Dh
@@ -321,8 +449,19 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
         v = mm(h, layer, "wv").reshape(B, T, KV, Dh)
         q = rope(q, pos, cfg.rope_theta)
         k = rope(k, pos, cfg.rope_theta)
-        k_pool_l = _paged_write(k_pool_l, cache.table, cache.lengths, k)
-        v_pool_l = _paged_write(v_pool_l, cache.table, cache.lengths, v)
+        if quant:
+            kq, ks_rows = _quantize_rows(k)
+            vq, vs_rows = _quantize_rows(v)
+            k_pool_l = _paged_write(k_pool_l, cache.table, cache.lengths,
+                                    kq)
+            v_pool_l = _paged_write(v_pool_l, cache.table, cache.lengths,
+                                    vq)
+            # same index math writes the [B, T, KV] scale rows
+            ks_l = _paged_write(ks_l, cache.table, cache.lengths, ks_rows)
+            vs_l = _paged_write(vs_l, cache.table, cache.lengths, vs_rows)
+        else:
+            k_pool_l = _paged_write(k_pool_l, cache.table, cache.lengths, k)
+            v_pool_l = _paged_write(v_pool_l, cache.table, cache.lengths, v)
         cap_bytes = (2 * cache.capacity_per_seq * KV * Dh
                      * jnp.dtype(k_pool_l.dtype).itemsize)
         # dispatch by measured crossover (v5e): per-sequence kernel
@@ -335,11 +474,23 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
                 and cap_bytes <= 8 * 1024 * 1024):
             # decode: walk the block table in place (no gathered copy)
             attn = _attend_paged_kernel(q, k_pool_l, v_pool_l,
-                                        cache.table, cache.lengths)
+                                        cache.table, cache.lengths,
+                                        ks_l, vs_l)
         else:
-            # prefill / CPU: gather view + masked reference attention
-            attn = _attend_paged(cfg, q, _paged_view(k_pool_l, cache.table),
-                                 _paged_view(v_pool_l, cache.table), pos)
+            # prefill / CPU: gather view + masked reference attention.
+            # int8 mode dequantizes the gathered view (the bandwidth win
+            # lives in the kernel path; this path is the correctness
+            # fallback and the memory win stands either way)
+            k_view = _paged_view(k_pool_l, cache.table)
+            v_view = _paged_view(v_pool_l, cache.table)
+            if quant:
+                k_view = (k_view.astype(jnp.float32)
+                          * _paged_view(ks_l, cache.table)[..., None]
+                          ).astype(q.dtype)
+                v_view = (v_view.astype(jnp.float32)
+                          * _paged_view(vs_l, cache.table)[..., None]
+                          ).astype(q.dtype)
+            attn = _attend_paged(cfg, q, k_view, v_view, pos)
         x = x + mm(attn.reshape(B, T, H * Dh), layer, "wo")
         h2 = rms_norm(x, layer["mlp_norm"])
         if ffn is not None:
@@ -348,14 +499,23 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
             gate = jax.nn.silu((mm(h2, layer, "w_gate")
                                 ).astype(jnp.float32)).astype(h2.dtype)
             x = x + mm(gate * mm(h2, layer, "w_up"), layer, "w_down")
+        if quant:
+            return (x,), (k_pool_l, v_pool_l, ks_l, vs_l)
         return (x,), (k_pool_l, v_pool_l)
 
-    (x,), (new_k, new_v) = jax.lax.scan(
-        body, (x,), (params["blocks"], cache.k, cache.v))
+    if quant:
+        (x,), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            body, (x,), (params["blocks"], cache.k, cache.v,
+                         cache.k_scale, cache.v_scale))
+    else:
+        (x,), (new_k, new_v) = jax.lax.scan(
+            body, (x,), (params["blocks"], cache.k, cache.v))
+        new_ks = new_vs = None
     x = rms_norm(x, params["final_norm"])
     logits = lm(x, params).astype(jnp.float32)
     new_cache = PagedKVCache(k=new_k, v=new_v, table=cache.table,
-                             lengths=cache.lengths + T)
+                             lengths=cache.lengths + T,
+                             k_scale=new_ks, v_scale=new_vs)
     return logits, new_cache
 
 
