@@ -9,12 +9,12 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 # (any goal) execute inside docker/Dockerfile.devel with the repo bind-
 # mounted — the reference's docker-% passthrough (Makefile:114-125)
 DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
-  docker-lint docker-cov-report docker-bench docker-dryrun
+  docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
-.PHONY: all native test test-fast lint cov-report cov-artifact bench dryrun \
-  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+.PHONY: all native test test-fast lint lint-domain cov-report cov-artifact \
+  bench dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
-all: lint native test
+all: lint lint-domain native test
 
 native: build/libtokenloader.so  ## C++ mmap token loader
 
@@ -28,13 +28,16 @@ test:
 test-fast:  ## operator-library tests only (skips slow JAX compiles)
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_jax_stack.py
 
-lint:  ## static analysis (tools/lint.py: stdlib AST linter — F821/F401/F811/F841/B006/E722/F541/F601/F631/F602/W605/W0101/A001/A002) + import sanity
+lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — see docs/static-analysis.md) + import sanity
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
-	$(PYTHON) tools/lint.py
+	$(PYTHON) -m tools.lint --generic
 	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
 	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
+
+lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, ARC001 import layering (docs/static-analysis.md)
+	$(PYTHON) -m tools.lint --domain
 
 COV_MIN ?= 80
 

@@ -25,7 +25,10 @@ One background stepper thread owns the batcher (submit/poll are guarded
 by a lock — the batcher itself is deliberately single-threaded);
 ``--chunk N`` runs N decode ticks per device call (serve.step(n)) to
 amortize the host round-trip. SIGTERM = POST /drain + wait idle + exit
-0. Model: ``--model tiny|small`` (random weights — smoke/serving-infra
+0, bounded by ``--grace`` (the pod termination grace period): a dead
+client that never collects its result cannot spin shutdown past the
+deadline — undelivered request ids are logged and the server exits.
+Model: ``--model tiny|small`` (random weights — smoke/serving-infra
 mode) or ``--ckpt DIR`` to restore trained params from the training
 harness's orbax checkpoints.
 """
@@ -131,6 +134,12 @@ class ServingRuntime:
         with self.lock:
             return not self.events and not self.results
 
+    def undelivered(self):
+        """Request ids still waiting on a handler (or whose handler
+        vanished) — what the bounded drain logs before giving up."""
+        with self.lock:
+            return sorted(set(self.results) | set(self.events))
+
     def _loop(self):
         import time
         while not self._stop.is_set():
@@ -227,6 +236,39 @@ def make_handler(rt: ServingRuntime):
     return Handler
 
 
+def drain_then_shutdown(rt, httpd, grace, poll=0.05, settle=0.5):
+    """The SIGTERM drain, bounded: finish in-flight requests and hand the
+    queue off, but never outlive the pod's termination grace period — a
+    dead client that never collects its result must not spin shutdown
+    forever (kubelet would SIGKILL mid-socket-write instead of us exiting
+    cleanly). On deadline, log the undelivered request ids (their clients
+    resubmit to a peer; the results are lost with this process either
+    way) and proceed to httpd.shutdown()."""
+    import time
+    logger.info("SIGTERM: draining (finish in-flight, hand off queue)")
+    handoff = rt.drain()
+    if handoff:
+        logger.info("handoff queue: %d requests", len(handoff))
+    # the HTTP server must outlive the last in-flight RESPONSE, not just
+    # the last decode: wait for every completed result to be picked up by
+    # its handler, plus a beat for the final socket writes — but only up
+    # to the grace deadline (minus the settle beat we still want to take)
+    deadline = time.monotonic() + max(0.0, grace - settle)
+    while not (rt.idle() and rt.delivered()):
+        if time.monotonic() >= deadline:
+            lost = rt.undelivered()
+            logger.warning(
+                "drain deadline (%.1fs grace) hit with %d undelivered "
+                "request(s): %s — shutting down anyway; clients must "
+                "resubmit to a peer", grace, len(lost),
+                ",".join(map(str, lost)) or "<none>")
+            break
+        time.sleep(poll)
+    else:
+        time.sleep(settle)
+    httpd.shutdown()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="tiny", choices=("tiny", "small"))
@@ -239,6 +281,10 @@ def main(argv=None):
     ap.add_argument("--chunk", type=int, default=4,
                     help="decode ticks per device call (serve.step(n))")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grace", type=float, default=30.0,
+                    help="termination grace period (s): the SIGTERM drain "
+                         "gives up and shuts down after this deadline, "
+                         "logging undelivered request ids")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
@@ -249,23 +295,8 @@ def main(argv=None):
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(rt))
 
     def on_term(signum, frame):
-        def drain_then_shutdown():
-            import time
-            logger.info("SIGTERM: draining (finish in-flight, hand off "
-                        "queue)")
-            handoff = rt.drain()
-            if handoff:
-                logger.info("handoff queue: %d requests", len(handoff))
-            # the HTTP server must outlive the last in-flight RESPONSE,
-            # not just the last decode: wait for every completed result
-            # to be picked up by its handler, plus a beat for the final
-            # socket writes, before tearing the listener down
-            while not (rt.idle() and rt.delivered()):
-                time.sleep(0.05)
-            time.sleep(0.5)
-            httpd.shutdown()
-
-        threading.Thread(target=drain_then_shutdown, daemon=True).start()
+        threading.Thread(target=drain_then_shutdown,
+                         args=(rt, httpd, args.grace), daemon=True).start()
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)

@@ -44,7 +44,9 @@ def _load_native() -> Optional[ctypes.CDLL]:
             if (not os.path.exists(_SO)
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 os.makedirs(os.path.dirname(_SO), exist_ok=True)
-                subprocess.run(
+                # compile-once under the lock is the point: every other
+                # thread must wait for the .so, not race the compiler
+                subprocess.run(  # lint: ignore
                     ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
                     check=True, capture_output=True)
             lib = ctypes.CDLL(_SO)

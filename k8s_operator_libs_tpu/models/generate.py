@@ -160,7 +160,20 @@ def filter_logits(logits: jax.Array, top_k: Optional[int] = None,
     renormalizes over the survivors. top_k keeps the k highest logits;
     top_p keeps the smallest prefix of the descending-probability order
     whose cumulative mass reaches p (the first token is always kept).
-    Both may combine (k-filter first, then p over the survivors)."""
+    Both may combine (k-filter first, then p over the survivors).
+
+    Tie semantics (documented divergence, pinned by
+    test_filter_logits_tied_integer_logits): both filters cut at a VALUE
+    threshold with a strict ``<``, so every logit exactly equal to the
+    k-th value (or to the nucleus-boundary value) survives — tied
+    integer/quantized logits can keep more than k tokens, where HF's
+    rank-based masking would break the tie by sort position. The value
+    rule is deliberate: it is order-invariant (no dependence on the
+    sort's tie order), and rank-based masking would need a second
+    O(V log V) argsort inside the per-token decode scan (the comment on
+    ``desc`` below — this function runs on every generated token).
+    Real-model float logits tie with vanishing probability; if exact-k
+    truncation ever matters, break ties by rank before calling."""
     if top_k is None and top_p is None:
         return logits
     # ONE descending sort serves both filters — this runs on every token

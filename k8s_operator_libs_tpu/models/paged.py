@@ -521,17 +521,22 @@ def _forward_paged(params: Params, tokens: jax.Array, cache: PagedKVCache,
 
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "temperature",
-                          "block_size", "top_k", "top_p"))
+                          "block_size", "top_k", "top_p", "kv_int8"))
 def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
                    max_new_tokens: int = 32, temperature: float = 0.0,
                    rng: Optional[jax.Array] = None,
                    prompt_lengths: Optional[jax.Array] = None,
                    block_size: int = DEFAULT_BLOCK_SIZE,
                    top_k: Optional[int] = None,
-                   top_p: Optional[float] = None) -> jax.Array:
+                   top_p: Optional[float] = None,
+                   kv_int8: bool = False) -> jax.Array:
     """Greedy/sampled decode over the paged cache. prompt [B, Tp] int32
     (right-padded when ragged; pass ``prompt_lengths`` [B] so each
     sequence decodes from its own offset) → [B, Tp + max_new_tokens].
+    ``kv_int8=True`` stores the block pools as per-row symmetric int8
+    (half the KV HBM bytes, ~1/127 relative rounding on attention
+    inputs — see :func:`init_paged_cache`); the forward/decode paths
+    dispatch on the cache itself, so nothing else changes.
 
     Note the pool here is provisioned for the padded capacity (static
     shapes inside one jit); the structural win — per-sequence tables over
@@ -539,7 +544,8 @@ def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
     request batches, and `init_paged_cache` sizes pools by true
     per-sequence capacity when given ragged caps."""
     B, Tp = prompt.shape
-    cache = init_paged_cache(cfg, [Tp + max_new_tokens] * B, block_size)
+    cache = init_paged_cache(cfg, [Tp + max_new_tokens] * B, block_size,
+                             kv_int8=kv_int8)
     if prompt_lengths is None:
         prompt_lengths = jnp.full((B,), Tp, jnp.int32)
     if rng is None:
@@ -552,8 +558,8 @@ def paged_generate(params: Params, prompt: jax.Array, cfg: LlamaConfig,
         logits, last_idx[:, None, None], axis=1)[:, 0]
     # sequences shorter than Tp wrote padding rows past their length;
     # rewind lengths so decode continues from the true end of each prompt
-    cache = PagedKVCache(k=cache.k, v=cache.v, table=cache.table,
-                         lengths=prompt_lengths)
+    # (replace() keeps the scale pools — int8 mode must not lose them)
+    cache = dataclasses.replace(cache, lengths=prompt_lengths)
     from .generate import scan_decode
     return scan_decode(partial(_forward_paged, cfg=cfg), params, prompt,
                        cache, last_logits, max_new_tokens, temperature, rng,

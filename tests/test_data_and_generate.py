@@ -185,6 +185,75 @@ def test_paged_generate_matches_contiguous():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_paged_generate_kv_int8_within_quant_tolerance():
+    """The int8 KV path, now reachable through paged_generate(kv_int8=
+    True): pools really store int8, the prefill-rewind keeps the scale
+    pools, prefill logits sit within per-row symmetric-quantization
+    tolerance of the float paged path, and greedy decode agrees with the
+    float path on (at least) the overwhelming majority of tokens."""
+    import jax
+    import jax.numpy as jnp
+    from k8s_operator_libs_tpu.models import paged
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+
+    # prefill logits: quantization noise only (measured ~0.9% of the
+    # logit scale for these shapes; 5% is the tolerance contract)
+    cache_f = paged.init_paged_cache(cfg, [16] * 2, 8)
+    cache_q = paged.init_paged_cache(cfg, [16] * 2, 8, kv_int8=True)
+    assert cache_q.quantized and cache_q.k.dtype == jnp.int8
+    lf, _ = paged._forward_paged(params, prompt, cache_f, cfg)
+    lq, new_q = paged._forward_paged(params, prompt, cache_q, cfg)
+    assert new_q.k_scale is not None  # scales ride along through forward
+    lf, lq = np.asarray(lf), np.asarray(lq)
+    assert np.abs(lf - lq).max() <= 0.05 * np.abs(lf).max()
+
+    # end-to-end greedy through the public entry point
+    out_f = np.asarray(paged.paged_generate(params, prompt, cfg,
+                                            max_new_tokens=8))
+    out_q = np.asarray(paged.paged_generate(params, prompt, cfg,
+                                            max_new_tokens=8, kv_int8=True))
+    assert out_q.shape == out_f.shape
+    assert (out_q == out_f).mean() >= 0.9  # measured 1.0; near-ties may flip
+
+
+def test_paged_kv_int8_interpret_kernel_matches_float():
+    """CPU-interpret pin for the int8 Pallas decode kernel
+    (_paged_decode_kernel_q): with head_dim=128 and INTERPRET on, the
+    kernel path must engage and its greedy tokens must agree with the
+    float paged decode within quantization tolerance."""
+    import jax
+    from unittest import mock
+    from k8s_operator_libs_tpu.models import paged
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(d_model=512, n_heads=4, n_kv_heads=2,
+                           vocab_size=256)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    ref = np.asarray(paged.paged_generate(params, prompt, cfg,
+                                          max_new_tokens=6, block_size=4))
+    paged.INTERPRET = True
+    jax.clear_caches()
+    try:
+        with mock.patch.object(paged, "_paged_decode_kernel_q",
+                               side_effect=paged._paged_decode_kernel_q) \
+                as spy:
+            out = paged.paged_generate(params, prompt, cfg,
+                                       max_new_tokens=6, block_size=4,
+                                       kv_int8=True)
+        assert spy.call_count > 0, "int8 kernel path did not engage"
+        out = np.asarray(out)
+        assert (out == ref).mean() >= 0.9  # measured 1.0 on these shapes
+    finally:
+        paged.INTERPRET = False
+
+
 def test_paged_generate_ragged_prompts():
     """Ragged batches are first-class in the paged layout: each padded
     sequence decodes from its own prompt length and matches the result of
@@ -476,6 +545,39 @@ def test_filter_logits_top_k_and_top_p():
     # combined: k filters first, p over the survivors
     kp = np.asarray(filter_logits(logits, top_k=3, top_p=0.99))
     assert np.isinf(kp[0, 3])
+
+
+def test_filter_logits_tied_integer_logits():
+    """Pin of the documented tie semantics (filter_logits docstring):
+    both filters cut at a VALUE threshold with strict <, so every logit
+    equal to the boundary survives — on tied integer logits top_k can
+    keep more than k tokens (HF's rank-based masking would keep exactly
+    k, tie-broken by sort position)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import filter_logits
+
+    # three-way tie at the top: top_k=2 keeps ALL THREE fives
+    tied = jnp.asarray([[5.0, 5.0, 5.0, 1.0]])
+    k2 = np.asarray(filter_logits(tied, top_k=2))
+    assert np.isfinite(k2[0, :3]).all() and np.isinf(k2[0, 3])
+
+    # four-way uniform tie: the nucleus boundary value is shared by every
+    # token, so top_p=0.5 keeps all four (value rule), not two (rank rule)
+    uniform = jnp.zeros((1, 4))
+    p5 = np.asarray(filter_logits(uniform, top_p=0.5))
+    assert np.isfinite(p5).all()
+
+    # ties BELOW the cut are still masked: only the maximal tie survives
+    k1 = np.asarray(filter_logits(tied, top_k=1))
+    assert np.isfinite(k1[0, :3]).all() and np.isinf(k1[0, 3])
+    below = jnp.asarray([[7.0, 3.0, 3.0, 3.0]])
+    kb = np.asarray(filter_logits(below, top_k=2))
+    # k-th value is 3.0 → the whole 3.0 tie survives with it
+    assert np.isfinite(kb).all()
+    kb1 = np.asarray(filter_logits(below, top_k=1))
+    assert np.isfinite(kb1[0, 0]) and np.isinf(kb1[0, 1:]).all()
 
 
 def test_top_k1_sampling_equals_greedy():

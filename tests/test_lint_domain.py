@@ -1,0 +1,406 @@
+"""tools/lint domain passes — JAX001–JAX004 jit-hygiene, LCK001–LCK003
+lock discipline, STM001 state-machine exhaustiveness, ARC001 import
+layering. Every code must fire on its module's offender fixture and stay
+silent on the clean idiom; the cross-file passes are additionally proven
+on mutated copies of the real repo files (delete a handler / add a fake
+state → the pass fails naming exactly what is missing)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint  # noqa: E402  (the tools/lint package; shadows the shim)
+from lint import jax_hygiene, layering, lock_discipline, state_machine  # noqa: E402
+from lint.registry import REGISTRY  # noqa: E402
+
+
+def run_lint(tmp_path, source, name="case.py"):
+    f = tmp_path / name
+    f.write_text(source)
+    return lint.lint_file(f)
+
+
+def codes(findings):
+    return [f.split(": ")[1].split(" ")[0] for f in findings]
+
+
+# --------------------------------------------------------------- registry
+
+def test_registry_has_all_passes():
+    names = {c.name for c in REGISTRY}
+    assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
+            "import-layering"} <= names
+    all_codes = lint.all_codes()
+    assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
+            "LCK003", "STM001", "ARC001"} <= set(all_codes)
+    # codes are globally unique across checks
+    per_check = [set(c.codes) for c in REGISTRY]
+    assert sum(map(len, per_check)) == len(set().union(*per_check))
+
+
+@pytest.mark.parametrize("mod", [jax_hygiene, lock_discipline])
+def test_every_file_check_ships_fixture_pairs(mod):
+    """The plugin contract: one firing offender and one silent clean
+    fixture per code, carried by the check module itself."""
+    assert set(mod.OFFENDERS) == set(mod.CODES)
+    assert set(mod.CLEAN) == set(mod.CODES)
+
+
+@pytest.mark.parametrize("mod", [jax_hygiene, lock_discipline])
+def test_offender_fixtures_fire(mod, tmp_path):
+    for code, src in mod.OFFENDERS.items():
+        found = run_lint(tmp_path, src, name=f"off_{code}.py")
+        assert code in codes(found), (code, found)
+
+
+@pytest.mark.parametrize("mod", [jax_hygiene, lock_discipline])
+def test_clean_fixtures_stay_silent(mod, tmp_path):
+    for code, src in mod.CLEAN.items():
+        found = run_lint(tmp_path, src, name=f"clean_{code}.py")
+        assert found == [], (code, found)
+
+
+# ------------------------------------------------------------ JAX hygiene
+
+def test_jax_wrapper_returning_idiom_resolved(tmp_path):
+    """`return jax.jit(train_step, ...)` over a local def (the
+    parallel/fsdp.py / long_context.py idiom) marks the def as traced."""
+    src = '''
+import jax
+import time
+
+def make_train_step(optimizer):
+    def train_step(state, tokens):
+        t0 = time.time()
+        return state, t0
+    return jax.jit(train_step, donate_argnums=(0,))
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["JAX001"] and "time.time" in found[0]
+
+
+def test_jax_partial_alias_hop_resolved(tmp_path):
+    """`kernel = partial(fn, ...)` then `pl.pallas_call(kernel, ...)`
+    (the models/paged.py idiom) traces fn — through either arm of a
+    conditional alias."""
+    src = '''
+import jax.experimental.pallas as pl
+from functools import partial
+import numpy as np
+
+def _kernel_a(ref):
+    return np.random.rand()
+
+def _kernel_b(ref):
+    return np.random.rand()
+
+def dispatch(quant):
+    if quant:
+        kernel = partial(_kernel_a, n=1)
+    else:
+        kernel = partial(_kernel_b, n=1)
+    return pl.pallas_call(kernel, grid=(1,))
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["JAX002", "JAX002"]
+
+
+def test_jax_static_argnames_exempt_from_host_sync(tmp_path):
+    """float()/int() on a static_argnames parameter is concrete at trace
+    time — silent; the same cast on a traced parameter fires."""
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("temperature",))
+def sample(logits, temperature):
+    scale = float(temperature)     # static: fine
+    return logits * scale
+
+@jax.jit
+def bad(logits, temperature):
+    return logits * float(temperature)   # traced: host sync
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["JAX003"] and "temperature" in found[0]
+
+
+def test_jax_shard_map_first_arg_traced(tmp_path):
+    src = '''
+import jax
+
+def build(mesh, specs):
+    def shard_gen(params, prompt):
+        print("tracing", prompt.shape)
+        return params
+    return jax.shard_map(shard_gen, mesh=mesh, in_specs=specs,
+                         out_specs=specs)
+'''
+    assert codes(run_lint(tmp_path, src)) == ["JAX001"]
+
+
+def test_jax_nested_def_inherits_traced(tmp_path):
+    src = '''
+import jax
+import random
+
+@jax.jit
+def outer(x):
+    def body(carry, _):
+        return carry + random.random(), None
+    return jax.lax.scan(body, x, None, length=4)[0]
+'''
+    assert codes(run_lint(tmp_path, src)) == ["JAX002"]
+
+
+def test_jax_item_call_fires(tmp_path):
+    src = '''
+import jax
+
+@jax.jit
+def step(x):
+    return x.sum().item()
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["JAX003"] and ".item()" in found[0]
+
+
+def test_jax_suppression_hatch(tmp_path):
+    src = '''
+import jax
+import time
+
+@jax.jit
+def step(x):
+    t0 = time.time()  # lint: ignore
+    return x + t0
+'''
+    assert run_lint(tmp_path, src) == []
+
+
+# --------------------------------------------------------- lock discipline
+
+def test_lck001_acquire_then_adjacent_try_finally_ok(tmp_path):
+    src = lock_discipline.CLEAN["LCK001"]
+    assert run_lint(tmp_path, src) == []
+
+
+def test_lck001_message_names_receiver(tmp_path):
+    found = run_lint(tmp_path, lock_discipline.OFFENDERS["LCK001"])
+    assert "LOCK.acquire()" in found[0]
+
+
+def test_lck002_nested_with_still_flagged(tmp_path):
+    src = '''
+import threading
+import subprocess
+
+class Refresher:
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+
+    def refresh(self):
+        with self._cache_lock:
+            if True:
+                subprocess.check_output(["kubectl", "get", "nodes"])
+'''
+    found = run_lint(tmp_path, src)
+    assert codes(found) == ["LCK002"] and "subprocess.check_output" in found[0]
+
+
+def test_lck002_nested_function_deferred_not_flagged(tmp_path):
+    src = '''
+import threading
+import time
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = []
+
+    def schedule(self):
+        with self._lock:
+            def job():
+                time.sleep(5)      # runs later, lock not held
+            self.jobs.append(job)
+'''
+    assert run_lint(tmp_path, src) == []
+
+
+def test_lck003_reports_unguarded_write_line(tmp_path):
+    found = run_lint(tmp_path, lock_discipline.OFFENDERS["LCK003"])
+    assert codes(found) == ["LCK003"]
+    assert "self.draining" in found[0] and "_lock" in found[0]
+
+
+def test_lck003_init_writes_exempt(tmp_path):
+    src = lock_discipline.CLEAN["LCK003"]
+    assert run_lint(tmp_path, src) == []
+
+
+# ------------------------------------------- STM001 (cross-file, mutated)
+
+STM_FILES = [state_machine.CONSTS_PATH, state_machine.STATE_PATH,
+             state_machine.METRICS_PATH, state_machine.DIAGRAM_PATH]
+
+
+def _stm_root(tmp_path, mutate=None):
+    """Copy the real state-machine files into a scratch root, optionally
+    mutating {relpath: fn(source) -> source}."""
+    root = tmp_path / "repo"
+    for rel in STM_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_stm001_real_repo_files_pass(tmp_path):
+    assert state_machine.run_project(_stm_root(tmp_path)) == []
+
+
+def test_stm001_deleted_handler_fails_naming_it(tmp_path):
+    """Disabling process_drain_nodes must fail twice: the state loses its
+    handler, and apply_state still calls the now-missing method."""
+    root = _stm_root(tmp_path, mutate={
+        state_machine.STATE_PATH: lambda s: s.replace(
+            "def process_drain_nodes", "def _disabled_drain_nodes")})
+    findings = state_machine.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings, "deleting a handler must fail the pass"
+    assert "DRAIN_REQUIRED" in msgs and "no process_* handler" in msgs
+    assert "process_drain_nodes" in msgs  # the dangling call site
+
+
+def test_stm001_fake_state_fails_every_facet(tmp_path):
+    root = _stm_root(tmp_path, mutate={
+        state_machine.CONSTS_PATH: lambda s: s.replace(
+            '    FAILED = "upgrade-failed"',
+            '    FAILED = "upgrade-failed"\n    LIMBO = "limbo-required"')})
+    findings = state_machine.run_project(root)
+    msgs = [m for (_, _, _, m) in findings]
+    assert any("LIMBO" in m and "no process_* handler" in m for m in msgs)
+    assert any("LIMBO" in m and "UpgradeState.ALL" in m for m in msgs)
+    assert any("LIMBO" in m and "metrics" in m for m in msgs)
+    assert any("LIMBO" in m and "diagram" in m for m in msgs)
+
+
+def test_stm001_state_dropped_from_all_is_caught(tmp_path):
+    """ALL is the manually-maintained closure metrics iterate — a member
+    silently removed from it must fail."""
+    root = _stm_root(tmp_path, mutate={
+        state_machine.CONSTS_PATH: lambda s: s.replace(
+            "VALIDATION_REQUIRED, UNCORDON_REQUIRED, DONE, FAILED)",
+            "VALIDATION_REQUIRED, UNCORDON_REQUIRED, DONE)")})
+    findings = state_machine.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "FAILED" in msgs and "UpgradeState.ALL" in msgs
+
+
+# ------------------------------------------------- ARC001 (fake packages)
+
+ARC_LAYERS = {"utils": set(), "core": {"utils"}, "models": {"core"}}
+
+
+def _arc_root(tmp_path, files):
+    root = tmp_path / "arc"
+    for rel, src in files.items():
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_arc001_clean_tree_silent(tmp_path):
+    root = _arc_root(tmp_path, {
+        "pkg/__init__.py": "from .models.m import M\n",
+        "pkg/utils/__init__.py": "",
+        "pkg/utils/u.py": "X = 1\n",
+        "pkg/core/__init__.py": "",
+        "pkg/core/c.py": "from ..utils.u import X\n",
+        "pkg/models/__init__.py": "",
+        "pkg/models/m.py": "from ..core.c import X\nM = X\n",
+    })
+    assert layering.run_project(root, package="pkg", layers=ARC_LAYERS) == []
+
+
+def test_arc001_layer_violation_fires(tmp_path):
+    root = _arc_root(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/core/__init__.py": "",
+        "pkg/core/c.py": "from ..models.m import M\n",
+        "pkg/models/__init__.py": "",
+        "pkg/models/m.py": "M = 1\n",
+        "pkg/utils/__init__.py": "",
+    })
+    findings = layering.run_project(root, package="pkg", layers=ARC_LAYERS)
+    assert len(findings) == 1
+    rel, lineno, code, msg = findings[0]
+    assert code == "ARC001" and rel.endswith("core/c.py")
+    assert "core may not import models" in msg
+
+
+def test_arc001_cycle_fires_even_when_layer_legal(tmp_path):
+    root = _arc_root(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/core/__init__.py": "",
+        "pkg/core/a.py": "from .b import Y\nX = 1\n",
+        "pkg/core/b.py": "from .a import X\nY = 2\n",
+    })
+    findings = layering.run_project(root, package="pkg", layers=ARC_LAYERS)
+    assert len(findings) == 1
+    assert "import cycle" in findings[0][3]
+    assert "pkg.core.a" in findings[0][3] and "pkg.core.b" in findings[0][3]
+
+
+def test_arc001_type_checking_imports_exempt(tmp_path):
+    root = _arc_root(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/core/__init__.py": "",
+        "pkg/core/c.py": ("from typing import TYPE_CHECKING\n"
+                          "if TYPE_CHECKING:\n"
+                          "    from ..models.m import M\n"),
+        "pkg/models/__init__.py": "",
+        "pkg/models/m.py": "M = 1\n",
+    })
+    assert layering.run_project(root, package="pkg", layers=ARC_LAYERS) == []
+
+
+def test_arc001_real_repo_layers_match_declared_dag():
+    assert layering.run_project(REPO) == []
+
+
+# ------------------------------------------------------------- CLI surface
+
+def test_python_m_tools_lint_domain_clean():
+    out = subprocess.run([sys.executable, "-m", "tools.lint", "--domain"],
+                         cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_shim_and_package_agree(tmp_path):
+    """`python tools/lint.py <file>` (the historical entry) and the
+    package produce identical findings."""
+    f = tmp_path / "case.py"
+    f.write_text(jax_hygiene.OFFENDERS["JAX001"])
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), str(f)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 1
+    assert [line for line in out.stdout.splitlines() if line] == \
+        lint.lint_file(f)
+
+
+def test_generic_mode_skips_domain_codes(tmp_path):
+    f = tmp_path / "case.py"
+    f.write_text(lock_discipline.OFFENDERS["LCK002"])
+    assert lint.lint_file(f, domain=False) == []
+    assert codes(lint.lint_file(f, domain=True)) == ["LCK002"]

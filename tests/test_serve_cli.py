@@ -182,3 +182,56 @@ def test_stepper_crash_fails_safe(server):
     assert rt.submit([1], 2) is None
     code, _ = _post(base, "/generate", {"tokens": [1], "max_new": 2})
     assert code == 503
+
+
+class _FakeHTTPD:
+    def __init__(self):
+        self.shut_down = False
+
+    def shutdown(self):
+        self.shut_down = True
+
+
+def test_drain_deadline_bounds_dead_client(server, caplog):
+    """A client that submits and then dies never pops its result, so
+    delivered() stays False forever — the SIGTERM drain must hit the
+    --grace deadline, log the undelivered request id, and still tear the
+    server down instead of spinning until kubelet SIGKILLs it."""
+    import logging
+    import time as _time
+    mod, rt, base = server
+
+    sub = rt.submit([1, 2, 3], 2)
+    assert sub is not None
+    rid, _ev = sub               # dead client: never waits, never pops
+    # let the decode finish so only DELIVERY is outstanding
+    for _ in range(600):
+        if rt.idle():
+            break
+        _time.sleep(0.05)
+    assert rt.idle() and not rt.delivered()
+    assert rid in rt.undelivered()
+
+    httpd = _FakeHTTPD()
+    t0 = _time.monotonic()
+    with caplog.at_level(logging.WARNING, logger="tpu-serve"):
+        mod.drain_then_shutdown(rt, httpd, grace=1.0, poll=0.01,
+                                settle=0.05)
+    assert _time.monotonic() - t0 < 10.0, "drain did not respect deadline"
+    assert httpd.shut_down
+    warned = " ".join(r.getMessage() for r in caplog.records)
+    assert "drain deadline" in warned and str(rid) in warned
+
+
+def test_drain_clean_exit_no_deadline_warning(server, caplog):
+    """With nothing outstanding the bounded drain exits promptly through
+    the settle path — no deadline warning, shutdown still called."""
+    import logging
+    mod, rt, base = server
+    httpd = _FakeHTTPD()
+    with caplog.at_level(logging.WARNING, logger="tpu-serve"):
+        mod.drain_then_shutdown(rt, httpd, grace=30.0, poll=0.01,
+                                settle=0.05)
+    assert httpd.shut_down
+    assert "drain deadline" not in " ".join(
+        r.getMessage() for r in caplog.records)

@@ -1,0 +1,137 @@
+"""tools.lint — the repo's static-analysis suite, stdlib-only.
+
+A check-registry plugin architecture (see :mod:`.registry`): each check
+module registers its codes and a run hook, and importing this package
+assembles the suite — the Python analog of the reference repo's
+golangci-lint config enabling ~50 linters from one file.
+
+Passes:
+
+- :mod:`.core`            — the 16 generic pyflakes-class codes
+                            (F821/F401/F811/F841/B006/E722/F541/F601/
+                            E712/F632/F631/F602/W605/W0101/A001/A002)
+- :mod:`.jax_hygiene`     — JAX001–JAX004 jit purity / host-sync
+- :mod:`.lock_discipline` — LCK001–LCK003 threading lock invariants
+- :mod:`.state_machine`   — STM001 upgrade-state-machine exhaustiveness
+- :mod:`.layering`        — ARC001 import layering + cycle rejection
+
+Usage::
+
+    python tools/lint.py [paths...]        # everything (generic + domain)
+    python -m tools.lint --generic [...]   # make lint
+    python -m tools.lint --domain  [...]   # make lint-domain
+
+Exit 1 on any finding. Suppress a single finding by appending
+``# lint: ignore`` (or ``# noqa``) to its line. Project-scope passes
+(STM/ARC) run against the repo root whenever domain checks are enabled
+and no explicit path arguments narrow the run. docs/static-analysis.md
+documents every code and how to add a check.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+from .registry import REGISTRY, Check, FileContext, all_codes, register
+from . import core, jax_hygiene, lock_discipline, state_machine, layering  # noqa: F401  (registration imports)
+from .core import BUILTINS, Checker, Scope  # noqa: F401  (compat re-exports)
+
+__all__ = ["lint_file", "lint_project", "main", "REGISTRY", "Check",
+           "register", "all_codes", "Checker", "Scope", "BUILTINS"]
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+DEFAULT_TARGETS = ["k8s_operator_libs_tpu", "cmd", "tools", "tests",
+                   "bench.py", "__graft_entry__.py"]
+
+
+def _suppressed(lines: List[str], lineno: int) -> bool:
+    if 0 < lineno <= len(lines):
+        line = lines[lineno - 1]
+        return "# lint: ignore" in line or "# noqa" in line
+    return False
+
+
+def lint_file(path: Path, domain: bool = True,
+              generic: bool = True) -> List[str]:
+    """Run the file-scope checks over one file → formatted findings."""
+    path = Path(path)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: E999 syntax error: {exc.msg}"]
+    ctx = FileContext(path=str(path), tree=tree, lines=source.splitlines(),
+                      source=source)
+    findings = []
+    for check in REGISTRY:
+        if check.scope != "file":
+            continue
+        if (check.domain and not domain) or (not check.domain
+                                             and not generic):
+            continue
+        findings.extend(check.run(ctx))
+    return [f"{path}:{lineno}: {code} {msg}"
+            for lineno, code, msg in sorted(findings)
+            if not _suppressed(ctx.lines, lineno)]
+
+
+def lint_project(root: Path = REPO_ROOT) -> List[str]:
+    """Run the project-scope (cross-file) passes → formatted findings."""
+    root = Path(root)
+    out: List[str] = []
+    for check in REGISTRY:
+        if check.scope != "project":
+            continue
+        for rel, lineno, code, msg in check.run(root):
+            try:
+                lines = (root / rel).read_text().splitlines()
+            except OSError:
+                lines = []
+            if _suppressed(lines, lineno):
+                continue
+            out.append(f"{rel}:{lineno}: {code} {msg}")
+    return sorted(out)
+
+
+def _collect(targets: List[str]) -> List[Path]:
+    files: List[Path] = []
+    for t in targets:
+        p = Path(t)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return [f for f in files if "__pycache__" not in f.parts]
+
+
+def main(argv: List[str]) -> int:
+    mode = "all"
+    paths: List[str] = []
+    for a in argv:
+        if a in ("--generic", "--generic-only"):
+            mode = "generic"
+        elif a in ("--domain", "--domain-only"):
+            mode = "domain"
+        elif a == "--codes":
+            for code, desc in sorted(all_codes().items()):
+                print(f"{code}  {desc}")
+            return 0
+        else:
+            paths.append(a)
+    files = _collect(paths or DEFAULT_TARGETS)
+    problems: List[str] = []
+    for f in files:
+        problems.extend(lint_file(f, domain=(mode != "generic"),
+                                  generic=(mode != "domain")))
+    # project passes: repo mode only (no explicit path narrowing)
+    if mode != "generic" and not paths:
+        problems.extend(lint_project(REPO_ROOT))
+    for p in problems:
+        print(p)
+    print(f"lint[{mode}]: {len(files)} files, {len(problems)} findings",
+          file=sys.stderr)
+    return 1 if problems else 0

@@ -1,0 +1,8 @@
+"""`python -m tools.lint` entry point (see package docstring for flags)."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,399 @@
+"""JAX001–JAX004: jit-hygiene — impurity and host-sync inside traced code.
+
+Code captured by ``jax.jit`` / ``jax.shard_map`` / ``pl.pallas_call`` runs
+ONCE at trace time; side effects silently stop repeating, host RNG freezes
+into the compiled program, and host-sync calls stall the device pipeline on
+every step. These are this repo's most expensive bug class (the decode scan
+and the train steps are all jitted), and no generic linter sees them:
+
+  JAX001  side-effecting call (print/open/input, time.*) inside a traced
+          function — executes at trace time only, then never again
+  JAX002  host RNG (random.* / np.random.*) inside a traced function —
+          the "random" draw is baked into the compiled program as a
+          constant; use jax.random with an explicit key
+  JAX003  host sync inside a traced function: ``.item()``, or
+          ``float()/int()/bool()/np.asarray()/np.array()`` applied to a
+          traced parameter — forces a device→host transfer (and under
+          trace, a ConcretizationTypeError)
+  JAX004  ``global`` / ``nonlocal`` write escaping a traced function —
+          the write happens at trace time, not per call
+
+A function is "traced" when it is (a) decorated with ``@jax.jit`` /
+``@partial(jax.jit, ...)`` / a shard_map/pallas_call wrapper, (b) passed —
+by name, directly or through one ``partial(...)`` / alias hop — as the
+first argument of a ``jit`` / ``shard_map`` / ``pallas_call`` call in the
+same file (the wrapper-returning idiom: ``return jax.jit(train_step, ...)``
+in parallel/fsdp.py and parallel/long_context.py), or (c) lexically nested
+inside a traced function. Names listed in ``static_argnames`` are concrete
+Python values, not tracers, and are exempt from JAX003.
+
+Resolution is name-based and file-local by design: a callee defined
+elsewhere (or reached only through the call graph) is out of scope — the
+pass is precise on the idioms this repo uses rather than approximate on
+all of Python.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import annotate_parents, dotted, parents, walk_same_function
+from .registry import Check, FileContext, register
+
+CODES = {
+    "JAX001": "side-effecting call inside a jit/shard_map/pallas traced "
+              "function",
+    "JAX002": "host RNG inside a traced function (use jax.random)",
+    "JAX003": "host sync inside a traced function (.item()/float()/"
+              "np.asarray on traced values)",
+    "JAX004": "global/nonlocal write escaping a traced function",
+}
+
+TRACE_WRAPPERS = {"jit", "shard_map", "pallas_call"}
+SIDE_EFFECT_BUILTINS = {"print", "open", "input", "breakpoint"}
+TIME_FUNCS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+              "monotonic", "monotonic_ns", "sleep"}
+HOST_CASTS = {"float", "int", "bool"}
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_trace_wrapper(node: ast.AST) -> bool:
+    """jax.jit / jit / jax.experimental.shard_map.shard_map / pl.pallas_call
+    — any dotted chain whose last segment is a known tracer entry point."""
+    parts = dotted(node)
+    return parts is not None and parts[-1] in TRACE_WRAPPERS
+
+
+def _is_partial(node: ast.AST) -> bool:
+    parts = dotted(node)
+    return parts is not None and parts[-1] == "partial"
+
+
+def _static_argnames(keywords) -> Set[str]:
+    """Extract the static_argnames value from jit(...) keywords: a string
+    or a tuple/list of string constants."""
+    names: Set[str] = set()
+    for kw in keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            names.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    names.add(el.value)
+    return names
+
+
+class _Pass:
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        # every function/lambda node -> enclosing function (or None)
+        self.enclosing: Dict[ast.AST, Optional[ast.AST]] = {}
+        # (scope node or None for module) -> {name: def node}
+        self.defs: Dict[Optional[ast.AST], Dict[str, ast.AST]] = {}
+        # (scope, alias name) -> every target function name assigned to
+        # it, for the ``kernel = partial(fn, ...)`` / ``step = fn`` hop
+        # (a name bound in both arms of an if keeps BOTH targets)
+        self.aliases: Dict[Tuple[Optional[ast.AST], str], List[str]] = {}
+        # traced def -> static_argnames gathered from its registrations
+        self.traced: Dict[ast.AST, Set[str]] = {}
+        self.findings: List[Tuple[int, str, str]] = []
+
+    # ------------------------------------------------------------ indexing
+
+    def _scope_of(self, node: ast.AST) -> Optional[ast.AST]:
+        for p in parents(node):
+            if isinstance(p, FunctionNode):
+                return p
+        return None
+
+    def index(self) -> None:
+        annotate_parents(self.tree)
+        for node in ast.walk(self.tree):
+            if isinstance(node, FunctionNode):
+                scope = self._scope_of(node)
+                self.enclosing[node] = scope
+                if not isinstance(node, ast.Lambda):
+                    self.defs.setdefault(scope, {})[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = self._alias_target(node.value)
+                if target is not None:
+                    scope = self._scope_of(node)
+                    self.aliases.setdefault(
+                        (scope, node.targets[0].id), []).append(target)
+
+    @staticmethod
+    def _alias_target(value: ast.AST) -> Optional[str]:
+        """``x = fn`` or ``x = partial(fn, ...)`` → "fn" (one hop)."""
+        if isinstance(value, ast.Name):
+            return value.id
+        if isinstance(value, ast.Call) and _is_partial(value.func) \
+                and value.args and isinstance(value.args[0], ast.Name):
+            return value.args[0].id
+        return None
+
+    def _lookup_all(self, name: str, scope: Optional[ast.AST],
+                    hops: int = 2) -> List[ast.AST]:
+        """Resolve a function name through the lexical scope chain,
+        following at most ``hops`` alias indirections; every target a
+        conditional alias may point at is returned."""
+        s = scope
+        while True:
+            if name in self.defs.get(s, {}):
+                return [self.defs[s][name]]
+            targets = self.aliases.get((s, name))
+            if targets and hops > 0:
+                out: List[ast.AST] = []
+                for t in targets:
+                    out.extend(self._lookup_all(t, s, hops - 1))
+                return out
+            if s is None:
+                return []
+            s = self.enclosing.get(s)
+
+    # ------------------------------------------------------- trace roots
+
+    def _mark(self, fn: Optional[ast.AST], static: Set[str]) -> None:
+        if fn is not None and isinstance(fn, FunctionNode):
+            self.traced.setdefault(fn, set()).update(static)
+
+    def find_traced(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_trace_wrapper(dec):
+                        self._mark(node, set())
+                    elif isinstance(dec, ast.Call):
+                        if _is_trace_wrapper(dec.func):
+                            # @jax.jit(...) decorator-factory form
+                            self._mark(node, _static_argnames(dec.keywords))
+                        elif _is_partial(dec.func) and dec.args \
+                                and _is_trace_wrapper(dec.args[0]):
+                            self._mark(node, _static_argnames(dec.keywords))
+            elif isinstance(node, ast.Call) and _is_trace_wrapper(node.func) \
+                    and node.args:
+                scope = self._scope_of(node)
+                arg = node.args[0]
+                static = _static_argnames(node.keywords)
+                if isinstance(arg, ast.Lambda):
+                    self._mark(arg, static)
+                elif isinstance(arg, ast.Name):
+                    for fn in self._lookup_all(arg.id, scope):
+                        self._mark(fn, static)
+                elif isinstance(arg, ast.Call) and _is_partial(arg.func) \
+                        and arg.args and isinstance(arg.args[0], ast.Name):
+                    for fn in self._lookup_all(arg.args[0].id, scope):
+                        self._mark(fn, static)
+        # lexical nesting: a def inside a traced def is traced too (its
+        # params are tracers; it has no static_argnames of its own)
+        changed = True
+        while changed:
+            changed = False
+            for fn, scope in self.enclosing.items():
+                if fn not in self.traced and scope in self.traced:
+                    self.traced[fn] = set()
+                    changed = True
+
+    # ----------------------------------------------------------- checking
+
+    @staticmethod
+    def _param_names(fn: ast.AST) -> Set[str]:
+        a = fn.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def report(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append((node.lineno, code, msg))
+
+    def check_function(self, fn: ast.AST, static: Set[str]) -> None:
+        traced_params = self._param_names(fn) - static
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in walk_same_function(stmt):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kw = ("global" if isinstance(node, ast.Global)
+                          else "nonlocal")
+                    self.report(node, "JAX004",
+                                f"{kw} write inside a traced function "
+                                "happens at trace time, not per call")
+                elif isinstance(node, ast.Call):
+                    self._check_call(node, traced_params)
+
+    def _check_call(self, node: ast.Call, traced_params: Set[str]) -> None:
+        func = node.func
+        parts = dotted(func)
+        if isinstance(func, ast.Name):
+            if func.id in SIDE_EFFECT_BUILTINS:
+                self.report(node, "JAX001",
+                            f"{func.id}() inside a traced function runs at "
+                            "trace time only (use jax.debug.print / "
+                            "jax.debug.callback)")
+                return
+            if func.id in HOST_CASTS and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in traced_params:
+                self.report(node, "JAX003",
+                            f"{func.id}({node.args[0].id}) forces host sync "
+                            "on a traced value (mark it static or keep it "
+                            "on device)")
+                return
+        if not parts:
+            # method calls on non-trivial receivers: still catch .item()
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args:
+                self.report(node, "JAX003",
+                            ".item() forces a device→host sync inside a "
+                            "traced function")
+            return
+        if parts[-1] == "item" and not node.args:
+            self.report(node, "JAX003",
+                        ".item() forces a device→host sync inside a "
+                        "traced function")
+        elif len(parts) == 2 and parts[0] == "time" \
+                and parts[1] in TIME_FUNCS:
+            self.report(node, "JAX001",
+                        f"time.{parts[1]}() inside a traced function is "
+                        "evaluated once at trace time")
+        elif len(parts) == 2 and parts[0] == "random":
+            self.report(node, "JAX002",
+                        f"random.{parts[1]}() inside a traced function "
+                        "bakes one host draw into the compiled program "
+                        "(use jax.random)")
+        elif len(parts) >= 3 and parts[0] in ("np", "numpy") \
+                and parts[1] == "random":
+            self.report(node, "JAX002",
+                        f"{'.'.join(parts)}() inside a traced function "
+                        "bakes one host draw into the compiled program "
+                        "(use jax.random)")
+        elif len(parts) == 2 and parts[0] in ("np", "numpy") \
+                and parts[1] in ("asarray", "array") and node.args \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in traced_params:
+            self.report(node, "JAX003",
+                        f"{'.'.join(parts)}({node.args[0].id}) "
+                        "materializes a traced value on the host (use "
+                        "jnp.asarray or keep it traced)")
+
+    def run(self) -> List[Tuple[int, str, str]]:
+        self.index()
+        self.find_traced()
+        for fn, static in self.traced.items():
+            self.check_function(fn, static)
+        return self.findings
+
+
+def _run(ctx: FileContext) -> List[Tuple[int, str, str]]:
+    return _Pass(ctx.tree).run()
+
+
+register(Check(name="jax-hygiene", codes=CODES, scope="file", run=_run,
+               domain=True))
+
+
+# ------------------------------------------------------- self-test fixtures
+# Replayed by tests/test_lint_domain.py: every code must fire on its
+# offender and stay silent on the clean idiom.
+
+OFFENDERS = {
+    "JAX001": '''
+import jax
+import time
+
+@jax.jit
+def step(x):
+    print("tracing")
+    t0 = time.time()
+    return x + t0
+''',
+    "JAX002": '''
+import jax
+import random
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def noisy(x, n):
+    return x + random.random() + np.random.normal()
+''',
+    "JAX003": '''
+import jax
+import numpy as np
+
+def make_step():
+    def step(x, scale):
+        host = np.asarray(x)
+        return float(scale) * x.item() + host.sum()
+    return jax.jit(step)
+''',
+    "JAX004": '''
+import jax
+
+COUNTER = 0
+
+@jax.jit
+def step(x):
+    global COUNTER
+    COUNTER += 1
+    return x * 2
+''',
+}
+
+CLEAN = {
+    "JAX001": '''
+import jax
+import time
+
+def host_loop(x):
+    print("not traced")      # plain function: fine
+    return time.time()
+
+@jax.jit
+def step(x):
+    jax.debug.print("x={x}", x=x)
+    return x * 2
+''',
+    "JAX002": '''
+import jax
+
+@jax.jit
+def noisy(x, key):
+    return x + jax.random.normal(key, x.shape)
+''',
+    "JAX003": '''
+import jax
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnames=("cfg", "temperature"))
+def step(x, cfg, temperature):
+    if temperature == 0.0:    # static: concrete at trace time
+        return x * float(temperature)
+    return x * int(cfg)
+
+def host_side(batch):
+    return np.asarray(batch).sum()   # not traced: fine
+''',
+    "JAX004": '''
+import jax
+
+CALLS = 0
+
+def host_bump():              # not traced: global write is fine
+    global CALLS
+    CALLS += 1
+
+@jax.jit
+def step(x):
+    return x * 2
+''',
+}

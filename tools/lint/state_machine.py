@@ -1,0 +1,181 @@
+"""STM001: upgrade-state-machine exhaustiveness — the enum, the
+orchestrator, the metrics, and the docs diagram can never drift.
+
+``upgrade/consts.py`` declares the UpgradeState members;
+``upgrade/upgrade_state.py`` routes every state through a ``process_*``
+handler; ``upgrade/metrics.py`` exports a per-state gauge;
+``tools/gen_state_diagram.py`` draws the node. Four files, one state
+machine — the reference repo's PNG went stale exactly this way (its own
+docs flag it). This cross-file pass parses all four (AST only, no
+imports) and fails when any member of the enum is missing from any of
+the other three:
+
+- **handler**: the member must be consumed by a ``process_*`` method of
+  the manager class — either ``<state-arg>.bucket(UpgradeState.X)``
+  inside a ``process_*`` body, or ``UpgradeState.X`` passed to a
+  ``self.process_*(...)`` call (the UNKNOWN/DONE routing in ApplyState).
+  A ``self.process_*`` call naming a method that does not exist is also
+  an error (deleting the handler but not the call site).
+- **enum closure**: every member must appear in ``UpgradeState.ALL`` —
+  the manually-maintained tuple that metrics and consumers iterate.
+- **metrics**: covered either by an explicit ``UpgradeState.X`` reference
+  in metrics.py or by iterating ``UpgradeState.ALL`` (the current idiom;
+  ALL-membership is checked above, so iteration covers every member).
+- **diagram**: gen_state_diagram.py must reference ``UpgradeState.X`` or
+  spell the state's wire value as a string literal (the UNKNOWN state's
+  value is ``""``, drawn as the literal ``"unknown"``).
+
+Tuple-valued class attributes (ALL, IN_PROGRESS) are not states.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from .astutil import dotted
+from .registry import Check, register
+
+CODES = {
+    "STM001": "UpgradeState member missing a handler/metrics/diagram "
+              "registration",
+}
+
+CONSTS_PATH = "k8s_operator_libs_tpu/upgrade/consts.py"
+STATE_PATH = "k8s_operator_libs_tpu/upgrade/upgrade_state.py"
+METRICS_PATH = "k8s_operator_libs_tpu/upgrade/metrics.py"
+DIAGRAM_PATH = "tools/gen_state_diagram.py"
+
+Finding = Tuple[str, int, str, str]
+
+
+def _parse(root: Path, rel: str) -> ast.Module:
+    return ast.parse((root / rel).read_text(), filename=rel)
+
+
+def _enum_members(tree: ast.Module) -> Tuple[Dict[str, Tuple[str, int]],
+                                             Set[str]]:
+    """→ ({member: (wire value, lineno)}, {names inside the ALL tuple})."""
+    members: Dict[str, Tuple[str, int]] = {}
+    all_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "UpgradeState"):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                members[name] = (stmt.value.value, stmt.lineno)
+            elif name == "ALL" and isinstance(stmt.value, ast.Tuple):
+                for el in stmt.value.elts:
+                    parts = dotted(el)
+                    if parts:
+                        all_names.add(parts[-1])
+    return members, all_names
+
+
+def _member_refs(node: ast.AST) -> Set[str]:
+    """Every ``UpgradeState.X`` attribute access under ``node``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        parts = dotted(n) if isinstance(n, ast.Attribute) else None
+        if parts and len(parts) == 2 and parts[0] == "UpgradeState":
+            out.add(parts[1])
+    return out
+
+
+def _handler_coverage(tree: ast.Module) -> Tuple[Set[str], Set[str],
+                                                 List[Tuple[str, int]]]:
+    """→ (states consumed by a process_* handler, defined process_* names,
+    [(called-but-undefined process_* name, lineno)])."""
+    handled: Set[str] = set()
+    defined: Set[str] = set()
+    called: List[Tuple[str, int]] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name.startswith("process_"):
+                defined.add(method.name)
+                # a bucket() read inside a process_* body consumes the state
+                for n in ast.walk(method):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "bucket":
+                        for arg in n.args:
+                            handled |= _member_refs(arg)
+            # UpgradeState.X routed through a self.process_*(...) call
+            for n in ast.walk(method):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr.startswith("process_"):
+                    called.append((n.func.attr, n.lineno))
+                    for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                        handled |= _member_refs(arg)
+    missing_defs = [(name, lineno) for name, lineno in called
+                    if name not in defined]
+    return handled, defined, missing_defs
+
+
+def _diagram_coverage(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """→ (UpgradeState.X refs, every string literal in the generator)."""
+    literals: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            literals.add(n.value)
+    return _member_refs(tree), literals
+
+
+def run_project(root: Path) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+    consts = _parse(root, CONSTS_PATH)
+    members, all_names = _enum_members(consts)
+    if not members:
+        return [(CONSTS_PATH, 1, "STM001",
+                 "no UpgradeState string members found (parse drift?)")]
+
+    handled, _, missing_defs = _handler_coverage(_parse(root, STATE_PATH))
+    for name, lineno in missing_defs:
+        findings.append((STATE_PATH, lineno, "STM001",
+                         f"call to {name}() but no such process_* handler "
+                         "is defined"))
+
+    metrics_tree = _parse(root, METRICS_PATH)
+    metrics_refs = _member_refs(metrics_tree)
+    metrics_iterates_all = "ALL" in metrics_refs
+    diagram_refs, diagram_literals = _diagram_coverage(
+        _parse(root, DIAGRAM_PATH))
+
+    for name, (value, lineno) in sorted(members.items()):
+        if name not in handled:
+            findings.append((CONSTS_PATH, lineno, "STM001",
+                             f"state {name} ({value!r}) has no process_* "
+                             f"handler in {STATE_PATH}"))
+        if name not in all_names:
+            findings.append((CONSTS_PATH, lineno, "STM001",
+                             f"state {name} missing from UpgradeState.ALL "
+                             "(metrics and consumers iterate it)"))
+        if not (name in metrics_refs
+                or (metrics_iterates_all and name in all_names)):
+            findings.append((CONSTS_PATH, lineno, "STM001",
+                             f"state {name} has no metrics label in "
+                             f"{METRICS_PATH}"))
+        display = value or "unknown"
+        if not (name in diagram_refs or display in diagram_literals):
+            findings.append((CONSTS_PATH, lineno, "STM001",
+                             f"state {name} ({display!r}) has no node in "
+                             f"the state diagram ({DIAGRAM_PATH})"))
+    return findings
+
+
+register(Check(name="state-machine", codes=CODES, scope="project",
+               run=run_project, domain=True))
