@@ -11,8 +11,9 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
-.PHONY: all native test test-fast lint lint-domain cov-report cov-artifact \
-  bench dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+.PHONY: all native test test-fast test-health health-sim lint lint-domain \
+  cov-report cov-artifact bench dryrun apply-crds-dry clean \
+  $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -28,11 +29,18 @@ test:
 test-fast:  ## operator-library tests only (skips slow JAX compiles)
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_jax_stack.py
 
+test-health:  ## fleet-health subsystem tests (docs/fleet-health.md)
+	$(PYTHON) -m pytest tests/test_health.py tests/test_health_e2e.py -q
+
+health-sim:  ## replay the canned fault-injection scenario on the fake cluster
+	$(PYTHON) tools/health_sim.py
+
 lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — see docs/static-analysis.md) + import sanity
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
 	$(PYTHON) -m tools.lint --generic
 	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
 	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
+	  k8s_operator_libs_tpu.health, \
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 

@@ -43,6 +43,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: E402
+from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
+from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
 from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
     ManagedComponent, TPUOperator)
 from k8s_operator_libs_tpu.upgrade import metrics as metrics_mod  # noqa: E402
@@ -65,6 +67,18 @@ def load_components(path: str):
     if not comps:
         raise ValueError(f"{path}: no components defined")
     return comps
+
+
+def load_health(path: str):
+    """Optional top-level ``health:`` section → HealthOptions (None when
+    absent or explicitly disabled — the health subsystem is opt-in)."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    section = cfg.get("health")
+    if not section or section.get("enabled") is False:
+        return None
+    return HealthOptions.from_dict(section)
 
 
 def build_client(args, components):
@@ -139,16 +153,22 @@ class MetricsServer:
 
 def render_metrics(operator: TPUOperator, states) -> str:
     """Prometheus text from the states the tick just acted on — no second
-    round of apiserver LISTs per scrape interval."""
-    chunks = []
+    round of apiserver LISTs per scrape interval. Upgrade gauges for every
+    component are grouped into one exposition block (HELP/TYPE once per
+    metric family), followed by the fleet-health gauges when the health
+    subsystem is on."""
+    per_component = {}
     for comp in operator.components:
         state = states.get(comp.name)
         if state is None:
             continue
-        chunks.append(metrics_mod.render_prometheus(
-            comp.name, metrics_mod.collect(operator.managers[comp.name],
-                                           state)))
-    return "".join(chunks)
+        per_component[comp.name] = metrics_mod.collect(
+            operator.managers[comp.name], state)
+    text = metrics_mod.render_prometheus_multi(per_component)
+    if operator.last_health is not None:
+        text += health_metrics.render(operator.health_component,
+                                      operator.last_health)
+    return text
 
 
 def main(argv=None, stop=None, on_ready=None) -> int:
@@ -193,6 +213,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
 
     try:
         components = load_components(args.config)
+        health = load_health(args.config)
         client, recorder = build_client(args, components)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -205,7 +226,11 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                                 [args.ensure_crds])
         logger.info("bootstrapped %d CRDs", n)
 
-    operator = TPUOperator(client, components, recorder=recorder)
+    operator = TPUOperator(client, components, recorder=recorder,
+                           health=health)
+    if health is not None:
+        logger.info("fleet health monitoring on (repair component %s)",
+                    operator.health_component)
     stop = stop or threading.Event()
     elector = None
     cache_started = not args.leader_elect  # see build_client

@@ -20,6 +20,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
+from k8s_operator_libs_tpu.health import consts as health_consts  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.util import KeyFactory, parse_selector  # noqa: E402
 from k8s_operator_libs_tpu.tpu.topology import slice_info_for_node  # noqa: E402
@@ -70,12 +71,20 @@ def collect_status(client, component: str, namespace: str, selector):
         info = slice_info_for_node(node)
         pod_rev = pod.metadata.labels.get("controller-revision-hash", "?")
         want_rev = ds_hash.get(owner, "?") if owner else "(orphan)"
+        # health column degrades gracefully: "-" when the health subsystem
+        # never ran (no labels) or the node is simply healthy (labels
+        # removed); a quarantined node shows "<verdict>/Q"
+        quarantine = node.metadata.labels.get(health_consts.QUARANTINE_LABEL)
+        verdict = node.metadata.labels.get(health_consts.VERDICT_LABEL)
+        health = f"{quarantine}/Q" if quarantine else (verdict or "-")
         rows.append({
             "node": node.metadata.name,
             "state": node.metadata.labels.get(keys.state_label, "") or "unknown",
             "schedulable": not node.spec.unschedulable,
             "slice": (info.slice_id if info is not None and info.multi_host
                       else "-"),
+            "health": health,
+            "quarantined": quarantine is not None,
             "pod_revision": pod_rev,
             "target_revision": want_rev,
             "in_sync": pod_rev == want_rev,
@@ -84,9 +93,9 @@ def collect_status(client, component: str, namespace: str, selector):
 
 
 def render_table(component: str, rows) -> str:
-    headers = ("NODE", "STATE", "SCHED", "SLICE", "REVISION")
+    headers = ("NODE", "STATE", "SCHED", "SLICE", "HEALTH", "REVISION")
     table = [(r["node"], r["state"], "yes" if r["schedulable"] else "no",
-              r["slice"],
+              r["slice"], r["health"],
               r["pod_revision"] + ("" if r["in_sync"]
                                    else f" -> {r['target_revision']}"))
              for r in rows]
@@ -100,8 +109,9 @@ def render_table(component: str, rows) -> str:
     failed = sum(1 for r in rows if r["state"] == UpgradeState.FAILED)
     in_flight = sum(1 for r in rows if r["state"] not in
                     ("unknown", UpgradeState.DONE, UpgradeState.FAILED))
+    quarantined = sum(1 for r in rows if r["quarantined"])
     lines.append(f"{len(rows)} nodes: {done} done, {in_flight} in flight, "
-                 f"{failed} failed")
+                 f"{failed} failed, {quarantined} quarantined")
     return "\n".join(lines)
 
 

@@ -1,0 +1,91 @@
+"""Health-verdict enum and the fleet-health label/annotation/taint keys.
+
+Like the upgrade state machine's :mod:`..upgrade.consts`, everything the
+health subsystem persists lives in the cluster as node labels, annotations,
+and taints — the monitor itself holds only soft state (damping timers,
+counter baselines) that an operator restart may safely lose. Verdict strings
+are wire format (label values, metric label names, doc anchors) and must
+stay stable, like the upgrade-state strings.
+"""
+
+from __future__ import annotations
+
+
+class HealthVerdict:
+    """Per-node (and rolled-up per-slice) health verdict lattice.
+
+    Ordered by severity::
+
+        healthy < degraded < unhealthy-transient < unhealthy-persistent
+
+    - ``healthy``: no probe signal firing.
+    - ``degraded``: a signal is firing but has not yet survived the flap
+      damping window — observed, not yet actionable.
+    - ``unhealthy-transient``: a signal confirmed past damping; the node is
+      quarantined but given a chance to recover on its own.
+    - ``unhealthy-persistent``: confirmed signal outlived the persistence
+      window (or the probe marked it inherently persistent, e.g. HBM ECC);
+      the slice is handed to the upgrade state machine for repair.
+
+    A slice's verdict is the WORST member verdict — an ICI domain fails as a
+    unit (SURVEY §7.4), so one unhealthy host condemns the whole slice.
+    """
+
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    UNHEALTHY_TRANSIENT = "unhealthy-transient"
+    UNHEALTHY_PERSISTENT = "unhealthy-persistent"
+
+    ALL = (HEALTHY, DEGRADED, UNHEALTHY_TRANSIENT, UNHEALTHY_PERSISTENT)
+
+    # verdicts that put (or keep) a slice in quarantine
+    QUARANTINE = (UNHEALTHY_TRANSIENT, UNHEALTHY_PERSISTENT)
+
+    _SEVERITY = {HEALTHY: 0, DEGRADED: 1, UNHEALTHY_TRANSIENT: 2,
+                 UNHEALTHY_PERSISTENT: 3}
+
+    @classmethod
+    def worst(cls, verdicts) -> str:
+        """Fold member verdicts into the slice verdict (max severity)."""
+        out = cls.HEALTHY
+        for v in verdicts:
+            if cls._SEVERITY[v] > cls._SEVERITY[out]:
+                out = v
+        return out
+
+
+DOMAIN = "tpu.dev"
+
+# Label carrying the current non-healthy verdict (removed while healthy, so
+# an idle fleet generates zero label churn; cmd/status.py renders "-" for
+# both "healthy" and "health subsystem never ran").
+VERDICT_LABEL = f"{DOMAIN}/health"
+
+# Quarantine marker trio: label (verdict that caused it), NoSchedule taint
+# (belt-and-braces next to the cordon — tolerating workloads must still not
+# land on a sick slice), and a human-readable reason annotation.
+QUARANTINE_LABEL = f"{DOMAIN}/health-quarantine"
+QUARANTINE_TAINT_KEY = f"{DOMAIN}/health-quarantine"
+QUARANTINE_TAINT_EFFECT = "NoSchedule"
+QUARANTINE_REASON_ANNOTATION = f"{DOMAIN}/health.quarantine-reason"
+# Set when the node was ALREADY unschedulable at quarantine time (an admin's
+# maintenance cordon, or an in-flight upgrade): lifting quarantine must not
+# remove a cordon it did not create — the initial-state idiom of
+# upgrade/upgrade_state.py applied to the health subsystem.
+PRE_QUARANTINE_CORDON_ANNOTATION = f"{DOMAIN}/health.pre-quarantine-cordon"
+
+# Repair bookkeeping: the in-flight marker, the attempt counter feeding
+# exponential backoff, and the wall-clock stamp of the last injection
+# (wall time so the backoff survives operator restarts — utils/clock.py
+# ``Clock.wall``, never a bare time.time()).
+REPAIR_ANNOTATION = f"{DOMAIN}/health.repair"
+REPAIR_PENDING = "pending"
+REPAIR_ATTEMPTS_ANNOTATION = f"{DOMAIN}/health.repair-attempts"
+REPAIR_LAST_ANNOTATION = f"{DOMAIN}/health.repair-last"
+
+# Signal-source annotations a node agent (device-plugin sidecar, DaemonSet)
+# is expected to maintain; all optional — a fleet without an agent simply
+# has fewer probes firing.
+HEARTBEAT_ANNOTATION = f"{DOMAIN}/health.heartbeat"        # wall-clock seconds
+ICI_LINK_ERRORS_ANNOTATION = f"{DOMAIN}/health.ici-link-errors"  # cumulative
+HBM_ECC_ERRORS_ANNOTATION = f"{DOMAIN}/health.hbm-ecc-errors"    # cumulative

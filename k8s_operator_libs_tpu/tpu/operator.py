@@ -22,6 +22,8 @@ from typing import Dict, List, Optional
 
 from ..api.v1alpha1 import DriverUpgradePolicySpec
 from ..core.client import Client, EventRecorder
+from ..health.monitor import (FleetHealthMonitor, HealthOptions,
+                              HealthReport)
 from ..upgrade.groups import GroupPolicy
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from ..upgrade.util import KeyFactory
@@ -49,7 +51,8 @@ class TPUOperator:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  group_policy: Optional[GroupPolicy] = None,
-                 synchronous: bool = False):
+                 synchronous: bool = False,
+                 health: Optional[HealthOptions] = None):
         self.client = client
         self.components = components
         self.scheduler = SliceScheduler(client)
@@ -73,6 +76,25 @@ class TPUOperator:
                 # delete exactly the pods holding TPU chips before drain
                 mgr.with_pod_deletion_enabled(tpu_workload_deletion_filter)
             self.managers[comp.name] = mgr
+        # fleet health: probe → classify → quarantine → slice-atomic repair
+        # through one component's upgrade pipeline (docs/fleet-health.md);
+        # shares the slice grouper so health and upgrades agree on failure
+        # domains, and the repair component's KeyFactory so injected repairs
+        # ride the exact same state machine and availability budget
+        self.health_monitor: Optional[FleetHealthMonitor] = None
+        self.last_health: Optional[HealthReport] = None
+        self.health_component: Optional[str] = None
+        if health is not None:
+            repair_comp = next(
+                (c for c in components if c.name == health.component),
+                components[0])
+            self.health_component = repair_comp.name
+            self.health_monitor = FleetHealthMonitor(
+                client, all_keys[repair_comp.name],
+                namespace=repair_comp.namespace,
+                driver_labels=repair_comp.driver_labels,
+                grouper=TPUSliceGrouper(), recorder=recorder,
+                clock=clock or RealClock(), options=health)
 
     # ---------------------------------------------------------- workloads
 
@@ -109,6 +131,16 @@ class TPUOperator:
             except Exception:
                 logger.exception("upgrade reconcile failed for %s", comp.name)
                 states[comp.name] = None
+        # health tick AFTER the upgrade pass (its driver-pod restarts leave a
+        # DS-pod-count mismatch that BuildState refuses until the controller
+        # recreates the pod) and BEFORE placement (a slice quarantined this
+        # tick must not receive this tick's workloads)
+        if self.health_monitor is not None:
+            try:
+                self.last_health = self.health_monitor.tick()
+            except Exception:
+                logger.exception("health tick failed; upgrades and "
+                                 "placement continue")
         still_pending: List[TPUWorkload] = []
         for wl in self._pending:
             # per-workload isolation: one failing placement must not starve

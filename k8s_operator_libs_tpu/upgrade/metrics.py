@@ -9,10 +9,23 @@ serve them from its /metrics endpoint without extra dependencies.
 
 from __future__ import annotations
 
+import re
 from typing import Dict
 
 from .consts import UpgradeState
 from .upgrade_state import ClusterUpgradeState, ClusterUpgradeStateManager
+
+# Prometheus metric names admit [a-zA-Z_:][a-zA-Z0-9_:]* — the per-state
+# gauges carry state wire values like "upgrade-done", whose dashes must be
+# mapped to underscores or the exposition is invalid and scrapes drop it.
+_INVALID_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    name = _INVALID_METRIC_CHARS.sub("_", name)
+    if name and not re.match(r"[a-zA-Z_:]", name[0]):
+        name = "_" + name
+    return name
 
 
 def collect(mgr: ClusterUpgradeStateManager,
@@ -30,11 +43,27 @@ def collect(mgr: ClusterUpgradeStateManager,
     }
 
 
+def render_prometheus_multi(per_component: Dict[str, Dict[str, float]],
+                            prefix: str = "tpu_operator") -> str:
+    """Text exposition for several components sharing one metric family
+    set. HELP and TYPE are emitted once per metric name (the format forbids
+    repeating them), followed by one sample per component."""
+    names = sorted({name for metrics in per_component.values()
+                    for name in metrics})
+    lines = []
+    for name in names:
+        metric = sanitize_metric_name(f"{prefix}_{name}")
+        help_text = sanitize_metric_name(name).replace("_", " ")
+        lines.append(f"# HELP {metric} {help_text}")
+        lines.append(f"# TYPE {metric} gauge")
+        for component in sorted(per_component):
+            metrics = per_component[component]
+            if name in metrics:
+                lines.append(
+                    f'{metric}{{component="{component}"}} {metrics[name]}')
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def render_prometheus(component: str, metrics: Dict[str, float],
                       prefix: str = "tpu_operator") -> str:
-    lines = []
-    for name, value in sorted(metrics.items()):
-        metric = f"{prefix}_{name}"
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f'{metric}{{component="{component}"}} {value}')
-    return "\n".join(lines) + "\n"
+    return render_prometheus_multi({component: metrics}, prefix=prefix)

@@ -1,0 +1,556 @@
+"""Fleet-health unit tests: probes, classifier (damping / escalation /
+flap reset), remediator primitives (quarantine, budget, backoff, lift),
+and the health metrics' Prometheus exposition validity."""
+
+import re
+
+import pytest
+
+from k8s_operator_libs_tpu.core.objects import (ContainerStatus, Node,
+                                                NodeCondition, ObjectMeta,
+                                                Pod)
+from k8s_operator_libs_tpu.health import consts as hconsts
+from k8s_operator_libs_tpu.health.classifier import (ClassifierConfig,
+                                                     HealthClassifier,
+                                                     NodeHealth, SliceHealth)
+from k8s_operator_libs_tpu.health.consts import HealthVerdict
+from k8s_operator_libs_tpu.health.probes import (CounterProbe,
+                                                 DriverCrashLoopProbe,
+                                                 HeartbeatProbe,
+                                                 NodeConditionProbe, Signal,
+                                                 Snapshot, default_probes,
+                                                 run_probes)
+from k8s_operator_libs_tpu.health.remediation import (HealthRemediator,
+                                                      RemediationContext,
+                                                      RemediationPolicy)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+def make_node(name, annotations=None, ready=True, conditions=None):
+    node = Node(metadata=ObjectMeta(name=name, namespace="",
+                                    annotations=dict(annotations or {})))
+    node.status.conditions[0].status = "True" if ready else "False"
+    for c in conditions or []:
+        node.status.conditions.append(c)
+    return node
+
+
+def make_pod(name, node, ready=True, restarts=0, phase="Running"):
+    pod = Pod(metadata=ObjectMeta(name=name))
+    pod.spec.node_name = node
+    pod.status.phase = phase
+    pod.status.container_statuses = [
+        ContainerStatus(ready=ready, restart_count=restarts)]
+    return pod
+
+
+def snap(clock, nodes, pods=()):
+    by_node = {}
+    for p in pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    return Snapshot(nodes=nodes, pods_by_node=by_node, clock=clock)
+
+
+# ------------------------------------------------------------------ probes
+
+
+def test_crashloop_probe_fires_on_notready_restarts():
+    clock = FakeClock()
+    probe = DriverCrashLoopProbe(restart_threshold=3)
+    n = make_node("n0")
+    assert probe.observe(snap(clock, [n], [make_pod("p", "n0")])) == []
+    sigs = probe.observe(snap(clock, [n], [make_pod("p", "n0", ready=False,
+                                                    restarts=5)]))
+    assert len(sigs) == 1 and sigs[0].node == "n0"
+    assert "crash-looping" in sigs[0].message
+    assert not sigs[0].persistent_hint
+
+
+def test_crashloop_probe_delta_catches_flapping_ready_pod():
+    """A pod momentarily Ready between crashes still fires via the
+    restart-count delta; a recreated pod (new UID) starts a clean
+    baseline."""
+    clock = FakeClock()
+    probe = DriverCrashLoopProbe(restart_threshold=3)
+    pod = make_pod("p", "n0", ready=True, restarts=4)
+    n = make_node("n0")
+    assert probe.observe(snap(clock, [n], [pod])) == []  # baseline
+    pod.status.container_statuses[0].restart_count = 6
+    sigs = probe.observe(snap(clock, [n], [pod]))
+    assert len(sigs) == 1 and "restarting" in sigs[0].message
+    fresh = make_pod("p", "n0", ready=True, restarts=2)  # new UID
+    assert probe.observe(snap(clock, [n], [fresh])) == []
+
+
+def test_crashloop_probe_failed_phase():
+    clock = FakeClock()
+    probe = DriverCrashLoopProbe()
+    sigs = probe.observe(snap(clock, [make_node("n0")],
+                              [make_pod("p", "n0", phase="Failed")]))
+    assert len(sigs) == 1 and "Failed" in sigs[0].message
+
+
+def test_heartbeat_probe_staleness_and_absence():
+    clock = FakeClock(start=1000.0)
+    probe = HeartbeatProbe(stale_after_seconds=60.0)
+    silent = make_node("no-agent")  # never reported: NOT a signal
+    fresh = make_node("fresh", annotations={
+        hconsts.HEARTBEAT_ANNOTATION: "990.0"})
+    stale = make_node("stale", annotations={
+        hconsts.HEARTBEAT_ANNOTATION: "100.0"})
+    bad = make_node("bad", annotations={
+        hconsts.HEARTBEAT_ANNOTATION: "not-a-number"})
+    sigs = probe.observe(snap(clock, [silent, fresh, stale, bad]))
+    assert sorted(s.node for s in sigs) == ["bad", "stale"]
+    # time passing makes the fresh node stale too
+    clock.advance(120.0)
+    sigs = probe.observe(snap(clock, [fresh]))
+    assert [s.node for s in sigs] == ["fresh"]
+
+
+def test_node_condition_probe():
+    clock = FakeClock()
+    probe = NodeConditionProbe()
+    ok = make_node("ok")
+    not_ready = make_node("nr", ready=False)
+    pressured = make_node("mp", conditions=[
+        NodeCondition(type="MemoryPressure", status="True")])
+    sigs = probe.observe(snap(clock, [ok, not_ready, pressured]))
+    assert sorted(s.node for s in sigs) == ["mp", "nr"]
+
+
+def test_counter_probe_delta_absolute_and_hint():
+    clock = FakeClock()
+    probe = CounterProbe("hbm-ecc", hconsts.HBM_ECC_ERRORS_ANNOTATION,
+                         delta_threshold=2, absolute_threshold=100,
+                         persistent_hint=True)
+    n = make_node("n0", annotations={hconsts.HBM_ECC_ERRORS_ANNOTATION: "10"})
+    assert probe.observe(snap(clock, [n])) == []  # first obs = baseline only
+    n.metadata.annotations[hconsts.HBM_ECC_ERRORS_ANNOTATION] = "11"
+    assert probe.observe(snap(clock, [n])) == []  # below delta threshold
+    n.metadata.annotations[hconsts.HBM_ECC_ERRORS_ANNOTATION] = "14"
+    sigs = probe.observe(snap(clock, [n]))
+    assert len(sigs) == 1 and sigs[0].persistent_hint
+    n.metadata.annotations[hconsts.HBM_ECC_ERRORS_ANNOTATION] = "150"
+    sigs = probe.observe(snap(clock, [n]))
+    assert len(sigs) == 1 and "absolute" in sigs[0].message
+
+
+def test_run_probes_isolates_raising_probe():
+    class Boom(DriverCrashLoopProbe):
+        name = "boom"
+
+        def observe(self, snapshot):
+            raise RuntimeError("probe exploded")
+
+    clock = FakeClock()
+    n = make_node("n0")
+    signals, errors = run_probes(
+        [Boom(), NodeConditionProbe()],
+        snap(clock, [make_node("nr", ready=False), n]))
+    assert errors == ["boom"]
+    assert [s.node for s in signals] == ["nr"]
+
+
+def test_default_probes_cover_all_shipped_sources():
+    names = {p.name for p in default_probes()}
+    assert names == {"driver-crashloop", "heartbeat", "node-condition",
+                     "ici-link-errors", "hbm-ecc-errors"}
+
+
+# -------------------------------------------------------------- classifier
+
+
+def classify_once(classifier, firing, nodes):
+    return classifier.classify(
+        [Signal("probe", n) for n in firing], nodes)
+
+
+def test_damping_holds_fresh_signal_at_degraded_then_confirms():
+    clock = FakeClock()
+    cls = HealthClassifier(clock, ClassifierConfig(damping_seconds=60,
+                                                   persist_seconds=600))
+    nodes = [make_node("n0")]
+    assert classify_once(cls, ["n0"], nodes)["n0"].verdict == \
+        HealthVerdict.DEGRADED
+    clock.advance(61)
+    assert classify_once(cls, ["n0"], nodes)["n0"].verdict == \
+        HealthVerdict.UNHEALTHY_TRANSIENT
+
+
+def test_bouncing_signal_never_confirms():
+    """Flap damping: fire/clear cycles reset the window — the verdict
+    never escalates past degraded, no matter how long it bounces."""
+    clock = FakeClock()
+    cls = HealthClassifier(clock, ClassifierConfig(damping_seconds=60,
+                                                   persist_seconds=120))
+    nodes = [make_node("n0")]
+    for _ in range(50):  # 50 * 2 * 40s = over an hour of bouncing
+        v = classify_once(cls, ["n0"], nodes)["n0"].verdict
+        assert v == HealthVerdict.DEGRADED
+        clock.advance(40)
+        v = classify_once(cls, [], nodes)["n0"].verdict
+        assert v == HealthVerdict.HEALTHY
+        clock.advance(40)
+
+
+def test_confirmed_signal_escalates_to_persistent():
+    clock = FakeClock()
+    cls = HealthClassifier(clock, ClassifierConfig(damping_seconds=10,
+                                                   persist_seconds=100))
+    nodes = [make_node("n0")]
+    classify_once(cls, ["n0"], nodes)
+    clock.advance(11)
+    assert classify_once(cls, ["n0"], nodes)["n0"].verdict == \
+        HealthVerdict.UNHEALTHY_TRANSIENT
+    clock.advance(101)
+    assert classify_once(cls, ["n0"], nodes)["n0"].verdict == \
+        HealthVerdict.UNHEALTHY_PERSISTENT
+
+
+def test_persistent_hint_skips_transient_stage():
+    clock = FakeClock()
+    cls = HealthClassifier(clock, ClassifierConfig(damping_seconds=10,
+                                                   persist_seconds=10_000))
+    nodes = [make_node("n0")]
+    sig = [Signal("ecc", "n0", persistent_hint=True)]
+    assert cls.classify(sig, nodes)["n0"].verdict == HealthVerdict.DEGRADED
+    clock.advance(11)
+    assert cls.classify(sig, nodes)["n0"].verdict == \
+        HealthVerdict.UNHEALTHY_PERSISTENT
+
+
+def test_healthy_streak_accumulates_and_resets():
+    clock = FakeClock()
+    cls = HealthClassifier(clock, ClassifierConfig(damping_seconds=0))
+    nodes = [make_node("n0")]
+    assert classify_once(cls, [], nodes)["n0"].healthy_for == 0.0
+    clock.advance(30)
+    assert classify_once(cls, [], nodes)["n0"].healthy_for == \
+        pytest.approx(30.0)
+    classify_once(cls, ["n0"], nodes)  # unhealthy: streak resets
+    clock.advance(5)
+    assert classify_once(cls, [], nodes)["n0"].healthy_for == 0.0
+
+
+def test_slice_rollup_worst_member_wins():
+    from k8s_operator_libs_tpu.tpu.topology import (
+        GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL, GKE_TOPOLOGY_LABEL,
+        TPUSliceGrouper)
+    labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+              GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-a"}
+    nodes = [Node(metadata=ObjectMeta(name=f"h{i}", labels=dict(labels)))
+             for i in range(4)] + [make_node("solo")]
+    health = {f"h{i}": NodeHealth(node=f"h{i}",
+                                  verdict=HealthVerdict.HEALTHY)
+              for i in range(4)}
+    health["h2"] = NodeHealth(node="h2",
+                              verdict=HealthVerdict.UNHEALTHY_PERSISTENT)
+    health["solo"] = NodeHealth(node="solo", verdict=HealthVerdict.DEGRADED)
+    slices = HealthClassifier.rollup(health, nodes, TPUSliceGrouper())
+    by_key = {s.key: s for s in slices}
+    assert by_key["slice/pool-a"].verdict == \
+        HealthVerdict.UNHEALTHY_PERSISTENT
+    assert by_key["slice/pool-a"].node_names == ["h0", "h1", "h2", "h3"]
+    assert by_key["solo"].verdict == HealthVerdict.DEGRADED
+
+
+def test_worst_ordering_is_total():
+    assert HealthVerdict.worst(HealthVerdict.ALL) == \
+        HealthVerdict.UNHEALTHY_PERSISTENT
+    assert HealthVerdict.worst([]) == HealthVerdict.HEALTHY
+    assert HealthVerdict.worst(
+        [HealthVerdict.DEGRADED, HealthVerdict.UNHEALTHY_TRANSIENT]) == \
+        HealthVerdict.UNHEALTHY_TRANSIENT
+
+
+# -------------------------------------------------------------- remediator
+
+
+def make_ctx(cluster, pods_by_node=None):
+    nodes = {n.metadata.name: n
+             for n in cluster.client.direct().list_nodes()}
+    unavailable = sum(1 for n in nodes.values()
+                      if n.spec.unschedulable or not n.is_ready())
+    return RemediationContext(nodes=nodes,
+                              pods_by_node=dict(pods_by_node or {}),
+                              total_nodes=len(nodes),
+                              unavailable=unavailable)
+
+
+def slice_health(verdict, names, healthy_for=0.0):
+    return SliceHealth(key="slice/pool-a", verdict=verdict, members=[
+        NodeHealth(node=n, verdict=verdict, reasons=["probe: boom"],
+                   healthy_for=healthy_for) for n in names])
+
+
+@pytest.fixture
+def remediator(cluster, clock):
+    return HealthRemediator(
+        cluster.client, KeyFactory("libtpu"), recorder=cluster.recorder,
+        clock=clock,
+        policy=RemediationPolicy(recovery_seconds=30.0,
+                                 backoff_base_seconds=100.0,
+                                 backoff_max_seconds=400.0))
+
+
+def test_handlers_mapping_is_exhaustive(remediator):
+    """Runtime mirror of the STM001 lint facet: every verdict dispatches."""
+    assert set(remediator.handlers()) == set(HealthVerdict.ALL)
+
+
+def test_unknown_verdict_raises(remediator, cluster):
+    cluster.add_node("h0")
+    with pytest.raises(ValueError, match="no remediation handler"):
+        remediator.apply([slice_health("limbo", ["h0"])], make_ctx(cluster))
+
+
+def test_quarantine_sets_cordon_taint_label_reason(remediator, cluster):
+    for i in range(2):
+        cluster.add_node(f"h{i}")
+    sv = slice_health(HealthVerdict.UNHEALTHY_TRANSIENT, ["h0", "h1"])
+    actions = remediator.apply([sv], make_ctx(cluster))
+    assert actions.quarantined_slices == ["slice/pool-a"]
+    for name in ("h0", "h1"):
+        n = cluster.client.direct().get_node(name)
+        assert n.spec.unschedulable
+        assert n.metadata.labels[hconsts.QUARANTINE_LABEL] == \
+            HealthVerdict.UNHEALTHY_TRANSIENT
+        assert [t.key for t in n.spec.taints] == \
+            [hconsts.QUARANTINE_TAINT_KEY]
+        assert "boom" in n.metadata.annotations[
+            hconsts.QUARANTINE_REASON_ANNOTATION]
+    # idempotent: same verdict again touches nothing new
+    actions = remediator.apply([sv], make_ctx(cluster))
+    assert actions.quarantined_slices == []
+    assert any(e.reason == "FleetHealth" and "Quarantined" in e.message
+               for e in cluster.recorder.events)
+
+
+def test_quarantine_budget_defers_when_exhausted(cluster, clock):
+    rem = HealthRemediator(
+        cluster.client, KeyFactory("libtpu"), clock=clock,
+        policy=RemediationPolicy(max_unavailable="50%"))
+    for i in range(4):
+        cluster.add_node(f"h{i}")
+    cluster.add_node("down", unschedulable=True)  # budget: 3 of 5... 50%→3
+    # quarantining 4 healthy nodes would make 5 unavailable > ceil(2.5)=3
+    sv = slice_health(HealthVerdict.UNHEALTHY_TRANSIENT,
+                      [f"h{i}" for i in range(4)])
+    actions = rem.apply([sv], make_ctx(cluster))
+    assert actions.deferred_slices == ["slice/pool-a"]
+    assert all(not cluster.client.direct().get_node(f"h{i}").spec.unschedulable
+               for i in range(4))
+
+
+def test_lift_waits_for_streak_and_pipeline_then_uncordons(
+        remediator, cluster, clock):
+    keys = KeyFactory("libtpu")
+    for i in range(2):
+        cluster.add_node(f"h{i}")
+    remediator.apply([slice_health(HealthVerdict.UNHEALTHY_TRANSIENT,
+                                   ["h0", "h1"])], make_ctx(cluster))
+    # healthy but streak too short -> still quarantined
+    remediator.apply([slice_health(HealthVerdict.HEALTHY, ["h0", "h1"],
+                                   healthy_for=5.0)], make_ctx(cluster))
+    assert cluster.client.direct().get_node("h0").spec.unschedulable
+    # streak long enough but repair pipeline mid-flight -> still quarantined
+    cluster.client.direct().patch_node_metadata(
+        "h0", labels={keys.state_label: "drain-required"})
+    remediator.apply([slice_health(HealthVerdict.HEALTHY, ["h0", "h1"],
+                                   healthy_for=60.0)], make_ctx(cluster))
+    assert cluster.client.direct().get_node("h1").spec.unschedulable
+    # pipeline done -> lift: uncordon + labels/taints/annotations cleared
+    cluster.client.direct().patch_node_metadata(
+        "h0", labels={keys.state_label: "upgrade-done"})
+    actions = remediator.apply(
+        [slice_health(HealthVerdict.HEALTHY, ["h0", "h1"],
+                      healthy_for=60.0)], make_ctx(cluster))
+    assert actions.lifted_slices == ["slice/pool-a"]
+    for name in ("h0", "h1"):
+        n = cluster.client.direct().get_node(name)
+        assert not n.spec.unschedulable
+        assert hconsts.QUARANTINE_LABEL not in n.metadata.labels
+        assert n.spec.taints == []
+        assert hconsts.QUARANTINE_REASON_ANNOTATION not in \
+            n.metadata.annotations
+
+
+def test_lift_preserves_pre_existing_cordon(remediator, cluster):
+    cluster.add_node("h0", unschedulable=True)  # admin cordon predates us
+    cluster.add_node("h1")
+    sv = slice_health(HealthVerdict.UNHEALTHY_TRANSIENT, ["h0", "h1"])
+    remediator.apply([sv], make_ctx(cluster))
+    # escalation re-labels the already-quarantined slice; must NOT record
+    # our own h1 cordon as pre-existing
+    remediator.apply([slice_health(HealthVerdict.UNHEALTHY_PERSISTENT,
+                                   ["h0", "h1"])], make_ctx(cluster))
+    remediator.apply([slice_health(HealthVerdict.HEALTHY, ["h0", "h1"],
+                                   healthy_for=60.0)], make_ctx(cluster))
+    assert cluster.client.direct().get_node("h0").spec.unschedulable
+    assert not cluster.client.direct().get_node("h1").spec.unschedulable
+
+
+def test_repair_injection_and_backoff(cluster, clock):
+    keys = KeyFactory("libtpu")
+    rem = HealthRemediator(
+        cluster.client, keys, clock=clock,
+        policy=RemediationPolicy(backoff_base_seconds=100.0,
+                                 backoff_max_seconds=400.0))
+    for i in range(2):
+        cluster.add_node(f"h{i}")
+    sv = slice_health(HealthVerdict.UNHEALTHY_PERSISTENT, ["h0", "h1"])
+    actions = rem.apply([sv], make_ctx(cluster))
+    assert actions.repairs_injected == ["slice/pool-a"]
+    for name in ("h0", "h1"):
+        anns = cluster.client.direct().get_node(name).metadata.annotations
+        assert anns[keys.upgrade_requested_annotation] == "true"
+        assert anns[hconsts.REPAIR_ANNOTATION] == hconsts.REPAIR_PENDING
+        assert anns[hconsts.REPAIR_ATTEMPTS_ANNOTATION] == "1"
+    # already pending -> no double injection
+    assert rem.apply([sv], make_ctx(cluster)).repairs_injected == []
+    # repair completes (pipeline done, pending cleared), node sick again:
+    # backoff gates the second attempt until base*2^0=100s elapsed
+    for name in ("h0", "h1"):
+        cluster.client.direct().patch_node_metadata(
+            name, labels={keys.state_label: "upgrade-done"},
+            annotations={hconsts.REPAIR_ANNOTATION: None})
+    clock.advance(50)
+    assert rem.apply([sv], make_ctx(cluster)).repairs_injected == []
+    clock.advance(60)
+    actions = rem.apply([sv], make_ctx(cluster))
+    assert actions.repairs_injected == ["slice/pool-a"]
+    anns = cluster.client.direct().get_node("h0").metadata.annotations
+    assert anns[hconsts.REPAIR_ATTEMPTS_ANNOTATION] == "2"
+
+
+def test_injection_defers_to_inflight_rolling_upgrade(cluster, clock):
+    keys = KeyFactory("libtpu")
+    rem = HealthRemediator(cluster.client, keys, clock=clock)
+    cluster.add_node("h0")
+    cluster.client.direct().patch_node_metadata(
+        "h0", labels={keys.state_label: "drain-required"})
+    sv = slice_health(HealthVerdict.UNHEALTHY_PERSISTENT, ["h0"])
+    actions = rem.apply([sv], make_ctx(cluster))
+    assert actions.repairs_injected == []
+    anns = cluster.client.direct().get_node("h0").metadata.annotations
+    assert keys.upgrade_requested_annotation not in anns
+
+
+def test_driver_restart_waits_for_slice_quiesce(cluster, clock):
+    keys = KeyFactory("libtpu")
+    rem = HealthRemediator(cluster.client, keys, clock=clock)
+    for i in range(2):
+        cluster.add_node(f"h{i}")
+    bad = cluster.add_pod("drv-h0", "h0", namespace="kube-system",
+                          ready=False, restart_count=12)
+    ok = cluster.add_pod("drv-h1", "h1", namespace="kube-system")
+    pods = {"h0": [bad], "h1": [ok]}
+    sv = slice_health(HealthVerdict.UNHEALTHY_PERSISTENT, ["h0", "h1"])
+    for name in ("h0", "h1"):
+        cluster.client.direct().patch_node_metadata(
+            name, annotations={hconsts.REPAIR_ANNOTATION:
+                               hconsts.REPAIR_PENDING})
+    # h1 not yet drained: restart barrier holds, nothing deleted
+    cluster.client.direct().patch_node_metadata(
+        "h0", labels={keys.state_label: "pod-restart-required"})
+    cluster.client.direct().patch_node_metadata(
+        "h1", labels={keys.state_label: "drain-required"})
+    actions = rem.apply([sv], make_ctx(cluster, pods))
+    assert actions.driver_pods_restarted == []
+    # whole slice at/past the barrier: ONLY the failing pod is deleted
+    cluster.client.direct().patch_node_metadata(
+        "h1", labels={keys.state_label: "pod-restart-required"})
+    actions = rem.apply([sv], make_ctx(cluster, pods))
+    assert actions.driver_pods_restarted == ["drv-h0"]
+    remaining = [p.metadata.name for p in
+                 cluster.client.direct().list_pods(namespace="kube-system")]
+    assert remaining == ["drv-h1"]
+
+
+# ----------------------------------------------------------------- metrics
+
+METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def parse_exposition(text):
+    """→ ({metric: {component: value}}, helps, types); asserts basic
+    format validity like a Prometheus scraper would."""
+    samples, helps, types = {}, set(), set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[3] == "gauge"
+            types.add(parts[2])
+            continue
+        m = re.match(r'^([^{]+)\{component="([^"]+)"\} (\S+)$', line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, component, value = m.groups()
+        assert METRIC_NAME.match(name), f"invalid metric name {name!r}"
+        samples.setdefault(name, {})[component] = float(value)
+    return samples, helps, types
+
+
+def test_upgrade_metrics_names_sanitized_with_help(cluster, clock, keys):
+    from k8s_operator_libs_tpu.upgrade.metrics import collect, \
+        render_prometheus
+    from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+        ClusterUpgradeStateManager)
+    ds = cluster.add_daemonset("drv", namespace="kube-system",
+                               labels={"app": "drv"}, revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("drv-n0", "n0", namespace="kube-system", owner_ds=ds)
+    mgr = ClusterUpgradeStateManager(cluster.client, keys, clock=clock,
+                                     synchronous=True)
+    state = mgr.build_state("kube-system", {"app": "drv"})
+    text = render_prometheus("drv", collect(mgr, state))
+    samples, helps, types = parse_exposition(text)
+    # the state bucket names carried dashes; they must not reach the wire
+    assert "tpu_operator_nodes_in_state_upgrade_done" in samples
+    assert not any("-" in name for name in samples)
+    # every family has HELP + TYPE exactly once
+    assert helps == types == set(samples)
+
+
+def test_multi_component_exposition_unique_help_type():
+    from k8s_operator_libs_tpu.upgrade.metrics import \
+        render_prometheus_multi
+    text = render_prometheus_multi(
+        {"libtpu": {"upgrades_done": 1}, "plugin": {"upgrades_done": 2}})
+    assert text.count("# HELP tpu_operator_upgrades_done") == 1
+    assert text.count("# TYPE tpu_operator_upgrades_done") == 1
+    samples, _, _ = parse_exposition(text)
+    assert samples["tpu_operator_upgrades_done"] == {"libtpu": 1.0,
+                                                     "plugin": 2.0}
+
+
+def test_health_metrics_per_verdict_gauges(cluster, clock):
+    from k8s_operator_libs_tpu.health import metrics as hmetrics
+    from k8s_operator_libs_tpu.health.monitor import (FleetHealthMonitor,
+                                                      HealthOptions)
+    cluster.add_node("n0")
+    cluster.add_pod("drv-n0", "n0", namespace="kube-system", ready=False,
+                    restart_count=12)
+    monitor = FleetHealthMonitor(
+        cluster.client, KeyFactory("drv"), namespace="kube-system",
+        driver_labels={}, clock=clock,
+        options=HealthOptions(classifier=ClassifierConfig(
+            damping_seconds=100.0)))
+    report = monitor.tick()
+    text = hmetrics.render("drv", report)
+    samples, helps, types = parse_exposition(text)
+    assert helps == types == set(samples)
+    assert not any("-" in name for name in samples)
+    # one degraded node (crashloop inside damping window), zero quarantined
+    assert samples["tpu_operator_health_nodes_verdict_degraded"]["drv"] == 1
+    assert samples["tpu_operator_health_nodes_verdict_healthy"]["drv"] == 0
+    assert samples["tpu_operator_health_quarantined_nodes"]["drv"] == 0
+    for v in HealthVerdict.ALL:
+        assert ("tpu_operator_health_nodes_verdict_"
+                + v.replace("-", "_")) in samples
+        assert ("tpu_operator_health_slices_verdict_"
+                + v.replace("-", "_")) in samples

@@ -305,6 +305,85 @@ def test_stm001_state_dropped_from_all_is_caught(tmp_path):
     assert "FAILED" in msgs and "UpgradeState.ALL" in msgs
 
 
+# ----------------------------------- STM001 health facet (cross-file, mutated)
+
+HEALTH_FILES = STM_FILES + [state_machine.HEALTH_CONSTS_PATH,
+                            state_machine.HEALTH_REMEDIATION_PATH,
+                            state_machine.HEALTH_METRICS_PATH,
+                            state_machine.HEALTH_DOC_PATH]
+
+
+def _health_root(tmp_path, mutate=None):
+    root = tmp_path / "repo"
+    for rel in HEALTH_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_stm001_health_real_repo_files_pass(tmp_path):
+    assert state_machine.run_project(_health_root(tmp_path)) == []
+
+
+def test_stm001_health_facet_skipped_without_health_package(tmp_path):
+    """Legacy fixture roots carrying only the upgrade machine still lint
+    (the real repo always has health/consts.py)."""
+    assert state_machine.run_project(_stm_root(tmp_path)) == []
+
+
+def test_stm001_health_deleted_handler_entry_fails(tmp_path):
+    """Removing a verdict's entry from the remediator's handlers() mapping
+    must fail naming the verdict."""
+    root = _health_root(tmp_path, mutate={
+        state_machine.HEALTH_REMEDIATION_PATH: lambda s: s.replace(
+            "            HealthVerdict.DEGRADED: self.process_degraded,\n",
+            "")})
+    findings = state_machine.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings, "deleting a handler entry must fail the pass"
+    assert "DEGRADED" in msgs and "no handler entry" in msgs
+
+
+def test_stm001_health_dangling_mapped_handler_fails(tmp_path):
+    """A verdict mapped to a process_* method that no longer exists is the
+    delete-the-method-not-the-mapping drift."""
+    root = _health_root(tmp_path, mutate={
+        state_machine.HEALTH_REMEDIATION_PATH: lambda s: s.replace(
+            "def process_degraded", "def _disabled_degraded")})
+    findings = state_machine.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "process_degraded" in msgs and "no such process_*" in msgs
+
+
+def test_stm001_health_fake_verdict_fails_every_facet(tmp_path):
+    root = _health_root(tmp_path, mutate={
+        state_machine.HEALTH_CONSTS_PATH: lambda s: s.replace(
+            '    UNHEALTHY_PERSISTENT = "unhealthy-persistent"',
+            '    UNHEALTHY_PERSISTENT = "unhealthy-persistent"\n'
+            '    LIMBO = "limbo-required"')})
+    findings = state_machine.run_project(root)
+    msgs = [m for (_, _, _, m) in findings]
+    assert any("LIMBO" in m and "no handler entry" in m for m in msgs)
+    assert any("LIMBO" in m and "HealthVerdict.ALL" in m for m in msgs)
+    assert any("LIMBO" in m and "metrics" in m for m in msgs)
+    assert any("LIMBO" in m and "fleet-health.md" in m for m in msgs)
+
+
+def test_stm001_health_undocumented_verdict_fails(tmp_path):
+    """Gutting docs/fleet-health.md must fail the doc facet for the
+    verdicts whose wire value disappears."""
+    root = _health_root(tmp_path, mutate={
+        state_machine.HEALTH_DOC_PATH:
+            lambda s: s.replace("unhealthy-persistent", "redacted")})
+    findings = state_machine.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "UNHEALTHY_PERSISTENT" in msgs and "not documented" in msgs
+
+
 # ------------------------------------------------- ARC001 (fake packages)
 
 ARC_LAYERS = {"utils": set(), "core": {"utils"}, "models": {"core"}}

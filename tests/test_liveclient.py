@@ -730,6 +730,78 @@ def test_operator_binary_leader_election(tmp_path):
     assert rcs[0] == [0] and rcs[1] == [0]
 
 
+def test_operator_standby_replica_healthz_stays_200(tmp_path):
+    """/healthz standby semantics: a NON-leader replica must answer 200
+    ("ok") — probes must not restart a hot spare — and must not flip to
+    503 while the leader (another process) is mid-reconcile holding the
+    lease. The standby also must not reconcile: no state labels appear."""
+    import threading
+    import time
+    import urllib.request
+
+    from k8s_operator_libs_tpu.core.objects import (Lease, LeaseSpec,
+                                                    ObjectMeta)
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+    op = _load_cli("operator")
+    cluster = FakeCluster()
+    _seed(cluster)
+    cluster.bump_daemonset_revision("libtpu", "tpu", "v2")  # work available
+    # a foreign leader holds the lease (renew_time is monotonic-clock
+    # seconds, matching LeaderElector's RealClock) and keeps renewing — the
+    # shape of a leader stuck in a long reconcile (e.g. a drain waiting out
+    # PDB retries): the lease stays live the whole time
+    cluster.create(Lease(
+        metadata=ObjectMeta(name="tpu-operator", namespace="tpu"),
+        spec=LeaseSpec(holder_identity="leader-elsewhere",
+                       lease_duration_seconds=15,
+                       acquire_time=time.monotonic(),
+                       renew_time=time.monotonic())))
+    srv = FakeAPIServer(cluster).start()
+    kc, cfg = _write_operator_env(tmp_path, srv.base_url)
+    stop = threading.Event()
+    captured = {}
+    rcs = []
+    t = threading.Thread(target=lambda: rcs.append(op.main(
+        ["--config", str(cfg), "--kubeconfig", str(kc),
+         "--interval", "0.1", "--metrics-port", "0", "--uncached",
+         "--leader-elect", "--leader-elect-identity", "standby-1"],
+        stop=stop, on_ready=lambda s: captured.update(server=s))))
+    t.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and not (
+                captured.get("server")
+                and captured["server"].snapshot["healthy"]):
+            time.sleep(0.05)
+        server = captured.get("server")
+        assert server is not None and server.snapshot["healthy"], \
+            "standby never reported healthy"
+        port = server.port
+        # probe /healthz repeatedly across several retry periods while the
+        # foreign leader renews mid-"reconcile": always 200, never 503
+        for _ in range(10):
+            lease = cluster.client.direct().get_lease("tpu", "tpu-operator")
+            lease.spec.renew_time = time.monotonic()
+            cluster.client.direct().update_lease(lease)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as r:
+                assert r.status == 200 and r.read() == b"ok"
+            time.sleep(0.1)
+        # the standby held back: the lease is untouched and no reconcile
+        # wrote upgrade-state labels despite pending version drift
+        lease = cluster.client.direct().get_lease("tpu", "tpu-operator")
+        assert lease.spec.holder_identity == "leader-elsewhere"
+        keys = KeyFactory("libtpu")
+        for node in cluster.client.direct().list_nodes():
+            assert keys.state_label not in node.metadata.labels
+    finally:
+        stop.set()
+        t.join(timeout=15)
+        srv.stop()
+    assert rcs == [0]
+
+
 def test_status_cli_reports_table_and_exit_codes(tmp_path, capsys):
     """cmd/status.py: per-node table + exit codes scripts can gate on
     (0 done, 3 in flight, 4 failed), over the live HTTP transport."""

@@ -127,6 +127,26 @@ def main(out_path: Optional[str] = None) -> None:
             parts.append(small_text(ax + BOX_W / 2 + 8, (ay + BOX_H + by) / 2 - 2,
                                     lines, anchor="start"))
 
+    # health-remediation entry (docs/fleet-health.md): an
+    # unhealthy-persistent slice is quarantined, then injected into THIS
+    # pipeline via the upgrade-requested annotation — repairs share the
+    # machine's slice-atomic admission and maxUnavailable budget
+    hx, hy = 30, FAIL_Y
+    parts.append(box(hx, hy, "health: quarantine", STATE_FILL, FAIL_EDGE))
+    parts.append(small_text(
+        hx + BOX_W / 2, hy + BOX_H + 18,
+        ["fleet-health verdict unhealthy-persistent:",
+         "slice cordoned + tainted, then upgrade-requested",
+         "on every member — repair rides this pipeline",
+         "(shared availability budget; docs/fleet-health.md)"]))
+    ux0, uy0 = pos[UpgradeState.UPGRADE_REQUIRED]
+    parts.append(
+        f'<path d="M {hx + BOX_W / 2} {hy} C {hx + BOX_W / 2} '
+        f'{uy0 + BOX_H + 60}, {ux0 + 30} {uy0 + BOX_H + 60}, '
+        f'{ux0 + 40} {uy0 + BOX_H + 4}" '
+        f'fill="none" stroke="{FAIL_EDGE}" stroke-width="1.2" '
+        'stroke-dasharray="5,4" marker-end="url(#arr)"/>')
+
     # failure state + edges
     fx, fy = 30 + 2 * (BOX_W + COL_GAP), FAIL_Y
     parts.append(box(fx, fy, UpgradeState.FAILED, FAIL_FILL, FAIL_EDGE))

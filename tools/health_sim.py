@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Replay a canned fault-injection scenario on the fake cluster.
+
+`make health-sim` — the health subsystem's smoke story, end to end and
+offline: a 4-host v5e slice plus two healthy single-host nodes, a
+device-plugin pod starts crash-looping on one host, and the full
+detect → quarantine → slice-atomic repair → recover loop runs on
+FakeCluster/FakeClock (docs/fleet-health.md). Prints a timeline of verdict,
+quarantine, and upgrade-state transitions; exits 0 only if the slice
+converges back to schedulable + healthy with the driver pod recreated.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: E402
+    DrainSpec, DriverUpgradePolicySpec)
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster  # noqa: E402
+from k8s_operator_libs_tpu.health import consts as hconsts  # noqa: E402
+from k8s_operator_libs_tpu.health.classifier import ClassifierConfig  # noqa: E402
+from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
+from k8s_operator_libs_tpu.health.remediation import RemediationPolicy  # noqa: E402
+from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
+    ManagedComponent, TPUOperator)
+from k8s_operator_libs_tpu.tpu.topology import (  # noqa: E402
+    GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL, GKE_TOPOLOGY_LABEL)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory  # noqa: E402
+from k8s_operator_libs_tpu.utils.clock import FakeClock  # noqa: E402
+
+NS = "kube-system"
+TICK = 15.0  # modelled seconds between reconcile ticks
+
+
+def build_fleet(cluster):
+    slice_labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                    GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-a"}
+    ds = cluster.add_daemonset("tpu-device-plugin", namespace=NS,
+                               labels={"app": "tpu-device-plugin"},
+                               revision_hash="v1")
+    hosts = [f"pool-a-h{i}" for i in range(4)]
+    for h in hosts:
+        cluster.add_node(h, labels=slice_labels)
+        cluster.add_pod(f"plugin-{h}", h, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+    for name in ("solo-0", "solo-1"):
+        cluster.add_node(name, labels={
+            GKE_ACCELERATOR_LABEL: "tpu-v5-lite-device",
+            GKE_TOPOLOGY_LABEL: "2x4", GKE_NODEPOOL_LABEL: name})
+        cluster.add_pod(f"plugin-{name}", name, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+    return hosts
+
+
+def main() -> int:
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock, cache_lag=0.5)
+    build_fleet(cluster)
+    keys = KeyFactory("tpu-device-plugin")
+
+    op = TPUOperator(
+        cluster.client,
+        components=[ManagedComponent(
+            name="tpu-device-plugin", namespace=NS,
+            driver_labels={"app": "tpu-device-plugin"},
+            policy=DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=0,
+                max_unavailable="100%",
+                drain=DrainSpec(enable=True, force=True,
+                                timeout_second=60)))],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        health=HealthOptions(
+            classifier=ClassifierConfig(damping_seconds=30.0,
+                                        persist_seconds=60.0),
+            policy=RemediationPolicy(recovery_seconds=45.0,
+                                     backoff_base_seconds=60.0)))
+
+    def snapshot():
+        nodes = {n.metadata.name: n
+                 for n in cluster.client.direct().list_nodes()}
+        return {h: (nodes[h].metadata.labels.get(hconsts.VERDICT_LABEL)
+                    or nodes[h].metadata.labels.get(hconsts.QUARANTINE_LABEL)
+                    or "healthy",
+                    "Q" if hconsts.QUARANTINE_LABEL
+                    in nodes[h].metadata.labels else "-",
+                    nodes[h].metadata.labels.get(keys.state_label, "") or "-",
+                    "cordoned" if nodes[h].spec.unschedulable else "open")
+                for h in sorted(nodes)}
+
+    print("== fault injection: plugin-pool-a-h0 starts crash-looping ==")
+    cluster.set_pod_status(NS, "plugin-pool-a-h0", ready=False,
+                           restart_count=12)
+
+    last = None
+    quarantined_seen = repaired_seen = False
+    for tick in range(120):
+        op.reconcile()
+        cluster.reconcile_daemonsets()
+        state = snapshot()
+        if state != last:
+            print(f"t={clock.now():7.1f}s")
+            for node, row in state.items():
+                print(f"   {node:12s} verdict={row[0]:22s} {row[1]:2s} "
+                      f"upgrade={row[2]:22s} {row[3]}")
+            last = state
+        report = op.last_health
+        if report and report.quarantined_slices:
+            quarantined_seen = True
+        if report and report.actions.driver_pods_restarted:
+            repaired_seen = True
+            print(f"t={clock.now():7.1f}s    driver pods restarted: "
+                  f"{report.actions.driver_pods_restarted}")
+        nodes = cluster.client.direct().list_nodes()
+        done = all(
+            not n.spec.unschedulable
+            and hconsts.QUARANTINE_LABEL not in n.metadata.labels
+            for n in nodes)
+        if quarantined_seen and repaired_seen and done:
+            pods = cluster.client.direct().list_pods(namespace=NS)
+            ready = all(cs.ready for p in pods
+                        for cs in p.status.container_statuses)
+            print(f"\n== converged at t={clock.now():.1f}s: slice "
+                  f"quarantined, repaired slice-atomically, uncordoned; "
+                  f"{len(pods)} driver pods, all ready={ready} ==")
+            return 0 if ready else 1
+        clock.advance(TICK)
+    print("\n== FAILED to converge ==", file=sys.stderr)
+    print(f"quarantined_seen={quarantined_seen} "
+          f"repaired_seen={repaired_seen}", file=sys.stderr)
+    for node, row in snapshot().items():
+        print(f"   {node}: {row}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

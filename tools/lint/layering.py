@@ -2,7 +2,7 @@
 
 The package has two dependency spines that must stay one-directional:
 
-    operator side:  utils/api  →  core  →  upgrade / crdutil  →  tpu
+    operator side:  utils/api  →  core  →  upgrade / crdutil  →  health  →  tpu
     model side:     ops        →  models / parallel  →  train
 
 ``LAYERS`` is the declared DAG: for each first-level subpackage (or
@@ -47,7 +47,8 @@ LAYERS: Dict[str, Set[str]] = {
     "core": {"utils", "api"},
     "crdutil": {"core", "utils", "api"},
     "upgrade": {"core", "utils", "api"},
-    "tpu": {"core", "utils", "api", "upgrade", "crdutil"},
+    "health": {"core", "utils", "api", "upgrade"},
+    "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health"},
     "data": {"utils"},
     "ops": {"utils"},
     "models": {"ops", "utils", "data"},

@@ -1,9 +1,11 @@
-"""STM001: upgrade-state-machine exhaustiveness — the enum, the
-orchestrator, the metrics, and the docs diagram can never drift.
+"""STM001: state-machine exhaustiveness — enums, handlers, metrics, and
+docs can never drift.
 
-``upgrade/consts.py`` declares the UpgradeState members;
-``upgrade/upgrade_state.py`` routes every state through a ``process_*``
-handler; ``upgrade/metrics.py`` exports a per-state gauge;
+Two state machines are covered, same code:
+
+**Upgrade pipeline.** ``upgrade/consts.py`` declares the UpgradeState
+members; ``upgrade/upgrade_state.py`` routes every state through a
+``process_*`` handler; ``upgrade/metrics.py`` exports a per-state gauge;
 ``tools/gen_state_diagram.py`` draws the node. Four files, one state
 machine — the reference repo's PNG went stale exactly this way (its own
 docs flag it). This cross-file pass parses all four (AST only, no
@@ -25,7 +27,26 @@ the other three:
   spell the state's wire value as a string literal (the UNKNOWN state's
   value is ``""``, drawn as the literal ``"unknown"``).
 
-Tuple-valued class attributes (ALL, IN_PROGRESS) are not states.
+**Health verdict lattice.** ``health/consts.py`` declares the
+HealthVerdict members; ``health/remediation.py`` dispatches every verdict
+to a handler through the ``handlers()`` mapping
+(``{HealthVerdict.X: self.process_*}``); ``health/metrics.py`` exports
+per-verdict gauges; ``docs/fleet-health.md`` documents each verdict's
+wire value. Every member needs all three:
+
+- **handler**: a ``HealthVerdict.X: self.process_*`` entry in the
+  remediator's dispatch mapping whose ``process_*`` method exists (a
+  mapped-but-undefined handler is also an error);
+- **metrics**: an explicit ``HealthVerdict.X`` reference in
+  health/metrics.py or iteration of ``HealthVerdict.ALL`` (plus
+  ALL-closure, as above);
+- **docs**: the wire value must appear in docs/fleet-health.md.
+
+The health facet is skipped when ``health/consts.py`` is absent, so
+fixture roots exercising only the upgrade machine still lint.
+
+Tuple-valued class attributes (ALL, IN_PROGRESS, QUARANTINE) and dunder
+or dict-valued members are not states.
 """
 
 from __future__ import annotations
@@ -47,6 +68,11 @@ STATE_PATH = "k8s_operator_libs_tpu/upgrade/upgrade_state.py"
 METRICS_PATH = "k8s_operator_libs_tpu/upgrade/metrics.py"
 DIAGRAM_PATH = "tools/gen_state_diagram.py"
 
+HEALTH_CONSTS_PATH = "k8s_operator_libs_tpu/health/consts.py"
+HEALTH_REMEDIATION_PATH = "k8s_operator_libs_tpu/health/remediation.py"
+HEALTH_METRICS_PATH = "k8s_operator_libs_tpu/health/metrics.py"
+HEALTH_DOC_PATH = "docs/fleet-health.md"
+
 Finding = Tuple[str, int, str, str]
 
 
@@ -54,14 +80,13 @@ def _parse(root: Path, rel: str) -> ast.Module:
     return ast.parse((root / rel).read_text(), filename=rel)
 
 
-def _enum_members(tree: ast.Module) -> Tuple[Dict[str, Tuple[str, int]],
-                                             Set[str]]:
+def _enum_members(tree: ast.Module, enum: str = "UpgradeState"
+                  ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
     """→ ({member: (wire value, lineno)}, {names inside the ALL tuple})."""
     members: Dict[str, Tuple[str, int]] = {}
     all_names: Set[str] = set()
     for node in ast.walk(tree):
-        if not (isinstance(node, ast.ClassDef)
-                and node.name == "UpgradeState"):
+        if not (isinstance(node, ast.ClassDef) and node.name == enum):
             continue
         for stmt in node.body:
             if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
@@ -79,12 +104,12 @@ def _enum_members(tree: ast.Module) -> Tuple[Dict[str, Tuple[str, int]],
     return members, all_names
 
 
-def _member_refs(node: ast.AST) -> Set[str]:
-    """Every ``UpgradeState.X`` attribute access under ``node``."""
+def _member_refs(node: ast.AST, enum: str = "UpgradeState") -> Set[str]:
+    """Every ``<enum>.X`` attribute access under ``node``."""
     out: Set[str] = set()
     for n in ast.walk(node):
         parts = dotted(n) if isinstance(n, ast.Attribute) else None
-        if parts and len(parts) == 2 and parts[0] == "UpgradeState":
+        if parts and len(parts) == 2 and parts[0] == enum:
             out.add(parts[1])
     return out
 
@@ -134,6 +159,82 @@ def _diagram_coverage(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
     return _member_refs(tree), literals
 
 
+def _health_handler_coverage(tree: ast.Module
+                             ) -> Tuple[Set[str], List[Tuple[str, int]]]:
+    """→ (verdicts with a dispatch-mapping handler entry,
+    [(mapped-but-undefined process_* name, lineno)]).
+
+    The remediator's exhaustiveness surface is its ``handlers()`` mapping:
+    ``{HealthVerdict.X: self.process_*}`` dict literals."""
+    defined: Set[str] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for method in cls.body:
+            if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defined.add(method.name)
+    mapped: Set[str] = set()
+    dangling: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            kparts = dotted(key) if isinstance(key, ast.Attribute) else None
+            if not (kparts and len(kparts) == 2
+                    and kparts[0] == "HealthVerdict"):
+                continue
+            vparts = dotted(value) if isinstance(value,
+                                                 ast.Attribute) else None
+            if not (vparts and vparts[-1].startswith("process_")):
+                continue
+            mapped.add(kparts[1])
+            if vparts[-1] not in defined:
+                dangling.append((vparts[-1], value.lineno))
+    return mapped, dangling
+
+
+def _health_findings(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    members, all_names = _enum_members(_parse(root, HEALTH_CONSTS_PATH),
+                                       enum="HealthVerdict")
+    if not members:
+        return [(HEALTH_CONSTS_PATH, 1, "STM001",
+                 "no HealthVerdict string members found (parse drift?)")]
+    mapped, dangling = _health_handler_coverage(
+        _parse(root, HEALTH_REMEDIATION_PATH))
+    for name, lineno in dangling:
+        findings.append((HEALTH_REMEDIATION_PATH, lineno, "STM001",
+                         f"handlers() maps a verdict to {name}() but no "
+                         "such process_* handler is defined"))
+    metrics_refs = _member_refs(_parse(root, HEALTH_METRICS_PATH),
+                                enum="HealthVerdict")
+    metrics_iterates_all = "ALL" in metrics_refs
+    doc_file = root / HEALTH_DOC_PATH
+    doc_text = doc_file.read_text() if doc_file.exists() else ""
+
+    for name, (value, lineno) in sorted(members.items()):
+        if name not in mapped:
+            findings.append((HEALTH_CONSTS_PATH, lineno, "STM001",
+                             f"verdict {name} ({value!r}) has no handler "
+                             f"entry in the handlers() mapping of "
+                             f"{HEALTH_REMEDIATION_PATH}"))
+        if name not in all_names:
+            findings.append((HEALTH_CONSTS_PATH, lineno, "STM001",
+                             f"verdict {name} missing from "
+                             "HealthVerdict.ALL (metrics and consumers "
+                             "iterate it)"))
+        if not (name in metrics_refs
+                or (metrics_iterates_all and name in all_names)):
+            findings.append((HEALTH_CONSTS_PATH, lineno, "STM001",
+                             f"verdict {name} has no metrics label in "
+                             f"{HEALTH_METRICS_PATH}"))
+        if value not in doc_text:
+            findings.append((HEALTH_CONSTS_PATH, lineno, "STM001",
+                             f"verdict {name} ({value!r}) is not "
+                             f"documented in {HEALTH_DOC_PATH}"))
+    return findings
+
+
 def run_project(root: Path) -> List[Finding]:
     root = Path(root)
     findings: List[Finding] = []
@@ -174,6 +275,11 @@ def run_project(root: Path) -> List[Finding]:
             findings.append((CONSTS_PATH, lineno, "STM001",
                              f"state {name} ({display!r}) has no node in "
                              f"the state diagram ({DIAGRAM_PATH})"))
+
+    # health-verdict facet — skipped for fixture roots that only carry the
+    # upgrade machine's files (the real repo always has health/consts.py)
+    if (root / HEALTH_CONSTS_PATH).exists():
+        findings.extend(_health_findings(root))
     return findings
 
 
