@@ -11,8 +11,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
-.PHONY: all native test test-fast test-health health-sim lint lint-domain \
-  cov-report cov-artifact bench dryrun apply-crds-dry clean \
+.PHONY: all native test test-fast test-health test-obs health-sim lint \
+  lint-domain cov-report cov-artifact bench dryrun apply-crds-dry clean \
   $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
@@ -32,6 +32,9 @@ test-fast:  ## operator-library tests only (skips slow JAX compiles)
 test-health:  ## fleet-health subsystem tests (docs/fleet-health.md)
 	$(PYTHON) -m pytest tests/test_health.py tests/test_health_e2e.py -q
 
+test-obs:  ## observability tests: tracing, journey, stuck detection, exposition validator (docs/observability.md)
+	$(PYTHON) -m pytest tests/test_obs.py tests/test_obs_metrics.py -q
+
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py
 
@@ -44,7 +47,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
-lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, ARC001 import layering (docs/static-analysis.md)
+lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, OBS001 journey closure, ARC001 import layering (docs/static-analysis.md)
 	$(PYTHON) -m tools.lint --domain
 
 COV_MIN ?= 80

@@ -42,9 +42,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
+from k8s_operator_libs_tpu import __version__  # noqa: E402
 from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: E402
 from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
+from k8s_operator_libs_tpu.obs import JsonlSink, MetricsHub, Tracer  # noqa: E402
 from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
     ManagedComponent, TPUOperator)
 from k8s_operator_libs_tpu.upgrade import metrics as metrics_mod  # noqa: E402
@@ -89,9 +91,9 @@ def build_client(args, components):
     Pod/DaemonSet informers are scoped to the component namespaces, never
     cluster-wide."""
     from k8s_operator_libs_tpu.core.cachedclient import CachedClient
+    from k8s_operator_libs_tpu.core.client import ClientEventRecorder
     from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
-                                                       LiveClient,
-                                                       LiveEventRecorder)
+                                                       LiveClient)
     kc = (KubeConfig.in_cluster() if args.in_cluster else
           KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
     http = KubeHTTP(kc)
@@ -106,7 +108,10 @@ def build_client(args, components):
         # with --leader-elect the informers start on first leadership win:
         # permanent standbys must not hold watch streams for caches nobody
         # reads (controller-runtime starts caches after winning, too)
-    return client, LiveEventRecorder(http)
+    # events go through the injected client (ClientEventRecorder falls back
+    # to direct() for the cached wrapper), so the same wiring records real
+    # Events in production and assertable ones under the fake apiserver
+    return client, ClientEventRecorder(client)
 
 
 class MetricsServer:
@@ -151,12 +156,13 @@ class MetricsServer:
         self._server.server_close()
 
 
-def render_metrics(operator: TPUOperator, states) -> str:
+def render_metrics(operator: TPUOperator, states, hub: MetricsHub) -> str:
     """Prometheus text from the states the tick just acted on — no second
     round of apiserver LISTs per scrape interval. Upgrade gauges for every
     component are grouped into one exposition block (HELP/TYPE once per
     metric family), followed by the fleet-health gauges when the health
-    subsystem is on."""
+    subsystem is on, then the obs families (duration histograms, stuck
+    gauges, build/leader identity) from the hub."""
     per_component = {}
     for comp in operator.components:
         state = states.get(comp.name)
@@ -168,6 +174,7 @@ def render_metrics(operator: TPUOperator, states) -> str:
     if operator.last_health is not None:
         text += health_metrics.render(operator.health_component,
                                       operator.last_health)
+    text += hub.render()
     return text
 
 
@@ -195,6 +202,10 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     p.add_argument("--metrics-port", type=int, default=8080,
                    help="/metrics + /healthz port (0 = ephemeral, "
                         "-1 = disabled)")
+    p.add_argument("--trace-log", default=None, metavar="PATH",
+                   help="append reconcile span records (one JSON object "
+                        "per line) to PATH — the Dapper-style tick trace "
+                        "(docs/observability.md)")
     p.add_argument("--ensure-crds", default=None, metavar="DIR",
                    help="apply CRDs from DIR before the first tick")
     p.add_argument("--leader-elect", action="store_true",
@@ -226,11 +237,22 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                                 [args.ensure_crds])
         logger.info("bootstrapped %d CRDs", n)
 
+    hub = MetricsHub()
+    tracer = Tracer(sink=JsonlSink(args.trace_log)) if args.trace_log \
+        else Tracer()
+    # identity metrics: dashboards tell replicas and builds apart even
+    # before the first reconcile (and on permanent standbys)
+    hub.set_gauge("build_info", 1.0, labels={
+        "version": __version__,
+        "components": ",".join(c.name for c in components)})
+    hub.set_gauge("leader", 0.0 if args.leader_elect else 1.0)
     operator = TPUOperator(client, components, recorder=recorder,
-                           health=health)
+                           health=health, tracer=tracer, metrics=hub)
     if health is not None:
         logger.info("fleet health monitoring on (repair component %s)",
                     operator.health_component)
+    if args.trace_log:
+        logger.info("tracing reconcile spans to %s", args.trace_log)
     stop = stop or threading.Event()
     elector = None
     cache_started = not args.leader_elect  # see build_client
@@ -331,8 +353,13 @@ def main(argv=None, stop=None, on_ready=None) -> int:
             t0 = time.monotonic()
             if elector is not None and not elector.is_leader:
                 # standby replica: stay healthy (probes must not restart a
-                # hot spare) but do not reconcile
+                # hot spare) but do not reconcile. It still serves its
+                # identity metrics — tpu_operator_leader 0 is how
+                # dashboards tell a hot spare from the leader (both
+                # replicas' /metrics used to be indistinguishable)
+                hub.set_gauge("leader", 0.0)
                 if server:
+                    server.snapshot["text"] = hub.render()
                     server.snapshot["healthy"] = True
                 stop.wait(min(args.interval, elector.retry_period))
                 continue
@@ -342,11 +369,13 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                 if hasattr(client, "start"):
                     client.start()
                 cache_started = True
+            hub.set_gauge("leader", 1.0)
             states = operator.reconcile()
             ticks += 1
             last_ok = all(s is not None for s in states.values())
             if server:
-                server.snapshot["text"] = render_metrics(operator, states)
+                server.snapshot["text"] = render_metrics(operator, states,
+                                                         hub)
                 # healthy = the last tick reconciled every component; an
                 # apiserver outage flips this off so k8s probes can restart us
                 server.snapshot["healthy"] = last_ok
@@ -374,6 +403,8 @@ def main(argv=None, stop=None, on_ready=None) -> int:
             server.stop()
         if hasattr(client, "stop"):  # CachedClient informers
             client.stop()
+        if isinstance(tracer.sink, JsonlSink):
+            tracer.sink.close()
         for sig, handler in prev_handlers.items():
             signal.signal(sig, handler)
     logger.info("exiting after %d ticks", ticks)

@@ -9,18 +9,28 @@ machine makes; no controller required to be running).
     python cmd/status.py --kubeconfig ~/.kube/config \
         --component libtpu --namespace kube-system --selector app=libtpu
 
+``--timeline <node>`` instead renders the node's full upgrade JOURNEY —
+every state it moved through with entered-at timestamps and per-phase
+durations, read from the durable journey annotation the state machine
+maintains (docs/observability.md):
+
+    python cmd/status.py --component libtpu --timeline v5p-host-3
+
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
-gates and scripts can wait on it.
+gates and scripts can wait on it. ``--timeline`` always exits 0.
 """
 
 import argparse
+import datetime
 import json
 import sys
+import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.health import consts as health_consts  # noqa: E402
+from k8s_operator_libs_tpu.obs.journey import parse_journey  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.util import KeyFactory, parse_selector  # noqa: E402
 from k8s_operator_libs_tpu.tpu.topology import slice_info_for_node  # noqa: E402
@@ -115,7 +125,66 @@ def render_table(component: str, rows) -> str:
     return "\n".join(lines)
 
 
-def main(argv=None, client=None) -> int:
+def collect_timeline(client, component: str, node_name: str, now=None):
+    """The node's journey for one component, as duration-annotated rows.
+    ``now`` closes the open-ended last phase (defaults to wall clock;
+    injectable for deterministic tests)."""
+    now = time.time() if now is None else now
+    keys = KeyFactory(component)
+    node = client.get_node(node_name)
+    entries = parse_journey(
+        node.metadata.annotations.get(keys.journey_annotation))
+    rows = []
+    for i, (state, entered) in enumerate(entries):
+        ongoing = i + 1 >= len(entries)
+        end = now if ongoing else entries[i + 1][1]
+        rows.append({
+            "state": state or "unknown",
+            "entered": entered,
+            "duration_s": max(0.0, end - entered),
+            "ongoing": ongoing,
+        })
+    stuck = node.metadata.annotations.get(keys.stuck_reported_annotation)
+    return rows, stuck
+
+
+def _fmt_duration(seconds: float) -> str:
+    if seconds >= 3600:
+        return f"{seconds / 3600:.1f}h"
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds:.1f}s"
+
+
+def render_timeline(component: str, node_name: str, rows, stuck) -> str:
+    lines = [f"component: {component}  node: {node_name}"]
+    if not rows:
+        lines.append("  (no journey recorded — the node never transitioned "
+                     "under this component's state machine)")
+        return "\n".join(lines)
+    headers = ("STATE", "ENTERED", "DURATION")
+    table = []
+    for r in rows:
+        entered = datetime.datetime.fromtimestamp(
+            r["entered"], tz=datetime.timezone.utc).strftime(
+            "%Y-%m-%d %H:%M:%S")
+        dur = _fmt_duration(r["duration_s"]) + ("+" if r["ongoing"] else "")
+        table.append((r["state"], entered, dur))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    total = sum(r["duration_s"] for r in rows[:-1])
+    lines.append(f"{len(rows)} transitions, {_fmt_duration(total)} in "
+                 f"completed phases")
+    if stuck:
+        state, _, entered = stuck.partition("@")
+        lines.append(f"STUCK reported: state {state} (entered-at {entered})")
+    return "\n".join(lines)
+
+
+def main(argv=None, client=None, now=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--component", action="append", required=True,
                    help="managed component name (repeatable)")
@@ -127,10 +196,27 @@ def main(argv=None, client=None) -> int:
     p.add_argument("--in-cluster", action="store_true")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--timeline", default=None, metavar="NODE",
+                   help="render NODE's upgrade journey (per-phase "
+                        "durations) instead of the fleet table")
     args = p.parse_args(argv)
     if client is None:
         client = build_client(args)
     selector = parse_selector(args.selector) if args.selector else None
+
+    if args.timeline:
+        out = {}
+        for comp in args.component:
+            rows, stuck = collect_timeline(client, comp, args.timeline,
+                                           now=now)
+            out[comp] = {"node": args.timeline, "timeline": rows,
+                         "stuck_reported": stuck}
+            if not args.as_json:
+                print(render_timeline(comp, args.timeline, rows, stuck))
+                print()
+        if args.as_json:
+            print(json.dumps(out, indent=2))
+        return 0
 
     rc = 0
     out = {}

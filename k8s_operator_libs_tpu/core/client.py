@@ -14,9 +14,12 @@ use :class:`~k8s_operator_libs_tpu.core.fakecluster.FakeCluster`.
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Dict, List, Optional
 
 from .objects import ControllerRevision, DaemonSet, Event, Job, Node, Pod
+
+logger = logging.getLogger(__name__)
 
 
 class NotFoundError(KeyError):
@@ -129,6 +132,35 @@ class EventRecorder(abc.ABC):
 class NullRecorder(EventRecorder):
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         pass
+
+
+class ClientEventRecorder(EventRecorder):
+    """EventRecorder that persists Event objects through the injected
+    Client's ``create_event`` (FakeCluster and LiveClient both expose one),
+    so the SAME wiring records real apiserver Events in production and
+    assertable Events under the fake apiserver in tests — the default in
+    ``cmd/operator.py``. Failures are swallowed: an event is advisory,
+    never worth failing a reconcile over."""
+
+    def __init__(self, client: Client, namespace: str = "default"):
+        self._client = client
+        self._namespace = namespace
+
+    def event(self, obj, event_type: str, reason: str, message: str) -> None:
+        create = getattr(self._client, "create_event", None)
+        if create is None:
+            direct = getattr(self._client, "direct", None)
+            if direct is not None:
+                create = getattr(direct(), "create_event", None)
+        if create is None:
+            logger.debug("client cannot create Events; dropping %s/%s",
+                         reason, event_type)
+            return
+        try:
+            create(make_event(obj, event_type, reason, message),
+                   namespace=self._namespace)
+        except Exception as exc:
+            logger.debug("event write failed (%s); dropping %s", exc, reason)
 
 
 def make_event(obj, event_type: str, reason: str, message: str) -> Event:

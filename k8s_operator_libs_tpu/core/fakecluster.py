@@ -612,6 +612,14 @@ class _FakeClient(Client):
         self._c.flush_cache()
         return created
 
+    def create_event(self, event: Event, namespace: str = "default") -> Event:
+        """Persist an already-built Event (ClientEventRecorder's write
+        path); lands in the cluster-wide FakeRecorder for assertions, like
+        the HTTP facade's POST route."""
+        copied = deep_copy(event)
+        self._c.recorder.record(copied)
+        return copied
+
     # leases are never cached: leader election must read fresh state
     def get_lease(self, namespace, name):
         return self._c.get("Lease", namespace, name)

@@ -508,6 +508,30 @@ class LiveClient(Client):
             "POST", f"/api/v1/namespaces/{ns}/services",
             body=serde.service_to_json(service)))
 
+    def create_event(self, event, namespace: str = "default"):
+        """POST an already-built :class:`~.objects.Event`
+        (ClientEventRecorder's write path). Name uniqueness follows
+        LiveEventRecorder: a time_ns suffix never collides across recorder
+        restarts (the --once Job case)."""
+        import time as _time
+        uid = f"{_time.time_ns():x}"
+        name = (f"{event.object_name or 'obj'}."
+                f"{(event.reason or 'event').lower()}.{uid}")
+        body = {
+            "apiVersion": "v1", "kind": "Event",
+            "metadata": {"name": name, "namespace": namespace},
+            "involvedObject": {
+                "kind": event.object_kind, "name": event.object_name,
+                "namespace": namespace if event.object_kind != "Node"
+                else ""},
+            "type": event.event_type, "reason": event.reason,
+            "message": event.message,
+            "reportingComponent": "tpu-operator",
+        }
+        self._http.request(
+            "POST", f"/api/v1/namespaces/{namespace}/events", body=body)
+        return event
+
     # ------------------------------------------------ leases (leader election)
 
     _LEASES = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"

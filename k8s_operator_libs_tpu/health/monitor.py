@@ -110,7 +110,8 @@ class FleetHealthMonitor:
                  probes: Optional[List[Probe]] = None,
                  classifier: Optional[HealthClassifier] = None,
                  remediator: Optional[HealthRemediator] = None,
-                 options: Optional[HealthOptions] = None):
+                 options: Optional[HealthOptions] = None,
+                 metrics=None):
         options = options or HealthOptions()
         self._client = client
         self._keys = keys
@@ -118,6 +119,11 @@ class FleetHealthMonitor:
         self._driver_labels = dict(driver_labels)
         self._grouper = grouper or SingleNodeGrouper()
         self._clock = clock or RealClock()
+        # probe→quarantine reaction-time histogram: soft state only (when a
+        # slice FIRST left healthy); losing it on restart just skips one
+        # observation, never double-counts
+        self._metrics = metrics
+        self._unhealthy_since: Dict[str, float] = {}
         self.probes = probes if probes is not None else default_probes(
             restart_threshold=options.restart_threshold,
             heartbeat_stale_seconds=options.heartbeat_stale_seconds)
@@ -160,11 +166,29 @@ class FleetHealthMonitor:
             if n.spec.unschedulable or not n.is_ready()
             or n.metadata.labels.get(self._keys.state_label)
             == UpgradeState.CORDON_REQUIRED)
+        # stamp when each slice first leaves healthy, BEFORE remediation
+        # acts — reaction time measures signal-confirmed → quarantined
+        now = self._clock.wall()
+        for sv in slices:
+            if sv.verdict == HealthVerdict.HEALTHY:
+                self._unhealthy_since.pop(sv.key, None)
+            else:
+                self._unhealthy_since.setdefault(sv.key, now)
+
         ctx = RemediationContext(
             nodes={n.metadata.name: n for n in nodes},
             pods_by_node=pods_by_node,
             total_nodes=total, unavailable=unavailable)
         actions = self.remediator.apply(slices, ctx)
+
+        if self._metrics is not None:
+            for key in actions.quarantined_slices:
+                since = self._unhealthy_since.get(key)
+                if since is not None:
+                    self._metrics.observe(
+                        "health_reaction_seconds",
+                        max(0.0, self._clock.wall() - since),
+                        labels={"component": self._keys.component})
 
         quarantined = {n.metadata.name for n in nodes
                        if consts.QUARANTINE_LABEL in n.metadata.labels}

@@ -1,0 +1,36 @@
+"""k8s_operator_libs_tpu.obs — upgrade-journey observability.
+
+Duration-aware tracing for the two closed loops (slice-atomic upgrades,
+fleet-health remediation), following the span model of Dapper (Sigelman et
+al., 2010) and the time-series-first philosophy of Borgmon/Prometheus:
+
+- :mod:`.trace`   — dependency-free, clock-injected span primitives with a
+                    pluggable structured-JSONL sink (reconcile-tick root
+                    span, child spans per component ``apply_state`` and per
+                    ``process_*`` handler);
+- :mod:`.journey` — the per-node **upgrade journey**: every UpgradeState
+                    transition recorded through the single provider choke
+                    point, entered-at timestamps persisted in node
+                    annotations (time-in-state survives operator restart
+                    and leader failover), plus the stuck-node detector;
+- :mod:`.metrics` — Prometheus histogram exposition
+                    (``_bucket``/``_sum``/``_count``) and the shared
+                    per-metric HELP registry layered under the existing
+                    gauge renderer.
+
+Layering: ``obs`` sits BELOW ``upgrade``/``health``/``tpu`` (they import
+it, never the reverse), so the journey thresholds are keyed by the state
+WIRE VALUES — the OBS001 lint pass proves that table stays closed over
+``UpgradeState``.
+"""
+
+from .journey import (DEFAULT_STUCK_THRESHOLDS, JourneyRecorder,
+                      StuckNodeDetector, parse_journey)
+from .metrics import HELP_TEXTS, MetricsHub, help_for
+from .trace import JsonlSink, ListSink, NullSink, Span, Tracer
+
+__all__ = [
+    "DEFAULT_STUCK_THRESHOLDS", "JourneyRecorder", "StuckNodeDetector",
+    "parse_journey", "HELP_TEXTS", "MetricsHub", "help_for",
+    "JsonlSink", "ListSink", "NullSink", "Span", "Tracer",
+]

@@ -1,0 +1,273 @@
+"""Prometheus histogram exposition + the shared per-metric HELP registry.
+
+The upgrade and health gauges already render through
+``upgrade.metrics.render_prometheus_multi``; this module adds the two
+pieces that were missing for duration-aware observability:
+
+- :class:`MetricsHub` — a process-local registry of **histogram** families
+  (``_bucket``/``_sum``/``_count`` text exposition, cumulative buckets,
+  ``+Inf`` closed) and labelled gauges, fed by the instrumented layers
+  (phase durations from the journey choke point, reconcile-tick duration,
+  drain duration, scheduler placement latency, health reaction time,
+  stuck-node counts, build/leader identity);
+- :data:`HELP_TEXTS` / :func:`help_for` — real per-metric descriptions
+  shared by the upgrade gauges, health gauges, and the hub families.
+  Unknown names keep the legacy fallback (underscores mapped to spaces),
+  so consumer-defined metrics never break the renderer.
+
+No prometheus_client dependency: like the gauge renderer, the hub owns the
+text format itself so ``cmd/operator.py`` can serve ``/metrics`` from the
+stdlib HTTP server.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# Latency buckets sized for control-plane work: sub-second handler passes
+# up to multi-minute drains (drain timeout default 300 s) and hour-scale
+# stuck dwells.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    120.0, 300.0, 600.0, 1800.0, 3600.0)
+
+# ------------------------------------------------------------ HELP registry
+
+# Keyed by the FULL exposed metric name (prefix included) — the renderers
+# look up after prefixing, so upgrade ("tpu_operator_*") and health
+# ("tpu_operator_health_*") families cannot collide.
+HELP_TEXTS: Dict[str, str] = {
+    # upgrade gauges (upgrade/metrics.py collect())
+    "tpu_operator_total_managed_nodes":
+        "Nodes joined with a managed driver pod this reconcile tick",
+    "tpu_operator_upgrades_in_progress":
+        "Nodes between admission and done/failed in the upgrade pipeline",
+    "tpu_operator_upgrades_done":
+        "Nodes whose driver upgrade completed (state upgrade-done)",
+    "tpu_operator_upgrades_failed":
+        "Nodes parked in upgrade-failed awaiting recovery",
+    "tpu_operator_upgrades_pending":
+        "Nodes in upgrade-required waiting for an admission slot",
+    "tpu_operator_unavailable_nodes":
+        "Nodes currently cordoned or not Ready (maxUnavailable arithmetic)",
+    "tpu_operator_nodes_in_state_unknown":
+        "Nodes with no upgrade-state label yet",
+    "tpu_operator_nodes_in_state_upgrade_required":
+        "Nodes in state upgrade-required",
+    "tpu_operator_nodes_in_state_cordon_required":
+        "Nodes in state cordon-required",
+    "tpu_operator_nodes_in_state_wait_for_jobs_required":
+        "Nodes in state wait-for-jobs-required",
+    "tpu_operator_nodes_in_state_pod_deletion_required":
+        "Nodes in state pod-deletion-required",
+    "tpu_operator_nodes_in_state_drain_required":
+        "Nodes in state drain-required",
+    "tpu_operator_nodes_in_state_pod_restart_required":
+        "Nodes in state pod-restart-required",
+    "tpu_operator_nodes_in_state_validation_required":
+        "Nodes in state validation-required",
+    "tpu_operator_nodes_in_state_uncordon_required":
+        "Nodes in state uncordon-required",
+    "tpu_operator_nodes_in_state_upgrade_done":
+        "Nodes in state upgrade-done",
+    "tpu_operator_nodes_in_state_upgrade_failed":
+        "Nodes in state upgrade-failed",
+    # health gauges (health/metrics.py collect())
+    "tpu_operator_health_monitored_nodes":
+        "Nodes in scope of the fleet-health monitor this tick",
+    "tpu_operator_health_monitored_slices":
+        "Slices (failure domains) rolled up by the health classifier",
+    "tpu_operator_health_quarantined_nodes":
+        "Nodes currently under the health-quarantine label",
+    "tpu_operator_health_quarantined_slices":
+        "Slices currently quarantined",
+    "tpu_operator_health_repairs_in_flight":
+        "Slices with a repair riding the upgrade pipeline right now",
+    "tpu_operator_health_repairs_injected":
+        "Slice repairs injected into the upgrade pipeline this tick",
+    "tpu_operator_health_driver_pods_restarted":
+        "Failing driver pods deleted at the quiesced restart barrier "
+        "this tick",
+    "tpu_operator_health_quarantines_deferred":
+        "Quarantines deferred this tick to honor the availability budget",
+    "tpu_operator_health_probe_errors":
+        "Probes that raised this tick (isolated, not fatal)",
+    "tpu_operator_health_nodes_verdict_healthy":
+        "Nodes with verdict healthy",
+    "tpu_operator_health_nodes_verdict_degraded":
+        "Nodes with verdict degraded (signal inside the damping window)",
+    "tpu_operator_health_nodes_verdict_unhealthy_transient":
+        "Nodes with verdict unhealthy-transient (quarantined, may recover)",
+    "tpu_operator_health_nodes_verdict_unhealthy_persistent":
+        "Nodes with verdict unhealthy-persistent (handed to repair)",
+    "tpu_operator_health_slices_verdict_healthy":
+        "Slices with rolled-up verdict healthy",
+    "tpu_operator_health_slices_verdict_degraded":
+        "Slices with rolled-up verdict degraded",
+    "tpu_operator_health_slices_verdict_unhealthy_transient":
+        "Slices with rolled-up verdict unhealthy-transient",
+    "tpu_operator_health_slices_verdict_unhealthy_persistent":
+        "Slices with rolled-up verdict unhealthy-persistent",
+    # obs families (MetricsHub)
+    "tpu_operator_phase_duration_seconds":
+        "Seconds a node spent in an upgrade-pipeline state, observed at "
+        "the transition out of it (journey choke point)",
+    "tpu_operator_reconcile_tick_duration_seconds":
+        "Wall seconds one full TPUOperator reconcile tick took",
+    "tpu_operator_drain_duration_seconds":
+        "Seconds one successful node drain took (cordon excluded)",
+    "tpu_operator_placement_latency_seconds":
+        "Seconds SliceScheduler.place() took to bind a workload to its "
+        "slices",
+    "tpu_operator_health_reaction_seconds":
+        "Seconds from a slice first leaving healthy to its quarantine",
+    "tpu_operator_stuck_nodes":
+        "Nodes dwelling in an upgrade state beyond its stuck threshold",
+    "tpu_operator_build_info":
+        "Constant 1; labels carry the operator version and managed "
+        "components",
+    "tpu_operator_leader":
+        "1 on the replica holding the leader lease (or running without "
+        "leader election), 0 on hot standbys",
+}
+
+
+def help_for(metric: str, default: Optional[str] = None) -> str:
+    """Description for a fully-prefixed metric name; unknown names keep the
+    caller's fallback (historically the name with underscores as spaces)."""
+    text = HELP_TEXTS.get(metric)
+    if text is not None:
+        return text
+    return default if default is not None else metric.replace("_", " ")
+
+
+# --------------------------------------------------------------- exposition
+
+
+def _fmt_float(v: float) -> str:
+    """Prometheus sample/`le` formatting: integers without the trailing
+    .0 ("1" not "1.0"), everything else via repr (shortest round-trip)."""
+    if v == float("inf"):
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _escape_label(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Histogram:
+    """One histogram family: fixed buckets, one series per label set."""
+
+    def __init__(self, name: str, buckets: Tuple[float, ...]):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        # label-items tuple -> [per-bucket counts..., +Inf count], sum
+        self.series: Dict[Tuple[Tuple[str, str], ...],
+                          Tuple[List[int], float]] = {}
+
+    def observe(self, value: float, labels: Dict[str, str]) -> None:
+        key = tuple(sorted(labels.items()))
+        counts, total = self.series.get(key) or ([0] * (len(self.buckets) + 1),
+                                                 0.0)
+        # per-bucket (non-cumulative) counts; render() cumulates. The last
+        # slot is the (+Inf, total-count) overflow.
+        counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.series[key] = (counts, total + value)
+
+    def render(self, full_name: str) -> List[str]:
+        lines = [f"# HELP {full_name} {help_for(full_name)}",
+                 f"# TYPE {full_name} histogram"]
+        for key in sorted(self.series):
+            counts, total = self.series[key]
+            labels = dict(key)
+            cumulative = 0
+            for bound, c in zip(self.buckets, counts):
+                cumulative += c
+                le = 'le="%s"' % _fmt_float(bound)
+                lines.append(f"{full_name}_bucket"
+                             f"{_label_str(labels, le)} {cumulative}")
+            cumulative += counts[-1]  # overflow slot closes +Inf
+            inf_le = 'le="+Inf"'
+            lines.append(f"{full_name}_bucket"
+                         f"{_label_str(labels, inf_le)} {cumulative}")
+            lines.append(f"{full_name}_sum{_label_str(labels)} "
+                         f"{_fmt_float(total)}")
+            lines.append(f"{full_name}_count{_label_str(labels)} "
+                         f"{cumulative}")
+        return lines
+
+
+class MetricsHub:
+    """Process-local metric registry the instrumented layers write into and
+    ``cmd/operator.py`` renders per scrape. Thread-safe: drain worker
+    threads observe concurrently with the reconcile loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: Dict[str, _Histogram] = {}
+        # name -> {label-items tuple -> value}
+        self._gauges: Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                     float]] = {}
+
+    # -------------------------------------------------------------- writes
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None,
+                buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Record one histogram observation (family auto-created; its
+        buckets are fixed by the first call)."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = _Histogram(
+                    name, buckets or DEFAULT_BUCKETS)
+            hist.observe(float(value), labels or {})
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            series[tuple(sorted((labels or {}).items()))] = float(value)
+
+    # --------------------------------------------------------------- reads
+
+    def histogram_families(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hists)
+
+    def get_histogram(self, name: str) -> Optional[_Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def render(self, prefix: str = "tpu_operator") -> str:
+        """Text exposition of every family, name-sorted, HELP/TYPE once per
+        family (the format forbids repeating them)."""
+        with self._lock:
+            names = sorted(set(self._hists) | set(self._gauges))
+            lines: List[str] = []
+            for name in names:
+                full = f"{prefix}_{name}" if prefix else name
+                if name in self._hists:
+                    lines.extend(self._hists[name].render(full))
+                else:
+                    lines.append(f"# HELP {full} {help_for(full)}")
+                    lines.append(f"# TYPE {full} gauge")
+                    for key in sorted(self._gauges[name]):
+                        value = self._gauges[name][key]
+                        lines.append(f"{full}{_label_str(dict(key))} "
+                                     f"{_fmt_float(value)}")
+        return "\n".join(lines) + "\n" if lines else ""

@@ -16,17 +16,20 @@ and a real client in production.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 from typing import Dict, List, Optional
 
 from ..api.v1alpha1 import DriverUpgradePolicySpec
 from ..core.client import Client, EventRecorder
+from ..health.consts import HealthVerdict
 from ..health.monitor import (FleetHealthMonitor, HealthOptions,
                               HealthReport)
+from ..obs.journey import StuckNodeDetector
 from ..upgrade.groups import GroupPolicy
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
-from ..upgrade.util import KeyFactory
+from ..upgrade.util import KeyFactory, log_event
 from ..utils.clock import Clock, RealClock
 from .device_plugin import tpu_workload_deletion_filter
 from .scheduler import Placement, SliceScheduler, TPUWorkload
@@ -52,15 +55,29 @@ class TPUOperator:
                  clock: Optional[Clock] = None,
                  group_policy: Optional[GroupPolicy] = None,
                  synchronous: bool = False,
-                 health: Optional[HealthOptions] = None):
+                 health: Optional[HealthOptions] = None,
+                 tracer=None, metrics=None,
+                 stuck_thresholds: Optional[Dict[str, float]] = None):
         self.client = client
         self.components = components
-        self.scheduler = SliceScheduler(client)
+        self.clock = clock or RealClock()
+        self.recorder = recorder
+        # observability (obs/): the tracer draws the reconcile-tick span
+        # tree, the MetricsHub collects the duration histograms and stuck
+        # gauges, and one stuck detector per component reads the journeys
+        # the state providers persist. All optional (None = off) except the
+        # journey annotations themselves, which are always recorded.
+        self.tracer = tracer
+        self.metrics = metrics
+        self.scheduler = SliceScheduler(client, metrics=metrics,
+                                        clock=self.clock)
         self._pending: List[TPUWorkload] = []
         self.placements: List[Placement] = []
         # one state manager per component — instance-scoped keys make this
         # possible in one process (unlike the reference's DriverName global)
         self.managers: Dict[str, ClusterUpgradeStateManager] = {}
+        self.stuck_detectors: Dict[str, StuckNodeDetector] = {}
+        self.last_stuck: Dict[str, dict] = {}
         all_keys = {comp.name: KeyFactory(comp.name) for comp in components}
         for comp in components:
             # sibling_keys: the other components on the same nodes — the
@@ -68,14 +85,23 @@ class TPUOperator:
             # deferral across them (see upgrade_state.py SIBLING_BLOCKING)
             mgr = ClusterUpgradeStateManager(
                 client, all_keys[comp.name], recorder,
-                clock or RealClock(), grouper=TPUSliceGrouper(),
+                self.clock, grouper=TPUSliceGrouper(),
                 group_policy=group_policy, synchronous=synchronous,
                 sibling_keys=[k for name, k in all_keys.items()
-                              if name != comp.name])
+                              if name != comp.name],
+                metrics=metrics, tracer=tracer)
             if comp.policy.pod_deletion is not None:
                 # delete exactly the pods holding TPU chips before drain
                 mgr.with_pod_deletion_enabled(tpu_workload_deletion_filter)
             self.managers[comp.name] = mgr
+            keys = all_keys[comp.name]
+            self.stuck_detectors[comp.name] = StuckNodeDetector(
+                client, component=comp.name,
+                state_label=keys.state_label,
+                annotation_key=keys.journey_annotation,
+                stuck_key=keys.stuck_reported_annotation,
+                thresholds=stuck_thresholds, recorder=recorder,
+                clock=self.clock, metrics=metrics)
         # fleet health: probe → classify → quarantine → slice-atomic repair
         # through one component's upgrade pipeline (docs/fleet-health.md);
         # shares the slice grouper so health and upgrades agree on failure
@@ -84,6 +110,7 @@ class TPUOperator:
         self.health_monitor: Optional[FleetHealthMonitor] = None
         self.last_health: Optional[HealthReport] = None
         self.health_component: Optional[str] = None
+        self._prev_verdicts: Dict[str, str] = {}
         if health is not None:
             repair_comp = next(
                 (c for c in components if c.name == health.component),
@@ -94,7 +121,7 @@ class TPUOperator:
                 namespace=repair_comp.namespace,
                 driver_labels=repair_comp.driver_labels,
                 grouper=TPUSliceGrouper(), recorder=recorder,
-                clock=clock or RealClock(), options=health)
+                clock=self.clock, options=health, metrics=metrics)
 
     # ---------------------------------------------------------- workloads
 
@@ -118,46 +145,114 @@ class TPUOperator:
         pending workloads. Errors from one component don't starve the others
         (each reconcile is idempotent; the next tick retries).
 
+        The whole tick is one trace: a ``reconcile-tick`` root span with
+        child spans per component ``apply_state`` (whose handler passes are
+        grandchildren — upgrade_state.py), the health tick, stuck-node
+        detection, and placement; tick wall time feeds the
+        ``reconcile_tick_duration_seconds`` histogram.
+
         Returns {component name: the ClusterUpgradeState this tick acted on,
         or None if its reconcile raised} — consumers render metrics and
         health from it without re-listing the cluster (cmd/operator.py)."""
+        t0 = self.clock.now()
         states: Dict[str, Optional[object]] = {}
-        for comp in self.components:
-            mgr = self.managers[comp.name]
-            try:
-                state = mgr.build_state(comp.namespace, comp.driver_labels)
-                mgr.apply_state(state, comp.policy)
-                states[comp.name] = state
-            except Exception:
-                logger.exception("upgrade reconcile failed for %s", comp.name)
-                states[comp.name] = None
-        # health tick AFTER the upgrade pass (its driver-pod restarts leave a
-        # DS-pod-count mismatch that BuildState refuses until the controller
-        # recreates the pod) and BEFORE placement (a slice quarantined this
-        # tick must not receive this tick's workloads)
-        if self.health_monitor is not None:
-            try:
-                self.last_health = self.health_monitor.tick()
-            except Exception:
-                logger.exception("health tick failed; upgrades and "
-                                 "placement continue")
-        still_pending: List[TPUWorkload] = []
-        for wl in self._pending:
-            # per-workload isolation: one failing placement must not starve
-            # upgrades or the other workloads (mirrors the per-component
-            # try/except above)
-            try:
-                placement = self.scheduler.place(wl)
-            except Exception:
-                logger.exception("placement of workload %s failed; keeping "
-                                 "it pending", wl.name)
-                still_pending.append(wl)
-                continue
-            if placement is None:
-                still_pending.append(wl)
-            else:
-                logger.info("placed workload %s on slice %s", wl.name,
-                            placement.slice_id)
-                self.placements.append(placement)
-        self._pending = still_pending
+        with self._span("reconcile-tick", components=len(self.components)):
+            for comp in self.components:
+                mgr = self.managers[comp.name]
+                with self._span("apply_state", component=comp.name):
+                    try:
+                        state = mgr.build_state(comp.namespace,
+                                                comp.driver_labels)
+                        mgr.apply_state(state, comp.policy)
+                        states[comp.name] = state
+                    except Exception:
+                        logger.exception("upgrade reconcile failed for %s",
+                                         comp.name)
+                        states[comp.name] = None
+            # health tick AFTER the upgrade pass (its driver-pod restarts
+            # leave a DS-pod-count mismatch that BuildState refuses until
+            # the controller recreates the pod) and BEFORE placement (a
+            # slice quarantined this tick must not receive this tick's
+            # workloads)
+            if self.health_monitor is not None:
+                with self._span("health-tick"):
+                    try:
+                        self.last_health = self.health_monitor.tick()
+                    except Exception:
+                        logger.exception("health tick failed; upgrades and "
+                                         "placement continue")
+                self._emit_verdict_change_events()
+            with self._span("stuck-detection"):
+                self._check_stuck_nodes(states)
+            still_pending: List[TPUWorkload] = []
+            with self._span("placement", pending=len(self._pending)):
+                for wl in self._pending:
+                    # per-workload isolation: one failing placement must not
+                    # starve upgrades or the other workloads (mirrors the
+                    # per-component try/except above)
+                    try:
+                        placement = self.scheduler.place(wl)
+                    except Exception:
+                        logger.exception("placement of workload %s failed; "
+                                         "keeping it pending", wl.name)
+                        still_pending.append(wl)
+                        continue
+                    if placement is None:
+                        still_pending.append(wl)
+                    else:
+                        logger.info("placed workload %s on slice %s", wl.name,
+                                    placement.slice_id)
+                        self.placements.append(placement)
+            self._pending = still_pending
+        if self.metrics is not None:
+            self.metrics.observe("reconcile_tick_duration_seconds",
+                                 max(0.0, self.clock.now() - t0))
         return states
+
+    # ------------------------------------------------------- observability
+
+    def _span(self, name: str, **attrs):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **attrs)
+
+    def _check_stuck_nodes(self, states: Dict[str, Optional[object]]) -> None:
+        """Run each component's stuck detector over the nodes this tick's
+        BuildState already joined — no extra apiserver LISTs."""
+        for comp in self.components:
+            state = states.get(comp.name)
+            if state is None:
+                continue
+            nodes = [ns.node for bucket in state.node_states.values()
+                     for ns in bucket]
+            try:
+                self.last_stuck[comp.name] = \
+                    self.stuck_detectors[comp.name].check(nodes)
+            except Exception:
+                logger.exception("stuck detection failed for %s", comp.name)
+
+    def _emit_verdict_change_events(self) -> None:
+        """One Kubernetes Event per node HEALTH VERDICT transition —
+        Warning on escalation, Normal on recovery — so `kubectl describe
+        node` shows the sequence of events that led a slice into
+        quarantine."""
+        if self.last_health is None:
+            return
+        current = {name: nh.verdict
+                   for name, nh in self.last_health.node_health.items()}
+        if self.recorder is not None:
+            for name, verdict in current.items():
+                prev = self._prev_verdicts.get(name, HealthVerdict.HEALTHY)
+                if prev == verdict:
+                    continue
+                escalated = HealthVerdict.worst([prev, verdict]) == verdict
+                try:
+                    node = self.client.direct().get_node(name)
+                except Exception:
+                    continue  # node gone mid-tick; next tick re-evaluates
+                log_event(self.recorder, node,
+                          "Warning" if escalated else "Normal",
+                          "FleetHealthVerdict",
+                          f"Health verdict of node {name} changed "
+                          f"{prev} -> {verdict}")
+        self._prev_verdicts = current

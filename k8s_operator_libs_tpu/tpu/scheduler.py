@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 
 from ..core.client import Client, ConflictError
 from ..core.objects import ObjectMeta, Pod
+from ..utils.clock import Clock, RealClock
 from .device_plugin import TPU_RESOURCE, pod_requests_tpu
 from .topology import SliceInfo, chips_per_host, slice_info_for_node
 
@@ -59,8 +60,11 @@ class Placement:
 
 
 class SliceScheduler:
-    def __init__(self, client: Client):
+    def __init__(self, client: Client, metrics=None,
+                 clock: Optional[Clock] = None):
         self._client = client
+        self._metrics = metrics  # MetricsHub for placement_latency_seconds
+        self._clock = clock or RealClock()
 
     # -- inventory ----------------------------------------------------------
 
@@ -124,6 +128,18 @@ class SliceScheduler:
         Single-slice pods get the JAX distributed-init env; multislice pods
         additionally get the MEGASCALE variables JAX's multislice runtime
         reads (slices talk over DCN; slice 0's worker 0 coordinates)."""
+        t0 = self._clock.now()
+        placement = self._place(workload)
+        if placement is not None and self._metrics is not None:
+            # latency of a SUCCESSFUL bind (inventory LISTs + pod creates);
+            # a pass that finds no free slice is a cheap no-op, not latency
+            self._metrics.observe(
+                "placement_latency_seconds",
+                max(0.0, self._clock.now() - t0),
+                labels={"accelerator": workload.accelerator})
+        return placement
+
+    def _place(self, workload: TPUWorkload) -> Optional[Placement]:
         if workload.num_slices < 1:
             raise ValueError(f"workload {workload.name}: num_slices must be "
                              f">= 1, got {workload.num_slices}")

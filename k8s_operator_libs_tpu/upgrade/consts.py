@@ -59,6 +59,13 @@ INITIAL_STATE_ANNOTATION_FMT = (
 WAIT_FOR_COMPLETION_START_FMT = (
     "{domain}/{component}-driver-upgrade-wait-for-completion-start-time")
 VALIDATION_START_FMT = "{domain}/{component}-driver-upgrade-validation-start-time"
+# Upgrade-journey observability (obs/journey.py; no reference analog): the
+# durable per-node transition timeline with entered-at timestamps, and the
+# stuck-node already-reported marker keyed to one state entry. Annotations,
+# not labels — values are JSON / free-form and never selected on.
+JOURNEY_ANNOTATION_FMT = "{domain}/{component}-driver-upgrade.journey"
+STUCK_REPORTED_ANNOTATION_FMT = (
+    "{domain}/{component}-driver-upgrade.journey-stuck-reported")
 
 # Fixed thresholds (see BASELINE.md table).
 VALIDATION_TIMEOUT_SECONDS = 600.0  # validation_manager.go:32

@@ -38,12 +38,14 @@ class DrainConfiguration:
 class DrainManager:
     def __init__(self, client: Client, state_provider: NodeUpgradeStateProvider,
                  keys: KeyFactory, recorder: Optional[EventRecorder] = None,
-                 clock: Optional[Clock] = None, synchronous: bool = False):
+                 clock: Optional[Clock] = None, synchronous: bool = False,
+                 metrics=None):
         self._client = client
         self._provider = state_provider
         self._keys = keys
         self._recorder = recorder
         self._clock = clock or RealClock()
+        self._metrics = metrics  # MetricsHub for drain_duration_seconds
         self._draining = StringSet()
         # synchronous=True runs drains inline — used by deterministic tests
         # and by bench.py's simulated clock (threads + FakeClock would race).
@@ -112,6 +114,7 @@ class DrainManager:
                 log_event(self._recorder, node, "Warning", self._keys.event_reason,
                           f"Failed to cordon the node, {exc}")
                 return
+            t0 = self._clock.now()
             try:
                 helper.run_node_drain(name)
             except Exception as exc:  # drain failure → upgrade-failed (:122-128)
@@ -120,6 +123,11 @@ class DrainManager:
                 log_event(self._recorder, node, "Warning", self._keys.event_reason,
                           f"Failed to drain the node, {exc}")
                 return
+            if self._metrics is not None:
+                self._metrics.observe(
+                    "drain_duration_seconds",
+                    max(0.0, self._clock.now() - t0),
+                    labels={"component": self._keys.component})
             log_event(self._recorder, node, "Normal", self._keys.event_reason,
                       "Successfully drained the node")
             if successes is not None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import re
 from typing import Dict
 
+from ..obs.metrics import help_for
 from .consts import UpgradeState
 from .upgrade_state import ClusterUpgradeState, ClusterUpgradeStateManager
 
@@ -53,8 +54,11 @@ def render_prometheus_multi(per_component: Dict[str, Dict[str, float]],
     lines = []
     for name in names:
         metric = sanitize_metric_name(f"{prefix}_{name}")
-        help_text = sanitize_metric_name(name).replace("_", " ")
-        lines.append(f"# HELP {metric} {help_text}")
+        # real descriptions come from the shared registry (obs/metrics.py,
+        # keyed by the full exposed name); unknown names keep the legacy
+        # underscores-to-spaces fallback
+        fallback = sanitize_metric_name(name).replace("_", " ")
+        lines.append(f"# HELP {metric} {help_for(metric, default=fallback)}")
         lines.append(f"# TYPE {metric} gauge")
         for component in sorted(per_component):
             metrics = per_component[component]

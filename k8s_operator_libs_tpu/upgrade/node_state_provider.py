@@ -24,6 +24,7 @@ from typing import List, Optional
 
 from ..core.client import Client, EventRecorder
 from ..core.objects import Node
+from ..obs.journey import JourneyRecorder
 from ..utils.clock import Clock, RealClock
 from . import consts
 from .util import KeyFactory, KeyedMutex, log_event
@@ -45,7 +46,8 @@ class NodeUpgradeStateProvider:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  sync_timeout: float = consts.CACHE_SYNC_TIMEOUT_SECONDS,
-                 sync_poll: float = consts.CACHE_SYNC_POLL_SECONDS):
+                 sync_poll: float = consts.CACHE_SYNC_POLL_SECONDS,
+                 metrics=None, journey: Optional[JourneyRecorder] = None):
         self._client = client
         self._keys = keys
         self._recorder = recorder
@@ -53,6 +55,17 @@ class NodeUpgradeStateProvider:
         self._sync_timeout = sync_timeout
         self._sync_poll = sync_poll
         self._mutex = KeyedMutex()
+        # THE journey choke point (obs/journey.py): every state-label write
+        # goes through this provider, so folding the journey annotations
+        # into the same patch keeps timeline and label atomically coherent.
+        # Always on — the annotations are what cmd/status.py --timeline and
+        # the stuck detector read; ``metrics`` additionally feeds the
+        # per-phase duration histogram when a MetricsHub is wired.
+        self._journey = journey if journey is not None else JourneyRecorder(
+            component=keys.component,
+            annotation_key=keys.journey_annotation,
+            stuck_key=keys.stuck_reported_annotation,
+            clock=self._clock, metrics=metrics)
 
     # ----------------------------------------------------------------- reads
 
@@ -102,12 +115,26 @@ class NodeUpgradeStateProvider:
             labels = {self._keys.state_label: label_value}
         patched_annos = {k: (None if v == NULL else v)
                          for k, v in (annotations or {}).items()}
+        # Per-node patch payloads: shared caller annotations plus, on an
+        # actual state TRANSITION, the journey bookkeeping (timeline append
+        # + stuck-marker clear) — one patch, one barrier, label and journey
+        # atomically coherent. A re-write of the current state contributes
+        # nothing (JourneyRecorder.record returns {}), so idempotent passes
+        # and label flaps never reset time-in-state.
+        per_node_annos = {}
         rv_floor = {}
         for node in nodes:
+            annos = dict(patched_annos)
+            if labels is not None:
+                old = node.metadata.labels.get(self._keys.state_label) or ""
+                new = label_value or ""
+                if old != new:
+                    annos.update(self._journey.record(node, old, new))
+            per_node_annos[node.metadata.name] = annos
             with self._mutex.lock(node.metadata.name):
                 patched = self._client.patch_node_metadata(
                     node.metadata.name, labels=labels,
-                    annotations=patched_annos or None)
+                    annotations=annos or None)
             rv_floor[node.metadata.name] = getattr(
                 patched.metadata, "resource_version", "") if patched else ""
 
@@ -117,7 +144,7 @@ class NodeUpgradeStateProvider:
                     != label_value):
                 return False
             return all(n.metadata.annotations.get(k) == v
-                       for k, v in patched_annos.items())
+                       for k, v in per_node_annos[n.metadata.name].items())
 
         self._wait_synced_many({n.metadata.name for n in nodes}, synced,
                                rv_floor)
@@ -134,15 +161,19 @@ class NodeUpgradeStateProvider:
                           f"Node upgrade state updated to {new_state or 'unknown'}")
                 logger.info("node %s upgrade state -> %r",
                             node.metadata.name, new_state)
-            if patched_annos:
+            node_annos = per_node_annos[node.metadata.name]
+            if node_annos:
                 node.metadata.annotations = dict(node.metadata.annotations)
-                for k, v in patched_annos.items():
+                for k, v in node_annos.items():
                     if v is None:
                         node.metadata.annotations.pop(k, None)
                         verb = "deleted"
                     else:
                         node.metadata.annotations[k] = v
                         verb = f"set to {v}"
+                    if k not in patched_annos:
+                        continue  # journey bookkeeping stays out of the
+                        # event trail (it rides every transition)
                     log_event(self._recorder, node, "Normal",
                               self._keys.event_reason,
                               f"Node annotation {k} {verb}")

@@ -22,6 +22,7 @@ default; all hosts of a multi-host slice with a TPU grouper) — see
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 from typing import Dict, List, Optional
@@ -112,19 +113,27 @@ class ClusterUpgradeStateManager:
                  pod_manager: Optional[PodManager] = None,
                  validation_manager: Optional[ValidationManager] = None,
                  safe_load_manager: Optional[SafeDriverLoadManager] = None,
-                 sibling_keys: Optional[List[KeyFactory]] = None):
+                 sibling_keys: Optional[List[KeyFactory]] = None,
+                 metrics=None, tracer=None):
         self.client = client
         self.keys = keys
         self.recorder = recorder
         self.clock = clock or RealClock()
         self.grouper = grouper or SingleNodeGrouper()
         self.group_policy = group_policy or GroupPolicy()
+        # observability (obs/): ``metrics`` (a MetricsHub) feeds the
+        # phase-duration and drain-duration histograms through the provider
+        # choke point and the drain manager; ``tracer`` wraps each
+        # process_* handler in a child span of the caller's apply_state
+        # span. Both default off (None) — zero overhead for library-only
+        # consumers.
+        self._tracer = tracer
         self.node_upgrade_state_provider = state_provider or NodeUpgradeStateProvider(
-            client, keys, recorder, self.clock)
+            client, keys, recorder, self.clock, metrics=metrics)
         self.cordon_manager = cordon_manager or CordonManager(client)
         self.drain_manager = drain_manager or DrainManager(
             client, self.node_upgrade_state_provider, keys, recorder, self.clock,
-            synchronous=synchronous)
+            synchronous=synchronous, metrics=metrics)
         self.pod_manager = pod_manager or PodManager(
             client, self.node_upgrade_state_provider, keys, None, recorder,
             self.clock, synchronous=synchronous)
@@ -246,22 +255,35 @@ class ClusterUpgradeStateManager:
 
         groups = build_group_views(current_state, self.grouper)
 
-        self.process_done_or_unknown_nodes(current_state, UpgradeState.UNKNOWN)
-        self.process_done_or_unknown_nodes(current_state, UpgradeState.DONE)
-        self.process_upgrade_required_nodes(current_state, upgrades_available,
-                                            groups, max_unavailable)
-        self.process_cordon_required_nodes(current_state)
-        self.process_wait_for_jobs_required_nodes(
-            current_state, upgrade_policy.wait_for_completion)
+        # each handler pass is a child span of the caller's apply_state
+        # span (tpu/operator.py) — the per-phase breakdown an on-call
+        # operator needs to see WHERE a slow tick spent its time
+        with self._span("process_done_or_unknown_nodes"):
+            self.process_done_or_unknown_nodes(current_state, UpgradeState.UNKNOWN)
+            self.process_done_or_unknown_nodes(current_state, UpgradeState.DONE)
+        with self._span("process_upgrade_required_nodes"):
+            self.process_upgrade_required_nodes(current_state, upgrades_available,
+                                                groups, max_unavailable)
+        with self._span("process_cordon_required_nodes"):
+            self.process_cordon_required_nodes(current_state)
+        with self._span("process_wait_for_jobs_required_nodes"):
+            self.process_wait_for_jobs_required_nodes(
+                current_state, upgrade_policy.wait_for_completion)
         drain_enabled = (upgrade_policy.drain is not None
                          and upgrade_policy.drain.enable)
-        self.process_pod_deletion_required_nodes(
-            current_state, upgrade_policy.pod_deletion, drain_enabled)
-        self.process_drain_nodes(current_state, upgrade_policy.drain, groups)
-        self.process_pod_restart_nodes(current_state, groups)
-        self.process_upgrade_failed_nodes(current_state)
-        self.process_validation_required_nodes(current_state)
-        self.process_uncordon_required_nodes(current_state, groups)
+        with self._span("process_pod_deletion_required_nodes"):
+            self.process_pod_deletion_required_nodes(
+                current_state, upgrade_policy.pod_deletion, drain_enabled)
+        with self._span("process_drain_nodes"):
+            self.process_drain_nodes(current_state, upgrade_policy.drain, groups)
+        with self._span("process_pod_restart_nodes"):
+            self.process_pod_restart_nodes(current_state, groups)
+        with self._span("process_upgrade_failed_nodes"):
+            self.process_upgrade_failed_nodes(current_state)
+        with self._span("process_validation_required_nodes"):
+            self.process_validation_required_nodes(current_state)
+        with self._span("process_uncordon_required_nodes"):
+            self.process_uncordon_required_nodes(current_state, groups)
 
     # ----------------------------------------------------------- handlers
 
@@ -553,6 +575,12 @@ class ClusterUpgradeStateManager:
             uncordoned, UpgradeState.DONE)
 
     # ------------------------------------------------------------- helpers
+
+    def _span(self, name: str):
+        """A tracer child span, or a no-op when no tracer is wired."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, component=self.keys.component)
 
     def _pod_in_sync_with_ds(self, ns: NodeUpgradeState):
         """podInSyncWithDS (:558-578) → (is_synced, is_orphaned)."""

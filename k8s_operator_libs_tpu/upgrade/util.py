@@ -118,6 +118,14 @@ class KeyFactory:
         return self._fmt(consts.VALIDATION_START_FMT)
 
     @property
+    def journey_annotation(self) -> str:
+        return self._fmt(consts.JOURNEY_ANNOTATION_FMT)
+
+    @property
+    def stuck_reported_annotation(self) -> str:
+        return self._fmt(consts.STUCK_REPORTED_ANNOTATION_FMT)
+
+    @property
     def event_reason(self) -> str:
         """GetEventReason (util.go:137-139): ``<COMPONENT>DriverUpgrade``."""
         return f"{self.component.upper().replace('-', '')}DriverUpgrade"

@@ -15,7 +15,8 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
-from lint import jax_hygiene, layering, lock_discipline, state_machine  # noqa: E402
+from lint import (jax_hygiene, layering, lock_discipline, obs_check,  # noqa: E402
+                  state_machine)
 from lint.registry import REGISTRY  # noqa: E402
 
 
@@ -34,10 +35,10 @@ def codes(findings):
 def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
-            "import-layering"} <= names
+            "obs-journey", "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
-            "LCK003", "STM001", "ARC001"} <= set(all_codes)
+            "LCK003", "STM001", "OBS001", "ARC001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
@@ -382,6 +383,132 @@ def test_stm001_health_undocumented_verdict_fails(tmp_path):
     findings = state_machine.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "UNHEALTHY_PERSISTENT" in msgs and "not documented" in msgs
+
+
+# ------------------------------------------- OBS001 (cross-file, mutated)
+
+OBS_FILES = [obs_check.CONSTS_PATH, obs_check.JOURNEY_PATH,
+             obs_check.CHOKE_PATH]
+
+
+def _obs_root(tmp_path, mutate=None, extra=None):
+    """Copy the real journey/threshold/choke-point files into a scratch
+    root, optionally mutating {relpath: fn(source) -> source} and adding
+    {relpath: source} extras."""
+    root = tmp_path / "repo"
+    for rel in OBS_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    for rel, src in (extra or {}).items():
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_obs001_real_repo_files_pass(tmp_path):
+    assert obs_check.run_project(_obs_root(tmp_path)) == []
+
+
+def test_obs001_real_repo_passes():
+    assert obs_check.run_project(REPO) == []
+
+
+def test_obs001_missing_threshold_fails_naming_state(tmp_path):
+    """Dropping one state's stuck-threshold default must fail naming the
+    state (and flag the now-stale situation from neither side silently)."""
+    root = _obs_root(tmp_path, mutate={
+        obs_check.JOURNEY_PATH: lambda s: s.replace(
+            '    "pod-restart-required": 900.0,\n', '')})
+    findings = obs_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings, "a missing threshold must fail the pass"
+    assert "POD_RESTART_REQUIRED" in msgs and "stuck-threshold" in msgs
+
+
+def test_obs001_new_state_without_threshold_fails(tmp_path):
+    root = _obs_root(tmp_path, mutate={
+        obs_check.CONSTS_PATH: lambda s: s.replace(
+            '    FAILED = "upgrade-failed"',
+            '    FAILED = "upgrade-failed"\n    LIMBO = "limbo-required"')})
+    findings = obs_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "LIMBO" in msgs and "stuck-threshold" in msgs
+
+
+def test_obs001_stale_threshold_key_fails(tmp_path):
+    """A threshold key no longer matching any wire value (renamed state)
+    is dead configuration and must fail from the journey side."""
+    root = _obs_root(tmp_path, mutate={
+        obs_check.JOURNEY_PATH: lambda s: s.replace(
+            '    "uncordon-required": 600.0,',
+            '    "uncordon-required": 600.0,\n    "ghost-state": 60.0,')})
+    findings = obs_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "ghost-state" in msgs and "no UpgradeState wire value" in msgs
+
+
+ROGUE_STATE_WRITE = '''
+class Sneaky:
+    def __init__(self, client, keys):
+        self._client = client
+        self._keys = keys
+
+    def force_done(self, name):
+        self._client.patch_node_metadata(
+            name, labels={self._keys.state_label: "upgrade-done"})
+'''
+
+ROGUE_JOURNEY_WRITE = '''
+class Sneakier:
+    def __init__(self, client, keys):
+        self._client = client
+        self._keys = keys
+
+    def erase_history(self, name):
+        self._client.patch_node_metadata(
+            name, annotations={self._keys.journey_annotation: "[]"})
+'''
+
+
+def test_obs001_state_write_outside_choke_point_fires(tmp_path):
+    root = _obs_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/health/rogue.py": ROGUE_STATE_WRITE})
+    findings = obs_check.run_project(root)
+    assert len(findings) == 1
+    rel, _, code, msg = findings[0]
+    assert code == "OBS001" and rel.endswith("health/rogue.py")
+    assert "state-label key" in msg and "choke point" in msg
+
+
+def test_obs001_journey_write_outside_choke_point_fires(tmp_path):
+    root = _obs_root(tmp_path, extra={
+        "cmd/rogue.py": ROGUE_JOURNEY_WRITE})
+    findings = obs_check.run_project(root)
+    assert len(findings) == 1
+    assert "journey annotation" in findings[0][3]
+
+
+def test_obs001_literal_key_write_fires_and_reads_stay_silent(tmp_path):
+    """Spelling the key as a string literal instead of going through the
+    KeyFactory is the sneakiest bypass; plain READS of the label never
+    fire (cmd/status.py, health/monitor.py are full of them)."""
+    root = _obs_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/tpu/rogue.py": (
+            'def f(client, name):\n'
+            '    client.patch_node_metadata(name, labels={\n'
+            '        "tpu.dev/libtpu-driver-upgrade-state": "upgrade-done"'
+            '})\n'),
+        "k8s_operator_libs_tpu/tpu/reader.py": (
+            'def g(node, keys):\n'
+            '    return node.metadata.labels.get(keys.state_label)\n')})
+    findings = obs_check.run_project(root)
+    assert len(findings) == 1
+    assert findings[0][0].endswith("tpu/rogue.py")
 
 
 # ------------------------------------------------- ARC001 (fake packages)

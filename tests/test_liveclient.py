@@ -612,18 +612,31 @@ def test_patch_bodies_match_real_apiserver_fixtures(live, keys, clock):
 
     state_key = keys.state_label
     anno_key = keys.initial_state_annotation
+    journey_key = keys.journey_annotation
+    stuck_key = keys.stuck_reported_annotation
     provider.change_node_upgrade_state(node, "cordon-required")
     provider.change_node_state_and_annotations(
         node, "upgrade-done", {anno_key: NULL})
     cli.patch_node_unschedulable("n0", True)
 
+    # every state TRANSITION carries the journey bookkeeping in the same
+    # strategic-merge patch (obs/journey.py choke point): the timeline
+    # append plus a null clearing the stuck-reported marker. FakeClock
+    # wall time is 0.0, so the entries are deterministic.
     assert recorded == [
         ("/api/v1/nodes/n0",
-         {"metadata": {"labels": {state_key: "cordon-required"}}},
+         {"metadata": {"labels": {state_key: "cordon-required"},
+                       "annotations": {
+                           journey_key: '[["cordon-required",0.0]]',
+                           stuck_key: None}}},
          "application/strategic-merge-patch+json"),
         ("/api/v1/nodes/n0",
          {"metadata": {"labels": {state_key: "upgrade-done"},
-                       "annotations": {anno_key: None}}},
+                       "annotations": {
+                           anno_key: None,
+                           journey_key: '[["cordon-required",0.0],'
+                                        '["upgrade-done",0.0]]',
+                           stuck_key: None}}},
          "application/strategic-merge-patch+json"),
         ("/api/v1/nodes/n0",
          {"spec": {"unschedulable": True}},

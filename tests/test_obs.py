@@ -1,0 +1,420 @@
+"""obs/ — span tracing, the per-node upgrade journey, stuck-node detection
+(including leader failover), and the Client-backed EventRecorder.
+
+The journey invariants pinned here are the PR's acceptance bars:
+- time-in-state survives operator restart and leader failover (annotations,
+  not process memory);
+- a flapping node does not reset its journey;
+- a node pinned past its per-state threshold raises the stuck gauge and
+  records exactly ONE Kubernetes Event, across failover.
+"""
+
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.core.client import ClientEventRecorder
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.obs.journey import (DEFAULT_STUCK_THRESHOLDS,
+                                               JourneyRecorder,
+                                               StuckNodeDetector,
+                                               parse_journey)
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.obs.trace import JsonlSink, ListSink, Tracer
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+# ------------------------------------------------------------------ tracing
+
+
+def test_span_tree_parentage_and_durations():
+    clock = FakeClock(100.0)
+    sink = ListSink()
+    tracer = Tracer(sink=sink, clock=clock)
+    with tracer.span("reconcile-tick", components=2):
+        with tracer.span("apply_state", component="libtpu"):
+            with tracer.span("process_drain_nodes"):
+                clock.advance(0.5)
+            clock.advance(0.25)
+        clock.advance(1.0)
+    # children emit before parents (close order)
+    names = [r["name"] for r in sink.records]
+    assert names == ["process_drain_nodes", "apply_state", "reconcile-tick"]
+    drain, apply_s, tick = sink.records
+    assert drain["parent"] == apply_s["span"]
+    assert apply_s["parent"] == tick["span"]
+    assert tick["parent"] is None
+    assert {r["trace"] for r in sink.records} == {tick["trace"]}
+    assert drain["duration_s"] == pytest.approx(0.5)
+    assert apply_s["duration_s"] == pytest.approx(0.75)
+    assert tick["duration_s"] == pytest.approx(1.75)
+    assert apply_s["attrs"] == {"component": "libtpu"}
+
+
+def test_two_root_spans_get_distinct_traces():
+    tracer = Tracer(sink=ListSink(), clock=FakeClock())
+    with tracer.span("tick"):
+        pass
+    with tracer.span("tick"):
+        pass
+    traces = [r["trace"] for r in tracer.sink.records]
+    assert traces[0] != traces[1]
+
+
+def test_span_records_error_and_reraises():
+    sink = ListSink()
+    tracer = Tracer(sink=sink, clock=FakeClock())
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("no")
+    assert sink.records[0]["error"] == "ValueError"
+
+
+def test_jsonl_sink_one_object_per_line(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    sink = JsonlSink(str(path))
+    tracer = Tracer(sink=sink, clock=FakeClock(5.0))
+    with tracer.span("a"):
+        with tracer.span("b"):
+            pass
+    sink.close()
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    records = [json.loads(line) for line in lines]
+    assert {r["name"] for r in records} == {"a", "b"}
+    for r in records:
+        assert set(r) == {"trace", "span", "parent", "name", "start",
+                          "duration_s", "attrs", "error"}
+
+
+# ------------------------------------------------------------------ journey
+
+
+@pytest.fixture
+def provider_env():
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    cluster.add_node("n0")
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+    keys = KeyFactory("libtpu")
+    hub = MetricsHub()
+    provider = NodeUpgradeStateProvider(cluster.client, keys,
+                                        cluster.recorder, clock, metrics=hub)
+    return cluster, clock, keys, provider, hub
+
+
+def test_journey_annotation_written_through_choke_point(provider_env):
+    cluster, clock, keys, provider, hub = provider_env
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+    clock.advance(30)
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    n = cluster.client.direct().get_node("n0")
+    entries = parse_journey(n.metadata.annotations[keys.journey_annotation])
+    assert [s for s, _ in entries] == [UpgradeState.UPGRADE_REQUIRED,
+                                      UpgradeState.CORDON_REQUIRED]
+    # entered-at timestamps are wall-clock and strictly ordered
+    assert entries[1][1] - entries[0][1] >= 30
+    # the transition out of upgrade-required fed the phase histogram
+    hist = hub.get_histogram("phase_duration_seconds")
+    assert hist is not None
+    key = (("component", "libtpu"), ("state", "upgrade-required"))
+    counts, total = hist.series[key]
+    assert sum(counts) == 1 and total >= 30
+
+
+def test_idempotent_rewrite_does_not_reset_journey(provider_env):
+    """Re-writing the CURRENT state (idempotent reconcile passes, replayed
+    first tick after failover) must not append or reset entered-at."""
+    cluster, clock, keys, provider, _ = provider_env
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.DRAIN_REQUIRED)
+    before = cluster.client.direct().get_node(
+        "n0").metadata.annotations[keys.journey_annotation]
+    clock.advance(120)
+    node = provider.get_node("n0")
+    provider.change_node_state_and_annotations(
+        node, UpgradeState.DRAIN_REQUIRED, {"x": "y"})
+    after = cluster.client.direct().get_node(
+        "n0").metadata.annotations[keys.journey_annotation]
+    assert before == after  # dwell keeps accumulating
+
+
+def test_flapping_node_does_not_reset_its_journey(provider_env):
+    """A node bouncing A -> B -> A keeps its FULL history — the timeline is
+    how an operator sees the flap; truncating it would hide the evidence."""
+    cluster, clock, keys, provider, _ = provider_env
+    seq = [UpgradeState.POD_RESTART_REQUIRED, UpgradeState.FAILED,
+           UpgradeState.POD_RESTART_REQUIRED]
+    for s in seq:
+        node = provider.get_node("n0")
+        provider.change_node_upgrade_state(node, s)
+        clock.advance(10)
+    entries = parse_journey(cluster.client.direct().get_node(
+        "n0").metadata.annotations[keys.journey_annotation])
+    assert [s for s, _ in entries] == seq
+    # and the LAST entry's timestamp anchors the current dwell, not the
+    # first visit to the state
+    assert entries[-1][1] > entries[0][1]
+
+
+def test_journey_capped_and_malformed_tolerated(provider_env):
+    cluster, clock, keys, provider, _ = provider_env
+    rec = JourneyRecorder("libtpu", keys.journey_annotation,
+                          keys.stuck_reported_annotation, clock=clock,
+                          max_entries=4)
+    node = provider.get_node("n0")
+    states = ["a", "b", "c", "d", "e", "f"]
+    for i, s in enumerate(states):
+        updates = rec.record(node, states[i - 1] if i else "", s)
+        node.metadata.annotations.update(
+            {k: v for k, v in updates.items() if v is not None})
+        clock.advance(1)
+    entries = parse_journey(
+        node.metadata.annotations[keys.journey_annotation])
+    assert [s for s, _ in entries] == ["c", "d", "e", "f"]  # oldest dropped
+    assert parse_journey("not json [") == []
+    assert parse_journey(None) == []
+
+
+# ------------------------------------------------------------- stuck nodes
+
+
+def _stuck_env(clock=None):
+    clock = clock or FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    cluster.add_node("n0")
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+    keys = KeyFactory("libtpu")
+    provider = NodeUpgradeStateProvider(cluster.client, keys,
+                                        cluster.recorder, clock)
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.POD_RESTART_REQUIRED)
+    return cluster, clock, keys
+
+
+def _detector(cluster, clock, keys, hub=None):
+    return StuckNodeDetector(
+        cluster.client.direct(), component="libtpu",
+        state_label=keys.state_label,
+        annotation_key=keys.journey_annotation,
+        stuck_key=keys.stuck_reported_annotation,
+        recorder=cluster.recorder, clock=clock, metrics=hub)
+
+
+def test_stuck_node_raises_gauge_and_one_event_across_failover():
+    """The acceptance bar: pinned in pod-restart-required past the
+    threshold -> stuck gauge up + exactly one Event; a NEW detector (the
+    failed-over leader, fresh process memory) sees the durable marker and
+    stays quiet while the gauge stays raised."""
+    cluster, clock, keys = _stuck_env()
+    hub = MetricsHub()
+    threshold = DEFAULT_STUCK_THRESHOLDS[UpgradeState.POD_RESTART_REQUIRED]
+    assert threshold > 0
+
+    detector = _detector(cluster, clock, keys, hub)
+    nodes = cluster.client.direct().list_nodes()
+    report = detector.check(nodes)
+    assert report["stuck"] == [] and report["reported"] == []
+
+    clock.advance(threshold + 1)
+    nodes = cluster.client.direct().list_nodes()
+    report = detector.check(nodes)
+    assert [(n, s) for n, s, _ in report["reported"]] == [
+        ("n0", UpgradeState.POD_RESTART_REQUIRED)]
+    stuck_events = [e for e in cluster.recorder.events
+                    if e.reason == "StuckNode"]
+    assert len(stuck_events) == 1
+    assert "pod-restart-required" in stuck_events[0].message
+    gauge = hub.render()
+    assert ('tpu_operator_stuck_nodes{component="libtpu",'
+            'state="pod-restart-required"} 1') in gauge
+
+    # leader failover: a brand-new detector instance (and hub) re-checks —
+    # the annotation marker must suppress a duplicate Event, the gauge
+    # must stay raised
+    hub2 = MetricsHub()
+    successor = _detector(cluster, clock, keys, hub2)
+    clock.advance(60)
+    nodes = cluster.client.direct().list_nodes()
+    report2 = successor.check(nodes)
+    assert report2["reported"] == []
+    assert [(n, s) for n, s, _ in report2["stuck"]] == [
+        ("n0", UpgradeState.POD_RESTART_REQUIRED)]
+    assert len([e for e in cluster.recorder.events
+                if e.reason == "StuckNode"]) == 1
+    assert ('tpu_operator_stuck_nodes{component="libtpu",'
+            'state="pod-restart-required"} 1') in hub2.render()
+
+
+def test_stuck_marker_cleared_on_transition_and_reraised_on_reentry():
+    """Leaving the state drops the gauge; a LATER re-entry that dwells past
+    the threshold again is a NEW incident and gets its own (single) event."""
+    cluster, clock, keys = _stuck_env()
+    hub = MetricsHub()
+    detector = _detector(cluster, clock, keys, hub)
+    threshold = DEFAULT_STUCK_THRESHOLDS[UpgradeState.POD_RESTART_REQUIRED]
+
+    clock.advance(threshold + 1)
+    detector.check(cluster.client.direct().list_nodes())
+    assert len([e for e in cluster.recorder.events
+                if e.reason == "StuckNode"]) == 1
+
+    provider = NodeUpgradeStateProvider(cluster.client, keys,
+                                        cluster.recorder, clock)
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.VALIDATION_REQUIRED)
+    n = cluster.client.direct().get_node("n0")
+    assert keys.stuck_reported_annotation not in n.metadata.annotations
+    detector.check([n])
+    assert ('tpu_operator_stuck_nodes{component="libtpu",'
+            'state="pod-restart-required"} 0') in hub.render()
+
+    # re-enter and dwell past the threshold again -> second incident
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.POD_RESTART_REQUIRED)
+    clock.advance(threshold + 1)
+    detector.check(cluster.client.direct().list_nodes())
+    assert len([e for e in cluster.recorder.events
+                if e.reason == "StuckNode"]) == 2
+
+
+def test_zero_threshold_states_never_stuck():
+    cluster, clock, keys = _stuck_env()
+    provider = NodeUpgradeStateProvider(cluster.client, keys,
+                                        cluster.recorder, clock)
+    node = provider.get_node("n0")
+    provider.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+    detector = _detector(cluster, clock, keys)
+    clock.advance(10 ** 6)
+    report = detector.check(cluster.client.direct().list_nodes())
+    assert report["stuck"] == []
+
+
+def test_custom_threshold_override():
+    cluster, clock, keys = _stuck_env()
+    detector = StuckNodeDetector(
+        cluster.client.direct(), component="libtpu",
+        state_label=keys.state_label,
+        annotation_key=keys.journey_annotation,
+        stuck_key=keys.stuck_reported_annotation,
+        thresholds={UpgradeState.POD_RESTART_REQUIRED: 5.0},
+        recorder=cluster.recorder, clock=clock)
+    clock.advance(6)
+    report = detector.check(cluster.client.direct().list_nodes())
+    assert len(report["reported"]) == 1
+
+
+def test_threshold_table_closed_over_upgrade_states():
+    """Every UpgradeState wire value has a stuck-threshold default (the
+    invariant OBS001 enforces statically, pinned at runtime too)."""
+    assert set(DEFAULT_STUCK_THRESHOLDS) == set(UpgradeState.ALL)
+
+
+# --------------------------------------------------- status.py --timeline
+
+
+def test_status_timeline_renders_canned_upgrade_run(capsys):
+    """Acceptance: `cmd/status.py --timeline <node>` renders the node's
+    FULL state journey with per-phase durations after a real (canned)
+    rolling-upgrade run through the state machine."""
+    import importlib.util
+    import os
+
+    from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
+                                                    DriverUpgradePolicySpec)
+    from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+
+    spec = importlib.util.spec_from_file_location(
+        "status_cli_obs", os.path.join(os.path.dirname(__file__), "..",
+                                       "cmd", "status.py"))
+    status = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(status)
+
+    clock = FakeClock(1_700_000_000.0)
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    ds = cluster.add_daemonset("libtpu", namespace="tpu",
+                               labels={"app": "d"}, revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("d-0", "n0", namespace="tpu", owner_ds=ds,
+                    revision_hash="v1")
+    cluster.bump_daemonset_revision("libtpu", "tpu", "v2")
+    keys = KeyFactory("libtpu")
+    mgr = ClusterUpgradeStateManager(cluster.client, keys,
+                                     cluster.recorder, clock,
+                                     synchronous=True)
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    for _ in range(12):
+        mgr.apply_state(mgr.build_state("tpu", {"app": "d"}), policy)
+        cluster.reconcile_daemonsets()
+        clock.advance(30)
+        node = cluster.client.direct().get_node("n0")
+        if node.metadata.labels.get(keys.state_label) == UpgradeState.DONE:
+            break
+    assert cluster.client.direct().get_node("n0").metadata.labels[
+        keys.state_label] == UpgradeState.DONE
+
+    rc = status.main(["--component", "libtpu", "--timeline", "n0"],
+                     client=cluster.client, now=clock.wall())
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the full pipeline appears in order, each with a duration column
+    idx = [out.index(s) for s in
+           ("upgrade-required", "cordon-required", "drain-required",
+            "pod-restart-required", "uncordon-required", "upgrade-done")]
+    assert idx == sorted(idx), out
+    # closed phases advanced by the 30 s tick cadence; the terminal state
+    # is marked ongoing
+    assert "30.0s" in out or "1.0m" in out or "30s" in out, out
+    assert "+" in out
+    assert "transitions" in out
+
+    # machine-readable variant carries the same rows
+    rc = status.main(["--component", "libtpu", "--timeline", "n0",
+                      "--json"], client=cluster.client, now=clock.wall())
+    payload = json.loads(capsys.readouterr().out)
+    states = [r["state"] for r in payload["libtpu"]["timeline"]]
+    assert states[0] == "upgrade-required"
+    assert states[-1] == "upgrade-done"
+    assert payload["libtpu"]["timeline"][-1]["ongoing"] is True
+    assert all(r["duration_s"] >= 0 for r in payload["libtpu"]["timeline"])
+
+
+# -------------------------------------------------- Client-backed recorder
+
+
+def test_client_event_recorder_against_fake_cluster():
+    cluster = FakeCluster()
+    cluster.add_node("n0")
+    rec = ClientEventRecorder(cluster.client)
+    node = cluster.client.direct().get_node("n0")
+    rec.event(node, "Warning", "TestReason", "hello")
+    events = [e for e in cluster.recorder.events if e.reason == "TestReason"]
+    assert len(events) == 1
+    assert events[0].object_kind == "Node"
+    assert events[0].object_name == "n0"
+    assert events[0].event_type == "Warning"
+
+
+def test_client_event_recorder_swallows_failures():
+    class Broken:
+        def create_event(self, event, namespace="default"):
+            raise RuntimeError("apiserver down")
+
+    rec = ClientEventRecorder(Broken())
+    rec.event(object(), "Normal", "R", "m")  # must not raise
+
+
+def test_client_event_recorder_noop_without_create_event():
+    class NoEvents:
+        pass
+
+    rec = ClientEventRecorder(NoEvents())
+    rec.event(object(), "Normal", "R", "m")  # must not raise

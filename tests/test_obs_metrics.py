@@ -1,0 +1,449 @@
+"""Prometheus exposition of the full observability surface.
+
+Centerpiece: a text-exposition VALIDATOR (HELP/TYPE ordering, family
+contiguity, no duplicate families, histogram bucket monotonicity and +Inf
+closure, _count consistency) run against the complete /metrics output of a
+simulated multi-component operator — the acceptance bar: at least 4
+histogram families (phase duration, tick duration, drain duration,
+placement latency) all passing the validator. Plus the build-info / leader
+identity gauges, the real-HELP registry, and the cordon / drain-failure /
+stuck-node Event trail through the Client-backed recorder over the fake
+apiserver.
+"""
+
+import json
+import re
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
+                                                DriverUpgradePolicySpec)
+from k8s_operator_libs_tpu.core.client import ClientEventRecorder
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.health.classifier import ClassifierConfig
+from k8s_operator_libs_tpu.health.monitor import HealthOptions
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub, help_for
+from k8s_operator_libs_tpu.obs.trace import ListSink, Tracer
+from k8s_operator_libs_tpu.tpu.operator import (ManagedComponent,
+                                                TPUOperator)
+from k8s_operator_libs_tpu.tpu.scheduler import TPUWorkload
+from k8s_operator_libs_tpu.tpu.topology import (GKE_ACCELERATOR_LABEL,
+                                                GKE_NODEPOOL_LABEL,
+                                                GKE_TOPOLOGY_LABEL)
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.metrics import render_prometheus
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+NS = "kube-system"
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$")
+
+
+def validate_exposition(text):
+    """Prometheus text-format validator. Checks, per the exposition spec:
+    HELP then TYPE then samples for each family, each family declared once
+    and contiguous, sample names belonging to the declared family
+    (histograms: only _bucket/_sum/_count), parseable values; histograms:
+    `le` bounds strictly increasing, cumulative bucket counts
+    non-decreasing, +Inf present and equal to _count. Returns
+    (families {name: type}, samples {family: [(name, labels, value)]})."""
+    families, samples = {}, {}
+    seen, current, pending_help = set(), None, None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            assert pending_help is None, \
+                f"family {pending_help} has HELP but no TYPE"
+            assert name not in seen, f"duplicate family {name}"
+            assert help_text.strip(), f"empty HELP for {name}"
+            seen.add(name)
+            pending_help, current = name, None
+        elif line.startswith("# TYPE "):
+            name, _, mtype = line[len("# TYPE "):].partition(" ")
+            assert pending_help == name, \
+                f"TYPE {name} not immediately after its HELP"
+            mtype = mtype.strip()
+            assert mtype in ("gauge", "counter", "histogram"), mtype
+            families[name] = mtype
+            current, pending_help = name, None
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line {line!r}"
+            sname, labelstr, value = m.groups()
+            assert current is not None, f"sample {sname} outside any family"
+            if families[current] == "histogram":
+                assert (sname.startswith(current)
+                        and sname[len(current):] in ("_bucket", "_sum",
+                                                     "_count")), \
+                    f"{sname} is not a series of histogram {current}"
+            else:
+                assert sname == current, \
+                    f"{sname} inside family block of {current}"
+            labels = dict(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                     labelstr or ""))
+            samples.setdefault(current, []).append(
+                (sname, labels, float(value)))
+    for fam, mtype in families.items():
+        if mtype != "histogram":
+            continue
+        series = {}
+        for sname, labels, value in samples[fam]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            d = series.setdefault(key, {"buckets": [], "sum": None,
+                                        "count": None})
+            if sname.endswith("_bucket"):
+                d["buckets"].append((labels["le"], value))
+            elif sname.endswith("_sum"):
+                d["sum"] = value
+            else:
+                d["count"] = value
+        assert series, f"histogram {fam} has no series"
+        for key, d in series.items():
+            les = [le for le, _ in d["buckets"]]
+            assert les and les[-1] == "+Inf", \
+                f"{fam}{dict(key)} missing +Inf bucket"
+            bounds = [float(le) for le in les[:-1]]
+            assert bounds == sorted(set(bounds)), \
+                f"{fam}{dict(key)} le bounds not strictly increasing"
+            counts = [c for _, c in d["buckets"]]
+            assert counts == sorted(counts), \
+                f"{fam}{dict(key)} bucket counts not cumulative"
+            assert d["count"] == counts[-1], \
+                f"{fam}{dict(key)} _count != +Inf bucket"
+            assert d["sum"] is not None, f"{fam}{dict(key)} missing _sum"
+    return families, samples
+
+
+# ------------------------------------------------- validator self-checks
+
+
+def test_validator_rejects_malformed_expositions():
+    with pytest.raises(AssertionError, match="duplicate family"):
+        validate_exposition("# HELP a b\n# TYPE a gauge\na 1\n"
+                            "# HELP a b\n# TYPE a gauge\na 2\n")
+    with pytest.raises(AssertionError, match="HELP but no TYPE"):
+        validate_exposition("# HELP a b\n# HELP c d\n# TYPE c gauge\nc 1\n")
+    with pytest.raises(AssertionError, match="not immediately after"):
+        validate_exposition("# HELP a b\n# TYPE c gauge\nc 1\n")
+    with pytest.raises(AssertionError, match="inside family block"):
+        validate_exposition("# HELP a b\n# TYPE a gauge\nz 1\n")
+    with pytest.raises(AssertionError, match="missing \\+Inf"):
+        validate_exposition('# HELP h x\n# TYPE h histogram\n'
+                            'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+    with pytest.raises(AssertionError, match="not cumulative"):
+        validate_exposition('# HELP h x\n# TYPE h histogram\n'
+                            'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\n'
+                            'h_sum 1\nh_count 1\n')
+
+
+def test_hub_render_passes_validator_and_help_registry():
+    hub = MetricsHub()
+    hub.observe("phase_duration_seconds", 12.5,
+                labels={"component": "libtpu", "state": "drain-required"})
+    hub.set_gauge("leader", 1.0)
+    families, _ = validate_exposition(hub.render())
+    assert families["tpu_operator_phase_duration_seconds"] == "histogram"
+    assert families["tpu_operator_leader"] == "gauge"
+    # registry descriptions for known names; graceful fallback otherwise
+    assert "journey choke point" in help_for(
+        "tpu_operator_phase_duration_seconds")
+    assert help_for("tpu_operator_made_up_name") == \
+        "tpu operator made up name"
+
+
+def test_upgrade_gauges_carry_registry_help_with_fallback(cluster, clock):
+    """Satellite: the auto-generated HELP (name with spaces) is replaced by
+    the shared description registry; unknown names keep the fallback."""
+    text = render_prometheus("libtpu", {"upgrades_done": 3,
+                                        "custom_consumer_metric": 1})
+    assert ("# HELP tpu_operator_upgrades_done Nodes whose driver upgrade "
+            "completed (state upgrade-done)") in text
+    assert ("# HELP tpu_operator_custom_consumer_metric custom consumer "
+            "metric") in text  # fallback preserved
+    validate_exposition(text)
+
+
+# ------------------------------------- full simulated operator /metrics
+
+
+SLICE_LABELS = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-a"}
+
+
+def _seed_fleet(cluster):
+    """A 4-host v5e slice running TWO managed components, plus one plain
+    node whose libtpu driver pod crash-loops (health fodder)."""
+    ds_l = cluster.add_daemonset("libtpu", namespace=NS,
+                                 labels={"app": "libtpu"},
+                                 revision_hash="v1")
+    ds_t = cluster.add_daemonset("tdp", namespace=NS, labels={"app": "tdp"},
+                                 revision_hash="v1")
+    for i in range(4):
+        cluster.add_node(f"h{i}", labels=SLICE_LABELS)
+        cluster.add_pod(f"libtpu-h{i}", f"h{i}", namespace=NS, owner_ds=ds_l,
+                        revision_hash="v1")
+        cluster.add_pod(f"tdp-h{i}", f"h{i}", namespace=NS, owner_ds=ds_t,
+                        revision_hash="v1")
+    cluster.add_node("sick")
+    cluster.add_pod("libtpu-sick", "sick", namespace=NS, owner_ds=ds_l,
+                    revision_hash="v1")
+    cluster.add_pod("tdp-sick", "sick", namespace=NS, owner_ds=ds_t,
+                    revision_hash="v1")
+    cluster.set_pod_status(NS, "libtpu-sick", ready=False, restart_count=12)
+    return ds_l, ds_t
+
+
+def _multi_component_operator(cluster, clock, hub, tracer):
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="100%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    health = HealthOptions(
+        classifier=ClassifierConfig(damping_seconds=0.0,
+                                    persist_seconds=10 ** 9))
+    return TPUOperator(
+        cluster.client,
+        components=[
+            ManagedComponent(name="libtpu", namespace=NS,
+                             driver_labels={"app": "libtpu"}, policy=policy),
+            ManagedComponent(name="tdp", namespace=NS,
+                             driver_labels={"app": "tdp"}, policy=policy),
+        ],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        health=health, tracer=tracer, metrics=hub)
+
+
+def test_simulated_operator_metrics_expose_four_histograms(clock):
+    """The acceptance criterion: the full /metrics text of a simulated
+    multi-component operator (rolling upgrade with drains + health
+    quarantine + workload placement) exposes >= 4 histogram families and
+    passes the exposition validator end to end."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "operator_cli_obs", os.path.join(os.path.dirname(__file__), "..",
+                                         "cmd", "operator.py"))
+    op_cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(op_cli)
+
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    _seed_fleet(cluster)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    hub = MetricsHub()
+    tracer = Tracer(sink=ListSink(), clock=clock)
+    op = _multi_component_operator(cluster, clock, hub, tracer)
+    keys = KeyFactory("libtpu")
+
+    states = {}
+    for _ in range(80):
+        states = op.reconcile()
+        cluster.reconcile_daemonsets()
+        clock.advance(5)
+        done = all(
+            n.metadata.labels.get(keys.state_label) == UpgradeState.DONE
+            for n in cluster.client.direct().list_nodes()
+            if n.metadata.name.startswith("h"))
+        if done:
+            break
+    assert done, "slice upgrade never completed"
+
+    # workload placement AFTER the slice returned to service
+    op.submit(TPUWorkload(name="train",
+                          accelerator="tpu-v5-lite-podslice",
+                          topology="4x4"))
+    states = op.reconcile()
+    assert op.placements, "workload never placed"
+
+    hub.set_gauge("build_info", 1.0,
+                  labels={"version": "test", "components": "libtpu,tdp"})
+    hub.set_gauge("leader", 1.0)
+    text = op_cli.render_metrics(op, states, hub)
+
+    families, samples = validate_exposition(text)
+    histograms = {name for name, mtype in families.items()
+                  if mtype == "histogram"}
+    assert {"tpu_operator_phase_duration_seconds",
+            "tpu_operator_reconcile_tick_duration_seconds",
+            "tpu_operator_drain_duration_seconds",
+            "tpu_operator_placement_latency_seconds"} <= histograms
+    assert len(histograms) >= 4
+    # health reaction time observed for the quarantined sick node
+    assert "tpu_operator_health_reaction_seconds" in histograms
+    # phase durations labelled per component and state (tdp never drifted,
+    # so only libtpu transitioned more than once and has closed phases)
+    phase = samples["tpu_operator_phase_duration_seconds"]
+    assert {lbl.get("component") for _, lbl, _ in phase} == {"libtpu"}
+    phase_states = {lbl.get("state") for _, lbl, _ in phase}
+    assert {"upgrade-required", "drain-required",
+            "pod-restart-required"} <= phase_states
+    # upgrade gauges for BOTH components share one family block
+    upgrade_done = samples["tpu_operator_upgrades_done"]
+    assert {lbl["component"] for _, lbl, _ in upgrade_done} == {"libtpu",
+                                                                "tdp"}
+    # identity gauges
+    assert ("tpu_operator_build_info", {"version": "test",
+                                        "components": "libtpu,tdp"}, 1.0) \
+        in samples["tpu_operator_build_info"]
+    assert samples["tpu_operator_leader"][0][2] == 1.0
+    # the trace recorded the tick tree: root + per-component apply_state
+    names = {r["name"] for r in tracer.sink.records}
+    assert {"reconcile-tick", "apply_state", "process_drain_nodes",
+            "health-tick", "placement"} <= names
+    # health verdict-change event for the sick node rode the recorder
+    assert any(e.reason == "FleetHealthVerdict" and "sick" in e.message
+               for e in cluster.recorder.events)
+
+
+# -------------------------------------- operator binary: identity + trace
+
+
+def test_operator_binary_serves_identity_and_histograms(tmp_path):
+    """cmd/operator.py end to end: /metrics carries build_info (version +
+    components), the leader gauge, and the tick-duration histogram — all
+    validator-clean; --trace-log writes parseable span JSONL."""
+    import importlib.util
+    import os
+    import threading
+    import time
+    import urllib.request
+
+    import yaml
+
+    from k8s_operator_libs_tpu.core.httpapi import FakeAPIServer
+
+    spec = importlib.util.spec_from_file_location(
+        "operator_cli_obs2", os.path.join(os.path.dirname(__file__), "..",
+                                          "cmd", "operator.py"))
+    op = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(op)
+
+    cluster = FakeCluster()
+    ds = cluster.add_daemonset("libtpu", namespace="tpu",
+                               labels={"app": "d"}, revision_hash="v1")
+    for i in range(2):
+        cluster.add_node(f"n{i}")
+        cluster.add_pod(f"d-{i}", f"n{i}", namespace="tpu", owner_ds=ds,
+                        revision_hash="v1")
+
+    srv = FakeAPIServer(cluster).start()
+    kc = tmp_path / "kubeconfig"
+    kc.write_text(yaml.safe_dump({
+        "current-context": "fake",
+        "contexts": [{"name": "fake",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": srv.base_url}}],
+        "users": [{"name": "u", "user": {}}],
+    }))
+    cfg = tmp_path / "operator.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "components": [{"name": "libtpu", "namespace": "tpu",
+                        "driverLabels": {"app": "d"},
+                        "policy": {"autoUpgrade": True}}]}))
+    trace_path = tmp_path / "trace.jsonl"
+    stop = threading.Event()
+    captured = {}
+    rcs = []
+    t = threading.Thread(target=lambda: rcs.append(op.main(
+        ["--config", str(cfg), "--kubeconfig", str(kc), "--uncached",
+         "--interval", "0.1", "--metrics-port", "0",
+         "--trace-log", str(trace_path)],
+        stop=stop, on_ready=lambda s: captured.update(server=s))))
+    t.start()
+    try:
+        deadline = time.time() + 15
+        body = ""
+        while time.time() < deadline:
+            server = captured.get("server")
+            if server is not None and server.snapshot["healthy"]:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{server.port}/metrics") as r:
+                    body = r.read().decode()
+                if "tpu_operator_build_info" in body:
+                    break
+            time.sleep(0.05)
+        assert "tpu_operator_build_info" in body, body[:400]
+        families, samples = validate_exposition(body)
+        info = samples["tpu_operator_build_info"][0][1]
+        assert info["components"] == "libtpu" and info["version"]
+        assert samples["tpu_operator_leader"][0][2] == 1.0
+        assert families["tpu_operator_reconcile_tick_duration_seconds"] \
+            == "histogram"
+    finally:
+        stop.set()
+        t.join(timeout=20)
+        srv.stop()
+    assert rcs == [0]
+    records = [json.loads(line)
+               for line in trace_path.read_text().splitlines()]
+    assert any(r["name"] == "reconcile-tick" for r in records)
+    assert any(r["name"] == "apply_state"
+               and r["attrs"].get("component") == "libtpu"
+               for r in records)
+
+
+# ------------------------------------ event trail over the fake apiserver
+
+
+def test_events_recorded_for_cordon_drain_failure_and_stuck(tmp_path):
+    """Satellite: the Client-backed recorder (the cmd/operator.py default)
+    persists real Events through the fake apiserver for the three moments
+    an on-call operator greps for: admission/cordon, drain failure, and a
+    stuck node."""
+    from k8s_operator_libs_tpu.core.httpapi import FakeAPIServer
+    from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
+                                                       LiveClient)
+    from k8s_operator_libs_tpu.obs.journey import StuckNodeDetector
+    from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager
+
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock)
+    ds = cluster.add_daemonset("libtpu", namespace="tpu",
+                               labels={"app": "d"}, revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("d-0", "n0", namespace="tpu", owner_ds=ds,
+                    revision_hash="v1")
+    cluster.add_pod("workload", "n0")  # non-DS pod the drain must evict
+    cluster.block_eviction("default", "workload", times=10_000)
+    cluster.bump_daemonset_revision("libtpu", "tpu", "v2")
+
+    with FakeAPIServer(cluster) as srv:
+        cli = LiveClient(KubeHTTP(KubeConfig(server=srv.base_url)))
+        recorder = ClientEventRecorder(cli)
+        keys = KeyFactory("libtpu")
+        mgr = ClusterUpgradeStateManager(cli, keys, recorder, clock,
+                                         synchronous=True)
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable="100%",
+            drain=DrainSpec(enable=True, force=True, timeout_second=30))
+        for _ in range(8):
+            mgr.apply_state(mgr.build_state("tpu", {"app": "d"}), policy)
+            node = cli.get_node("n0")
+            if node.metadata.labels.get(keys.state_label) \
+                    == UpgradeState.FAILED:
+                break
+        assert cli.get_node("n0").metadata.labels[keys.state_label] \
+            == UpgradeState.FAILED
+
+        # stuck detection over the SAME recorder: FAILED has a 1h threshold
+        detector = StuckNodeDetector(
+            cli, component="libtpu", state_label=keys.state_label,
+            annotation_key=keys.journey_annotation,
+            stuck_key=keys.stuck_reported_annotation,
+            recorder=recorder, clock=clock)
+        clock.advance(3601)
+        report = detector.check([cli.get_node("n0")])
+        assert len(report["reported"]) == 1
+
+    events = cluster.recorder.events
+    assert any("cordon-required" in e.message for e in events), \
+        "no cordon admission event"
+    assert any(e.event_type == "Warning" and "Failed to drain" in e.message
+               for e in events), "no drain-failure event"
+    stuck_events = [e for e in events if e.reason == "StuckNode"]
+    assert len(stuck_events) == 1
+    assert "upgrade-failed" in stuck_events[0].message

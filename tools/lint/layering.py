@@ -45,10 +45,14 @@ LAYERS: Dict[str, Set[str]] = {
     "api": {"utils"},
     "consts": set(),
     "core": {"utils", "api"},
+    # obs sits BELOW upgrade/health/tpu: they import its tracer/journey/
+    # metrics hub, and obs must never import them back (its stuck-threshold
+    # table is keyed by wire values; OBS001 keeps it closed)
+    "obs": {"core", "utils"},
     "crdutil": {"core", "utils", "api"},
-    "upgrade": {"core", "utils", "api"},
-    "health": {"core", "utils", "api", "upgrade"},
-    "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health"},
+    "upgrade": {"core", "utils", "api", "obs"},
+    "health": {"core", "utils", "api", "upgrade", "obs"},
+    "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs"},
     "data": {"utils"},
     "ops": {"utils"},
     "models": {"ops", "utils", "data"},

@@ -1,0 +1,213 @@
+"""OBS001: upgrade-journey observability closure — thresholds and the
+transition choke point can never drift.
+
+The journey subsystem (``k8s_operator_libs_tpu/obs/journey.py``) sits
+BELOW the upgrade package in the layering DAG, so its per-state stuck
+thresholds are keyed by the state **wire values**, not by
+``UpgradeState.X`` references the type system would check. This cross-file
+pass (AST only, no imports) closes that gap in both directions, plus the
+choke-point invariant that makes the journey trustworthy:
+
+- **threshold closure**: every string member of ``UpgradeState``
+  (``upgrade/consts.py``) must appear as a literal key of
+  ``DEFAULT_STUCK_THRESHOLDS`` in obs/journey.py — a new pipeline state
+  without a stuck-threshold default is invisible to the detector;
+- **no stale thresholds**: a ``DEFAULT_STUCK_THRESHOLDS`` key that is no
+  longer any state's wire value is dead configuration (a renamed state
+  silently losing its threshold is exactly this, seen from the other
+  side);
+- **choke point**: the state label and the journey annotation may be
+  WRITTEN only by the provider choke point
+  (``upgrade/node_state_provider.py``). Any other module patching node
+  metadata with the state-label key (``.state_label`` /
+  ``STATE_LABEL_FMT`` / a ``*-driver-upgrade-state`` literal) or the
+  journey key (``.journey_annotation`` / ``JOURNEY_ANNOTATION_FMT`` / a
+  ``*-driver-upgrade.journey`` literal) bypasses the journey recording
+  and desynchronizes timeline from label — reads are fine, writes fire.
+
+Proven on mutated copies of the real files by tests/test_lint_domain.py,
+like STM001.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from .registry import Check, register
+
+CODES = {
+    "OBS001": "upgrade-journey drift: state without a stuck-threshold "
+              "default, stale threshold key, or a state/journey write "
+              "outside the provider choke point",
+}
+
+CONSTS_PATH = "k8s_operator_libs_tpu/upgrade/consts.py"
+JOURNEY_PATH = "k8s_operator_libs_tpu/obs/journey.py"
+# the ONLY module allowed to write the state label / journey annotation
+CHOKE_PATH = "k8s_operator_libs_tpu/upgrade/node_state_provider.py"
+# package trees scanned for choke-point violations
+SCAN_ROOTS = ("k8s_operator_libs_tpu", "cmd")
+
+# attribute / constant / literal-substring markers of the guarded keys
+STATE_KEY_ATTRS = {"state_label"}
+STATE_KEY_NAMES = {"STATE_LABEL_FMT"}
+STATE_KEY_LITERAL = "-driver-upgrade-state"
+JOURNEY_KEY_ATTRS = {"journey_annotation"}
+JOURNEY_KEY_NAMES = {"JOURNEY_ANNOTATION_FMT"}
+JOURNEY_KEY_LITERAL = "-driver-upgrade.journey"
+
+# node-metadata write methods whose labels/annotations arguments are
+# checked (the abstract Client write path plus the provider's own wrappers,
+# which a bypasser could call with a raw key)
+WRITE_METHODS = {"patch_node_metadata", "change_node_upgrade_annotation",
+                 "change_node_state_and_annotations",
+                 "change_nodes_state_and_annotations"}
+
+Finding = Tuple[str, int, str, str]
+
+
+def _parse(root: Path, rel: str) -> ast.Module:
+    return ast.parse((root / rel).read_text(), filename=rel)
+
+
+def _state_wire_values(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
+    """UpgradeState string members → {member: (wire value, lineno)}."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "UpgradeState"):
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                out[stmt.targets[0].id] = (stmt.value.value, stmt.lineno)
+    return out
+
+
+def _threshold_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
+    """Literal string keys of DEFAULT_STUCK_THRESHOLDS → ({key: lineno},
+    lineno of the table itself; 0 when the table is missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):  # DEFAULT_...: Dict[...] = {}
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "DEFAULT_STUCK_THRESHOLDS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}, node.lineno
+        keys: Dict[str, int] = {}
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                keys[key.value] = key.lineno
+        return keys, node.lineno
+    return {}, 0
+
+
+def _mentions_guarded_key(node: ast.AST, attrs: Set[str], names: Set[str],
+                          literal: str) -> bool:
+    """Does any subexpression reference one of the guarded keys?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in attrs:
+            return True
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+        if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and literal in n.value):
+            return True
+    return False
+
+
+def _call_payloads(call: ast.Call):
+    """(labels-like, annotations-like) argument expressions of a write
+    call: keyword args by name, plus every positional after the first
+    (node/name) — keys could hide in either payload position."""
+    labels = [kw.value for kw in call.keywords if kw.arg == "labels"]
+    annos = [kw.value for kw in call.keywords
+             if kw.arg in ("annotations",)]
+    rest = list(call.args[1:])
+    return labels + rest, annos + rest
+
+
+def _choke_violations(root: Path, rel: str,
+                      tree: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in WRITE_METHODS):
+            continue
+        label_args, anno_args = _call_payloads(node)
+        if any(_mentions_guarded_key(a, STATE_KEY_ATTRS, STATE_KEY_NAMES,
+                                     STATE_KEY_LITERAL)
+               for a in label_args):
+            findings.append(
+                (rel, node.lineno, "OBS001",
+                 f"direct write of the upgrade state-label key outside the "
+                 f"choke point ({CHOKE_PATH}) bypasses journey recording"))
+        if any(_mentions_guarded_key(a, JOURNEY_KEY_ATTRS,
+                                     JOURNEY_KEY_NAMES,
+                                     JOURNEY_KEY_LITERAL)
+               for a in anno_args):
+            findings.append(
+                (rel, node.lineno, "OBS001",
+                 f"direct write of the journey annotation outside the "
+                 f"choke point ({CHOKE_PATH}) desynchronizes the timeline "
+                 f"from the state label"))
+    return findings
+
+
+def run_project(root: Path) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+
+    members = _state_wire_values(_parse(root, CONSTS_PATH))
+    if not members:
+        return [(CONSTS_PATH, 1, "OBS001",
+                 "no UpgradeState string members found (parse drift?)")]
+    thresholds, table_line = _threshold_keys(_parse(root, JOURNEY_PATH))
+    if table_line == 0:
+        return [(JOURNEY_PATH, 1, "OBS001",
+                 "DEFAULT_STUCK_THRESHOLDS table not found (parse drift?)")]
+
+    wire_values = {v for v, _ in members.values()}
+    for name, (value, lineno) in sorted(members.items()):
+        if value not in thresholds:
+            findings.append(
+                (CONSTS_PATH, lineno, "OBS001",
+                 f"state {name} ({value!r}) has no stuck-threshold default "
+                 f"in DEFAULT_STUCK_THRESHOLDS ({JOURNEY_PATH})"))
+    for key, lineno in sorted(thresholds.items()):
+        if key not in wire_values:
+            findings.append(
+                (JOURNEY_PATH, lineno, "OBS001",
+                 f"stuck-threshold key {key!r} matches no UpgradeState "
+                 f"wire value (renamed or removed state?)"))
+
+    for scan_root in SCAN_ROOTS:
+        base = root / scan_root
+        if not base.exists():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = str(path.relative_to(root))
+            if rel == CHOKE_PATH:
+                continue
+            try:
+                tree = ast.parse(path.read_text(), filename=rel)
+            except SyntaxError:
+                continue  # the generic pass reports E999
+            findings.extend(_choke_violations(root, rel, tree))
+    return findings
+
+
+register(Check(name="obs-journey", codes=CODES, scope="project",
+               run=run_project, domain=True))
