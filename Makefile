@@ -11,9 +11,9 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-devel
 DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
-.PHONY: all native test test-fast test-health test-obs health-sim lint \
-  lint-domain cov-report cov-artifact bench dryrun apply-crds-dry clean \
-  $(DOCKER_TARGETS) .build-image
+.PHONY: all native test test-fast test-health test-obs test-obs-workload \
+  health-sim lint lint-domain cov-report cov-artifact bench dryrun \
+  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -34,6 +34,9 @@ test-health:  ## fleet-health subsystem tests (docs/fleet-health.md)
 
 test-obs:  ## observability tests: tracing, journey, stuck detection, exposition validator (docs/observability.md)
 	$(PYTHON) -m pytest tests/test_obs.py tests/test_obs_metrics.py -q
+
+test-obs-workload:  ## workload telemetry: goodput ledger, serving metrics, downtime attribution (docs/observability.md)
+	$(PYTHON) -m pytest tests/test_goodput.py tests/test_workload_obs.py -q
 
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py

@@ -20,6 +20,13 @@ HTTP surface (stdlib ThreadingHTTPServer, JSON):
   In-flight requests still finish and their /generate calls return.
 - ``GET  /healthz``   → 200 "ok", or 503 once draining (flips the
   readiness probe so the Service stops routing here).
+- ``GET  /metrics``   → Prometheus text under the ``tpu_workload``
+  prefix, rendered through the SAME exposition path the operator uses
+  (``render_prometheus_multi`` for the process gauges +
+  ``MetricsHub.render`` for the batcher's TTFT / inter-token /
+  queue-wait / occupancy / KV-utilization histograms —
+  docs/observability.md's workload-telemetry catalog). ``--trace-log``
+  additionally appends one ``serve-step`` span per batcher step.
 
 One background stepper thread owns the batcher (submit/poll are guarded
 by a lock — the batcher itself is deliberately single-threaded);
@@ -73,12 +80,15 @@ class ServingRuntime:
     """Batcher + stepper thread + completion events."""
 
     def __init__(self, params, cfg, max_slots, capacity, block_size,
-                 chunk, shared_prefix=None):
+                 chunk, shared_prefix=None, hub=None, tracer=None):
         from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
+        from k8s_operator_libs_tpu.obs import MetricsHub
+        self.hub = hub if hub is not None else MetricsHub()
         self.srv = ContinuousBatcher(params, cfg, max_slots=max_slots,
                                      capacity_per_slot=capacity,
                                      block_size=block_size,
-                                     shared_prefix=shared_prefix)
+                                     shared_prefix=shared_prefix,
+                                     metrics=self.hub, tracer=tracer)
         self.chunk = chunk
         self.lock = threading.Lock()
         self.results = {}
@@ -140,6 +150,25 @@ class ServingRuntime:
         with self.lock:
             return sorted(set(self.results) | set(self.events))
 
+    def metrics_text(self):
+        """The /metrics body: process-level gauges through the operator's
+        render_prometheus_multi (HELP/TYPE from the shared registry),
+        then the batcher's histogram/gauge families from the hub — all
+        under the tpu_workload prefix so a combined operator+workload
+        scrape never collides (the exposition validator test pins the
+        concatenation)."""
+        from k8s_operator_libs_tpu.upgrade.metrics import (
+            render_prometheus_multi)
+        with self.lock:
+            # serve_draining is the batcher's gauge (hub) — only the
+            # process-level facts live here, or the families would
+            # duplicate in the concatenated exposition
+            gauges = {"serve_up": 1.0,
+                      "serve_failed": 1.0 if self.failed else 0.0}
+        text = render_prometheus_multi({"serve": gauges},
+                                       prefix="tpu_workload")
+        return text + self.hub.render(prefix="tpu_workload")
+
     def _loop(self):
         import time
         while not self._stop.is_set():
@@ -194,6 +223,14 @@ def make_handler(rt: ServingRuntime):
                     self._json(503, {"status": "draining"})
                 else:
                     self._json(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                body = rt.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -285,13 +322,24 @@ def main(argv=None):
                     help="termination grace period (s): the SIGTERM drain "
                          "gives up and shuts down after this deadline, "
                          "logging undelivered request ids")
+    ap.add_argument("--trace-log", default=None, metavar="PATH",
+                    help="append serve-step span records (one JSON object "
+                         "per line) to PATH (docs/observability.md)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
 
+    from k8s_operator_libs_tpu import __version__
+    from k8s_operator_libs_tpu.obs import JsonlSink, MetricsHub, Tracer
+    hub = MetricsHub()
+    hub.set_gauge("build_info", 1.0, labels={"version": __version__,
+                                             "model": args.model})
+    tracer = Tracer(sink=JsonlSink(args.trace_log)) if args.trace_log \
+        else None
     params, cfg = build_params(args)
     rt = ServingRuntime(params, cfg, args.max_slots, args.capacity,
-                        args.block_size, args.chunk)
+                        args.block_size, args.chunk, hub=hub,
+                        tracer=tracer)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(rt))
 
     def on_term(signum, frame):

@@ -16,9 +16,21 @@ maintains (docs/observability.md):
 
     python cmd/status.py --component libtpu --timeline v5p-host-3
 
+``--goodput <ledger>`` renders the WORKLOAD side: the goodput/badput
+decomposition of a training job's goodput.jsonl ledger (written next to
+its checkpoints by cmd/train.py), and — with ``--goodput-node`` — joins
+each cross-restart unavailability window against that node's journey,
+splitting it into the named operator phases (window_to_gate /
+window_gate_to_restart / window_after_restart) plus the workload's own
+drain-save / restore / re-warmup badput:
+
+    python cmd/status.py --component libtpu \
+        --goodput /ckpt/run1/goodput.jsonl --goodput-node v5p-host-3
+
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
-gates and scripts can wait on it. ``--timeline`` always exits 0.
+gates and scripts can wait on it. ``--timeline`` and ``--goodput``
+always exit 0.
 """
 
 import argparse
@@ -30,6 +42,8 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.health import consts as health_consts  # noqa: E402
+from k8s_operator_libs_tpu.obs.attribution import attribute_downtime  # noqa: E402
+from k8s_operator_libs_tpu.obs.goodput import read_ledger, summarize  # noqa: E402
 from k8s_operator_libs_tpu.obs.journey import parse_journey  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.util import KeyFactory, parse_selector  # noqa: E402
@@ -148,6 +162,61 @@ def collect_timeline(client, component: str, node_name: str, now=None):
     return rows, stuck
 
 
+def collect_goodput(ledger_path: str, client=None, components=(),
+                    node_name=None, now=None):
+    """Ledger summary plus (when a node is named) the per-component
+    attribution of every cross-restart unavailability window against
+    that node's journey."""
+    now = time.time() if now is None else now
+    records = read_ledger(ledger_path)
+    summary = summarize(records)
+    attributions = {}
+    if node_name and client is not None:
+        node = client.get_node(node_name)
+        for comp in components:
+            keys = KeyFactory(comp)
+            entries = parse_journey(
+                node.metadata.annotations.get(keys.journey_annotation))
+            attributions[comp] = attribute_downtime(records, entries,
+                                                    now=now)
+    return summary, attributions
+
+
+def render_goodput(ledger_path: str, summary, attributions,
+                   node_name=None) -> str:
+    lines = [f"goodput ledger: {ledger_path}  ({summary['runs']} run(s), "
+             f"{summary['steps']} steps)"]
+    frac = summary["goodput_fraction"]
+    tps = summary["tokens_per_s"]
+    lines.append(
+        f"goodput {_fmt_duration(summary['goodput_s'])}"
+        + (f" ({frac:.1%} of accounted time)" if frac is not None else "")
+        + (f"  tokens/s {tps:.1f}" if tps else "")
+        + (f"  mfu {summary['mfu']:.3f}" if summary["mfu"] else ""))
+    badput = summary["badput_s"]
+    if any(v for v in badput.values()):
+        lines.append("badput: " + "  ".join(
+            f"{name} {_fmt_duration(secs)}"
+            for name, secs in badput.items() if secs))
+    for comp, reports in attributions.items():
+        for i, rep in enumerate(reports):
+            lines.append(f"unavailability window #{i + 1} "
+                         f"({_fmt_duration(rep['total_s'])}, component "
+                         f"{comp}, node {node_name}):")
+            lines.append("  " + "  ".join(
+                f"{name} {_fmt_duration(secs)}"
+                for name, secs in sorted(rep["phases"].items(),
+                                         key=lambda kv: -kv[1])))
+        if not reports:
+            lines.append(f"component {comp}: no unavailability window in "
+                         f"the ledger (no preempted run)")
+    if not attributions and summary["unavailability_windows"]:
+        lines.append(f"{len(summary['unavailability_windows'])} "
+                     "unavailability window(s) — pass --goodput-node to "
+                     "attribute them against a node's journey")
+    return "\n".join(lines)
+
+
 def _fmt_duration(seconds: float) -> str:
     if seconds >= 3600:
         return f"{seconds / 3600:.1f}h"
@@ -199,10 +268,30 @@ def main(argv=None, client=None, now=None) -> int:
     p.add_argument("--timeline", default=None, metavar="NODE",
                    help="render NODE's upgrade journey (per-phase "
                         "durations) instead of the fleet table")
+    p.add_argument("--goodput", default=None, metavar="LEDGER",
+                   help="render a workload goodput ledger (goodput.jsonl) "
+                        "instead of the fleet table")
+    p.add_argument("--goodput-node", default=None, metavar="NODE",
+                   help="with --goodput: attribute each unavailability "
+                        "window against NODE's upgrade journey")
     args = p.parse_args(argv)
-    if client is None:
+    # --goodput without a node never touches the cluster — the ledger is
+    # a local file
+    if client is None and not (args.goodput and not args.goodput_node):
         client = build_client(args)
     selector = parse_selector(args.selector) if args.selector else None
+
+    if args.goodput:
+        summary, attributions = collect_goodput(
+            args.goodput, client=client, components=args.component,
+            node_name=args.goodput_node, now=now)
+        if args.as_json:
+            print(json.dumps({"ledger": args.goodput, "summary": summary,
+                              "attribution": attributions}, indent=2))
+        else:
+            print(render_goodput(args.goodput, summary, attributions,
+                                 node_name=args.goodput_node))
+        return 0
 
     if args.timeline:
         out = {}

@@ -182,6 +182,14 @@ def main(argv=None) -> int:
                         "steps into DIR (open with TensorBoard or Perfetto); "
                         "the capture starts after the first step so compile "
                         "time does not drown the timeline")
+    p.add_argument("--goodput-log", default="auto", metavar="PATH",
+                   help="goodput-ledger JSONL path ('auto' = goodput.jsonl "
+                        "next to the checkpoints so a resumed job continues "
+                        "it; 'off' disables). cmd/status.py --goodput "
+                        "renders it (docs/observability.md)")
+    p.add_argument("--goodput-sync-every", type=int, default=10,
+                   help="steps between telemetry syncs with the device "
+                        "stream (the ledger never blocks per step)")
     args = p.parse_args(argv)
 
     # under an operator placement, join the multi-host/multislice
@@ -213,10 +221,18 @@ def main(argv=None) -> int:
 
     optimizer = default_optimizer(args.lr)
     mesh, step_fn, init_fn = build_parallel(cfg, args, optimizer)
+    ledger = None
+    if args.goodput_log != "off":
+        from k8s_operator_libs_tpu.obs.goodput import GoodputLedger
+        ledger = (GoodputLedger.for_checkpoint_dir(args.ckpt)
+                  if args.goodput_log == "auto"
+                  else GoodputLedger(args.goodput_log))
     trainer = CheckpointingTrainer(cfg, args.ckpt, mesh=mesh,
                                    optimizer=optimizer,
                                    checkpoint_interval=args.ckpt_interval,
-                                   step_fn=step_fn, init_fn=init_fn)
+                                   step_fn=step_fn, init_fn=init_fn,
+                                   ledger=ledger,
+                                   metrics_sync_every=args.goodput_sync_every)
     state = trainer.init_or_resume(jax.random.PRNGKey(0))
     start_step = int(state.step)
 
@@ -259,6 +275,14 @@ def main(argv=None) -> int:
             print(f"profiler trace written to {args.profile}")
     trainer.close()
     ds.close()
+    if ledger is not None:
+        ledger.close()
+        from k8s_operator_libs_tpu.obs.goodput import read_ledger, summarize
+        s = summarize(read_ledger(ledger.path))
+        frac = s["goodput_fraction"]
+        print(f"goodput: {s['goodput_s']:.1f}s over {s['steps']} steps "
+              f"({frac:.1%} of accounted time)" if frac is not None else
+              f"goodput ledger at {ledger.path}")
     if result.preempted:
         print(f"preempted at step {int(result.state.step)}; checkpoint "
               f"{result.last_checkpoint_step} saved — exiting for upgrade")

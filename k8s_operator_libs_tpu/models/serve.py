@@ -43,10 +43,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.clock import RealClock
 from .llama import LlamaConfig
 from .paged import DEFAULT_BLOCK_SIZE, PagedKVCache, _forward_paged
 
 Params = Dict[str, Any]
+
+# sub-1.0 bucket ladders for the ratio-valued serving histograms (slot
+# occupancy, KV-page utilization) and the per-request token counter —
+# kept in sync with obs/metrics.py's RATIO_BUCKETS/TOKEN_COUNT_BUCKETS
+# without importing obs (the hub is duck-typed; models carries no obs
+# dependency)
+_RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 0.95, 1.0)
+_TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 @dataclasses.dataclass
@@ -56,6 +65,7 @@ class _Request:
     max_new: int
     slot: int = -1
     generated: Optional[List[int]] = None
+    submit_t: float = 0.0       # monotonic clock at submit (telemetry)
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -82,7 +92,8 @@ class ContinuousBatcher:
     def __init__(self, params: Params, cfg: LlamaConfig, max_slots: int = 8,
                  capacity_per_slot: int = 512,
                  block_size: int = DEFAULT_BLOCK_SIZE,
-                 shared_prefix=None, forward=None):
+                 shared_prefix=None, forward=None,
+                 metrics=None, tracer=None, clock=None):
         """``forward`` overrides the paged forward pass — signature
         ``(params, tokens, cache, cfg) -> (logits, cache)``, default
         :func:`~.paged._forward_paged`. The MoE family rides this hook
@@ -100,7 +111,16 @@ class ContinuousBatcher:
         prepended to each request's own prompt (sharing a partial block
         would let one slot's prefill write into another's visible rows).
         ``capacity_per_slot`` still bounds each request's PRIVATE tokens
-        (remainder + prompt + generation)."""
+        (remainder + prompt + generation).
+
+        ``metrics`` (an ``obs.MetricsHub``, duck-typed) turns the batcher
+        into its own telemetry source: TTFT, queue-wait, inter-token and
+        step-duration histograms plus slot-occupancy / KV-page-
+        utilization samples per step and the live slot/queue gauges.
+        ``tracer`` (``obs.Tracer``) emits one ``serve-step`` span per
+        :meth:`step` call. ``clock`` injects time for both (default
+        monotonic wall clock); all three default to off/real and add no
+        overhead when unset."""
         self.params = params
         self.cfg = cfg
         self._forward = forward or _forward_paged
@@ -147,6 +167,15 @@ class ContinuousBatcher:
         self._next_rid = 0
         self._draining = False
         self._last_tok = np.zeros((max_slots,), np.int32)
+
+        self._metrics = metrics
+        self._tracer = tracer
+        self._clock = clock or RealClock()
+        self._submitted = 0
+        self._completed = 0
+        if metrics is not None:
+            metrics.set_gauge("serve_slots_total", max_slots)
+            metrics.set_gauge("serve_draining", 0)
 
         self._prefill_cache: Dict[int, Any] = {}
         self._decode_cache: Dict[int, Any] = {}
@@ -240,7 +269,10 @@ class ContinuousBatcher:
                 f"slot capacity {self.capacity}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        self._queue.append(_Request(rid, prompt, max_new_tokens,
+                                    submit_t=self._clock.now()))
+        self._submitted += 1
+        self._refresh_gauges()
         return rid
 
     @property
@@ -260,6 +292,8 @@ class ContinuousBatcher:
         (train/harness.py); decode state is cheap to re-create, so the
         serving story is finish + requeue, not save."""
         self._draining = True
+        if self._metrics is not None:
+            self._metrics.set_gauge("serve_draining", 1)
 
     def handoff(self):
         """(rid, prompt, max_new_tokens) triples never admitted — the
@@ -271,6 +305,9 @@ class ContinuousBatcher:
                                "live queue")
         out = [(r.rid, r.prompt, r.max_new) for r in self._queue]
         self._queue.clear()
+        if self._metrics is not None:
+            self._metrics.set_gauge("serve_requests_handed_off", len(out))
+        self._refresh_gauges()
         return out
 
     def poll(self) -> Dict[int, np.ndarray]:
@@ -293,8 +330,33 @@ class ContinuousBatcher:
         n=1 loop (pinned in tests)."""
         if n < 1:
             raise ValueError("step(n) needs n >= 1")
+        if self._tracer is not None:
+            with self._tracer.span("serve-step", chunk=n) as span:
+                self._step_inner(n, span)
+        else:
+            self._step_inner(n, None)
+
+    def _step_inner(self, n: int, span) -> None:
+        t0 = self._clock.now()
         while self._queue and self._free_slots and not self._draining:
             self._admit(self._queue.pop(0))
+        if span is not None:
+            span.set("running", len(self._running))
+            span.set("queued", len(self._queue))
+        if self._metrics is not None:
+            # one occupancy / pool-utilization sample per batcher step:
+            # their distributions over steps are the serving-efficiency
+            # story (how full the fused scan and the KV pool run)
+            self._metrics.observe(
+                "serve_slot_occupancy_ratio",
+                len(self._running) / self.max_slots,
+                buckets=_RATIO_BUCKETS)
+            total_private = self.max_slots * self.blocks_per_slot
+            self._metrics.observe(
+                "serve_kv_page_utilization_ratio",
+                (total_private - len(self._free_blocks)) / total_private,
+                buckets=_RATIO_BUCKETS)
+            self._refresh_gauges()
         if not self._running:
             return
         # structural in-bounds guarantee: the scan writes n rows into
@@ -315,11 +377,19 @@ class ContinuousBatcher:
         if n > cap:
             n = max((c for c in self._decode_cache if c <= cap),
                     default=1)
+        if span is not None:
+            span.set("ticks", n)
+        t_dev = self._clock.now()
         k, v, toks = self._build_decode(n)(
             self.params, self._k, self._v, jnp.asarray(self._table),
             jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
         self._k, self._v = k, v
         toks = np.asarray(toks)              # [n, slots]
+        if self._metrics is not None:
+            # the np.asarray readback above synchronized the device call,
+            # so this is honest decode time; / n = inter-token latency
+            decode_s = max(0.0, self._clock.now() - t_dev)
+            self._metrics.observe("serve_inter_token_seconds", decode_s / n)
         finished = []
         for rid, req in self._running.items():
             s = req.slot
@@ -336,10 +406,23 @@ class ContinuousBatcher:
                 self._last_tok[s] = toks[n - 1, s]
         for rid in finished:
             self._retire(self._running.pop(rid))
+        if self._metrics is not None:
+            self._metrics.observe("serve_step_duration_seconds",
+                                  max(0.0, self._clock.now() - t0))
+            self._refresh_gauges()
 
     # ------------------------------------------------------------ internal
 
+    def _refresh_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge("serve_slots_busy", len(self._running))
+        self._metrics.set_gauge("serve_queue_depth", len(self._queue))
+        self._metrics.set_gauge("serve_requests_submitted", self._submitted)
+        self._metrics.set_gauge("serve_requests_completed", self._completed)
+
     def _admit(self, req: _Request) -> None:
+        t_admit = self._clock.now()
         slot = self._free_slots.pop(0)
         n_blk = self.blocks_per_slot
         blocks = [self._free_blocks.pop(0) for _ in range(n_blk)]
@@ -375,11 +458,26 @@ class ContinuousBatcher:
         req.slot = slot
         req.generated = []
         self._running[req.rid] = req
+        if self._metrics is not None:
+            # the int(nxt) readback above synchronized the prefill, so
+            # the first token exists HERE: TTFT = queue wait + prefill
+            self._metrics.observe("serve_queue_wait_seconds",
+                                  max(0.0, t_admit - req.submit_t))
+            self._metrics.observe("serve_ttft_seconds",
+                                  max(0.0, self._clock.now() - req.submit_t))
 
     def _retire(self, req: _Request) -> None:
         s = req.slot
         self._done[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
+        self._completed += 1
+        if self._metrics is not None:
+            self._metrics.observe(
+                "serve_request_latency_seconds",
+                max(0.0, self._clock.now() - req.submit_t))
+            self._metrics.observe("serve_generated_tokens",
+                                  len(req.generated),
+                                  buckets=_TOKEN_BUCKETS)
         # free the PRIVATE blocks only; the shared-prefix columns stay
         self._free_blocks.extend(
             int(b) for b in self._table[s, self._prefix_blocks:])

@@ -16,7 +16,16 @@ al., 2010) and the time-series-first philosophy of Borgmon/Prometheus:
 - :mod:`.metrics` — Prometheus histogram exposition
                     (``_bucket``/``_sum``/``_count``) and the shared
                     per-metric HELP registry layered under the existing
-                    gauge renderer.
+                    gauge renderer;
+- :mod:`.goodput` — the WORKLOAD half: the clock-injected goodput
+                    ledger (JSONL step log next to the checkpoint dir;
+                    a resumed job continues it, so cross-restart
+                    unavailability is computed from the log);
+- :mod:`.attribution` — joins the ledger against the per-node journey
+                    and splits each unavailability window into the named
+                    phases the bench reports; also owns the downtime
+                    formula (``bench.py`` and production metrics are the
+                    same code path).
 
 Layering: ``obs`` sits BELOW ``upgrade``/``health``/``tpu`` (they import
 it, never the reverse), so the journey thresholds are keyed by the state
@@ -24,6 +33,11 @@ WIRE VALUES — the OBS001 lint pass proves that table stays closed over
 ``UpgradeState``.
 """
 
+from .attribution import (WINDOW_PHASES, WindowBreakdown,
+                          attribute_downtime, downtime_summary,
+                          slice_window, windows_from_journey)
+from .goodput import (GoodputLedger, read_ledger, summarize,
+                      unavailability_windows)
 from .journey import (DEFAULT_STUCK_THRESHOLDS, JourneyRecorder,
                       StuckNodeDetector, parse_journey)
 from .metrics import HELP_TEXTS, MetricsHub, help_for
@@ -33,4 +47,7 @@ __all__ = [
     "DEFAULT_STUCK_THRESHOLDS", "JourneyRecorder", "StuckNodeDetector",
     "parse_journey", "HELP_TEXTS", "MetricsHub", "help_for",
     "JsonlSink", "ListSink", "NullSink", "Span", "Tracer",
+    "GoodputLedger", "read_ledger", "summarize", "unavailability_windows",
+    "WINDOW_PHASES", "WindowBreakdown", "attribute_downtime",
+    "downtime_summary", "slice_window", "windows_from_journey",
 ]

@@ -131,7 +131,76 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_operator_leader":
         "1 on the replica holding the leader lease (or running without "
         "leader election), 0 on hot standbys",
+    # workload families (obs/goodput.py ledger + models/serve.py batcher,
+    # exposed by cmd/train.py and cmd/serve.py under the tpu_workload
+    # prefix — distinct from the operator's so a combined scrape never
+    # collides; the combined-exposition validator test pins this)
+    "tpu_workload_step_duration_seconds":
+        "Wall seconds per training step, averaged over one telemetry "
+        "sync window (goodput ledger)",
+    "tpu_workload_badput_seconds":
+        "Non-productive workload seconds by phase (compile, rewarmup, "
+        "ckpt_save, drain_save, ckpt_restore)",
+    "tpu_workload_tokens_per_s":
+        "Training tokens per second over the last synced step window",
+    "tpu_workload_mfu":
+        "Achieved-vs-peak model-FLOPs utilization over the last synced "
+        "step window",
+    "tpu_workload_serve_ttft_seconds":
+        "Seconds from request submit to its first generated token "
+        "(queue wait + prefill)",
+    "tpu_workload_serve_queue_wait_seconds":
+        "Seconds a request waited in the admission queue for a free "
+        "decode slot",
+    "tpu_workload_serve_inter_token_seconds":
+        "Per-token decode latency of one fused batcher chunk (device "
+        "call time / ticks)",
+    "tpu_workload_serve_step_duration_seconds":
+        "Wall seconds of one ContinuousBatcher.step call (admission "
+        "prefills + fused decode)",
+    "tpu_workload_serve_request_latency_seconds":
+        "Seconds from request submit to retirement (prompt + all "
+        "generated tokens)",
+    "tpu_workload_serve_generated_tokens":
+        "Tokens generated per completed request",
+    "tpu_workload_serve_slot_occupancy_ratio":
+        "Fraction of decode slots running a request, sampled once per "
+        "batcher step",
+    "tpu_workload_serve_kv_page_utilization_ratio":
+        "Fraction of private KV pool blocks allocated to live requests, "
+        "sampled once per batcher step",
+    "tpu_workload_serve_slots_total":
+        "Decode slots this replica serves (the fused-scan batch size)",
+    "tpu_workload_serve_slots_busy":
+        "Decode slots currently running a request",
+    "tpu_workload_serve_queue_depth":
+        "Requests admitted but still waiting for a free slot",
+    "tpu_workload_serve_requests_submitted":
+        "Requests accepted by submit() since process start",
+    "tpu_workload_serve_requests_completed":
+        "Requests retired with a full result since process start",
+    "tpu_workload_serve_requests_handed_off":
+        "Queued requests surfaced to a peer replica by the drain handoff",
+    "tpu_workload_serve_up":
+        "Constant 1 while the serving process is alive",
+    "tpu_workload_serve_failed":
+        "1 once the stepper thread crashed and the server went "
+        "unhealthy, else 0",
+    "tpu_workload_serve_draining":
+        "1 once the drain began (admission closed), else 0",
+    "tpu_workload_build_info":
+        "Constant 1; labels carry the workload binary's version and "
+        "model",
 }
+
+# ratio-valued histograms (occupancy, utilization) need sub-1.0 buckets —
+# the latency defaults would put every observation in the first bucket
+RATIO_BUCKETS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 0.95, 1.0)
+
+# token-count histogram (generated tokens per request)
+TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 def help_for(metric: str, default: Optional[str] = None) -> str:

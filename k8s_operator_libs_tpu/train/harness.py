@@ -83,6 +83,26 @@ class TrainResult:
     wall_time_s: float
 
 
+def _block_on(metrics) -> None:
+    """Block the host until every metric leaf has materialized — the ONE
+    place telemetry is allowed to synchronize with the device stream.
+    Leaves without ``block_until_ready`` (plain floats, test stubs) pass
+    through untouched."""
+    for leaf in jax.tree_util.tree_leaves(metrics):
+        block = getattr(leaf, "block_until_ready", None)
+        if block is not None:
+            block()
+
+
+def _batch_tokens(batch) -> int:
+    """Trained tokens in one batch: [B, T+1] token arrays train on B*T
+    targets; anything unshaped (custom step_fn payloads) counts 0."""
+    shape = getattr(batch, "shape", None)
+    if shape is not None and len(shape) == 2 and shape[1] > 1:
+        return int(shape[0]) * (int(shape[1]) - 1)
+    return 0
+
+
 class CheckpointingTrainer:
     def __init__(self, cfg: LlamaConfig, checkpoint_dir: str,
                  mesh=None, optimizer=None,
@@ -90,18 +110,32 @@ class CheckpointingTrainer:
                  keep: int = 3,
                  step_fn: Optional[Callable] = None,
                  init_fn: Optional[Callable] = None,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 ledger=None,
+                 metrics_sync_every: int = 10):
         """``step_fn(state, batch) -> (state, metrics)`` and
         ``init_fn(rng) -> TrainState`` default to the Llama FSDP pair; pass
         both to train another model family (MoE) or parallelism (sp/pp/ep)
         through the same checkpoint/drain machinery. ``grad_accum=A``
         splits each batch into A sequential microbatches (activation
         memory of one, effective batch of all — parallel/fsdp.py
-        _train_step_body)."""
+        _train_step_body).
+
+        ``ledger`` (an :class:`~..obs.goodput.GoodputLedger`, duck-typed)
+        turns the run loop into a goodput recorder: per-sync-window step
+        wall time and tokens/s, plus the badput phases (first-step
+        compile/re-warmup, checkpoint save/restore, the drain save).
+        ``metrics_sync_every`` bounds how often telemetry BLOCKS on the
+        device stream: the loop synchronizes only every that many steps
+        and at checkpoint/drain/final boundaries — never per step, so
+        recording never serializes dispatch (pinned by a sync-counting
+        test)."""
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
         self.checkpoint_interval = checkpoint_interval
+        self.ledger = ledger
+        self.metrics_sync_every = max(1, int(metrics_sync_every))
         self._mngr = ocp.CheckpointManager(
             checkpoint_dir,
             options=ocp.CheckpointManagerOptions(
@@ -131,6 +165,10 @@ class CheckpointingTrainer:
         fresh = self._init_fn(rng)
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           fresh)
+        if self.ledger is not None:
+            with self.ledger.phase("ckpt_restore"):
+                return self._mngr.restore(
+                    latest, args=ocp.args.StandardRestore(abstract))
         return self._mngr.restore(latest,
                                   args=ocp.args.StandardRestore(abstract))
 
@@ -154,32 +192,78 @@ class CheckpointingTrainer:
     def run(self, state: TrainState, data: Iterator[Any],
             num_steps: int,
             drain_signal: Optional[Callable[[], bool]] = None,
-            on_step: Optional[Callable[[int, dict], None]] = None
-            ) -> TrainResult:
+            on_step: Optional[Callable[[int, dict], None]] = None,
+            sync_every: Optional[int] = None) -> TrainResult:
         """Train until num_steps more steps are done or a drain is signalled.
 
         Drain → synchronous checkpoint → return (preempted=True). Periodic
         checkpoints every checkpoint_interval steps are async (orbax
-        overlaps them with compute)."""
-        t0 = time.monotonic()
+        overlaps them with compute).
+
+        ``on_step(step, metrics)`` receives the HOST-side step counter and
+        the raw (possibly still in-flight) device metrics — the loop no
+        longer forces a per-step host sync to read ``metrics["step"]``.
+        Telemetry blocks on the device stream only at sync boundaries:
+        every ``sync_every`` steps (default ``metrics_sync_every``), at
+        checkpoint boundaries, on the first step (compile/re-warmup is
+        segmented into the ledger as badput), and at the end."""
+        ledger = self.ledger
+        now = ledger.clock.now if ledger is not None else time.monotonic
+        sync_every = (self.metrics_sync_every if sync_every is None
+                      else max(1, int(sync_every)))
+        t0 = now()
         start_step = int(state.step)
+        if ledger is not None:
+            ledger.run_started(start_step)
         last_ckpt = self._mngr.latest_step() or start_step
         done = 0
         preempted = False
+        win_t0 = now()       # start of the current unsynced step window
+        win_steps = 0
+        win_tokens = 0
         while done < num_steps:
             if drain_signal is not None and drain_signal():
                 logger.info("drain signalled at step %d: checkpoint + exit",
-                            int(state.step))
-                last_ckpt = self.save(state, wait=True)
+                            start_step + done)
+                if ledger is not None:
+                    with ledger.phase("drain_save"):
+                        last_ckpt = self.save(state, wait=True)
+                else:
+                    last_ckpt = self.save(state, wait=True)
                 preempted = True
                 break
             batch = next(data)
             state, metrics = self._step_fn(state, batch)
             done += 1
+            win_steps += 1
+            win_tokens += _batch_tokens(batch)
+            host_step = start_step + done
+            at_ckpt = done % self.checkpoint_interval == 0
+            if (win_steps >= sync_every or at_ckpt or done == num_steps
+                    or done == 1):
+                _block_on(metrics)
+                elapsed = max(0.0, now() - win_t0)
+                if ledger is not None:
+                    if done == win_steps == 1:
+                        # the run's first step is compile (fresh) or
+                        # re-warmup (resumed) badput, not goodput
+                        ledger.first_step(host_step, elapsed, win_tokens)
+                    else:
+                        ledger.steps(host_step, win_steps, elapsed,
+                                     win_tokens)
+                win_t0 = now()
+                win_steps = 0
+                win_tokens = 0
             if on_step is not None:
-                on_step(int(metrics["step"]), metrics)
-            if done % self.checkpoint_interval == 0:
-                last_ckpt = self.save(state)  # async
+                on_step(host_step, metrics)
+            if at_ckpt:
+                if ledger is not None:
+                    with ledger.phase("ckpt_save"):
+                        last_ckpt = self.save(state)  # async dispatch
+                else:
+                    last_ckpt = self.save(state)  # async
+        if ledger is not None:
+            ledger.run_ended(start_step + done, preempted)
         return TrainResult(state=state, steps_done=done, preempted=preempted,
                            last_checkpoint_step=last_ckpt,
-                           wall_time_s=time.monotonic() - t0)
+                           wall_time_s=max(0.0, now() - t0))

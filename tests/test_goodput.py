@@ -1,0 +1,450 @@
+"""Workload goodput ledger + downtime attribution.
+
+The acceptance bars pinned here:
+
+- the trainer's telemetry NEVER serializes the device stream — blocking
+  syncs happen only at sync boundaries (counted mechanically);
+- a preempted run and its resumed successor produce ONE contiguous
+  ledger (same JSONL file next to the checkpoints), and the
+  cross-restart unavailability window is computed from the log;
+- joining that ledger against the node's journey annotation splits the
+  window into named phases that SUM to the observed window within one
+  fake-clock tick, with the operator segments coming from the same
+  journey the bench uses.
+"""
+
+import types
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
+                                                DriverUpgradePolicySpec,
+                                                WaitForCompletionSpec)
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.obs.attribution import (WINDOW_PHASES,
+                                                   WindowBreakdown,
+                                                   attribute_downtime,
+                                                   downtime_summary,
+                                                   slice_window,
+                                                   windows_from_journey)
+from k8s_operator_libs_tpu.obs.goodput import (GoodputLedger, read_ledger,
+                                               split_runs, summarize,
+                                               unavailability_windows)
+from k8s_operator_libs_tpu.obs.journey import parse_journey
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.train.harness import CheckpointingTrainer
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    ClusterUpgradeStateManager)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+NS = "kube-system"
+
+
+# ------------------------------------------------------------ ledger unit
+
+
+def test_ledger_roundtrip_and_summary(tmp_path):
+    clock = FakeClock(100.0)
+    hub = MetricsHub()
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock,
+                        metrics=hub, flops_per_token=6e9, peak_flops=459e12)
+    assert not led.resumed
+    led.run_started(0)
+    with led.phase("compile"):
+        clock.advance(2.0)
+    clock.advance(4.0)
+    led.steps(10, 10, 4.0, 40_000)
+    with led.phase("ckpt_save"):
+        clock.advance(0.5)
+    led.run_ended(10, preempted=False)
+    led.close()
+
+    records = read_ledger(led.path)
+    assert [r["kind"] for r in records] == ["run_start", "phase", "step",
+                                           "phase", "run_end"]
+    s = summarize(records)
+    assert s["runs"] == 1 and s["steps"] == 10 and s["tokens"] == 40_000
+    assert s["goodput_s"] == pytest.approx(4.0)
+    assert s["badput_s"]["compile"] == pytest.approx(2.0)
+    assert s["badput_s"]["ckpt_save"] == pytest.approx(0.5)
+    assert s["idle_gap_s"] == 0.0
+    assert s["tokens_per_s"] == pytest.approx(10_000.0)
+    # mfu = tok/s * flops/tok / peak = 1e4 * 6e9 / 459e12
+    assert s["mfu"] == pytest.approx(1e4 * 6e9 / 459e12, rel=1e-2)
+    # the hub carries the same families
+    assert hub.get_histogram("step_duration_seconds") is not None
+    assert hub.get_histogram("badput_seconds") is not None
+
+
+def test_ledger_resume_names_first_step_rewarmup(tmp_path):
+    path = str(tmp_path / "goodput.jsonl")
+    clock = FakeClock()
+    led = GoodputLedger(path, clock=clock)
+    led.run_started(0)
+    led.first_step(1, 3.0, 64)
+    led.close()
+    led2 = GoodputLedger(path, clock=clock)
+    assert led2.resumed
+    led2.run_started(5)
+    led2.first_step(6, 1.0, 64)
+    led2.close()
+    phases = [r["phase"] for r in read_ledger(path)
+              if r.get("kind") == "phase"]
+    assert phases == ["compile", "rewarmup"]
+    assert len(split_runs(read_ledger(path))) == 2
+
+
+def test_unavailability_window_from_log_not_live_process(tmp_path):
+    """Preempted run + resumed run: the gap is computed purely from the
+    JSONL, opening at the drain save and closing at the first goodput
+    step of the resumed run."""
+    path = str(tmp_path / "goodput.jsonl")
+    clock = FakeClock(1000.0)
+    led = GoodputLedger(path, clock=clock)
+    led.run_started(0)
+    clock.advance(5.0)
+    led.steps(5, 5, 5.0, 100)
+    with led.phase("drain_save"):          # opens at t=1005
+        clock.advance(3.0)
+    led.run_ended(5, preempted=True)
+    led.close()
+    clock.advance(60.0)                     # evicted / rescheduled gap
+    led2 = GoodputLedger(path, clock=clock)
+    led2.run_started(5)
+    with led2.phase("ckpt_restore"):
+        clock.advance(2.0)
+    clock.advance(1.0)
+    led2.steps(6, 1, 1.0, 20)               # goodput resumes at t=1070
+    led2.close()
+    windows = unavailability_windows(read_ledger(path))
+    assert len(windows) == 1
+    start, end = windows[0]
+    assert start == pytest.approx(1005.0)
+    assert end == pytest.approx(1070.0)
+    s = summarize(read_ledger(path))
+    assert s["idle_gap_s"] == pytest.approx(65.0)
+
+
+# ------------------------------------------- trainer telemetry (no syncs)
+
+
+class _DeviceLeaf:
+    """Stands in for an in-flight device scalar: counts blocking syncs
+    and host conversions so the test can prove the loop does neither per
+    step."""
+
+    def __init__(self, counters):
+        self._counters = counters
+
+    def block_until_ready(self):
+        self._counters["sync"] += 1
+        return self
+
+    def __int__(self):
+        self._counters["convert"] += 1
+        return 0
+
+    def __float__(self):
+        self._counters["convert"] += 1
+        return 0.0
+
+
+def test_trainer_blocks_only_at_sync_boundaries(tmp_path):
+    """Satellite: telemetry must not serialize the device stream. 20
+    steps at sync_every=5 → exactly 5 sync points (first step + every
+    5th + final), zero per-step host conversions of the metrics."""
+    clock = FakeClock()
+    counters = {"sync": 0, "convert": 0}
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+
+    def step_fn(state, batch):
+        clock.advance(0.1)
+        return state, {"step": _DeviceLeaf(counters),
+                       "loss": _DeviceLeaf(counters)}
+
+    trainer = CheckpointingTrainer(
+        None, str(tmp_path / "ckpt"), step_fn=step_fn,
+        init_fn=lambda rng: None, checkpoint_interval=10_000,
+        ledger=led, metrics_sync_every=5)
+    state = types.SimpleNamespace(step=0)
+    seen = []
+    result = trainer.run(state, iter(lambda: object(), None), num_steps=20,
+                         on_step=lambda s, m: seen.append(s))
+    trainer.close()
+    led.close()
+
+    assert result.steps_done == 20
+    assert seen == list(range(1, 21))       # host-side counter, no sync
+    # boundaries: done=1 (compile segment), 5, 10, 15, 20 → 5 syncs of
+    # 2 metric leaves each
+    assert counters["sync"] == 5 * 2
+    assert counters["convert"] == 0, \
+        "the run loop converted device metrics on the host per step"
+    records = read_ledger(led.path)
+    step_recs = [r for r in records if r["kind"] == "step"]
+    # first step is its own (badput) segment; then windows of 5 close at
+    # steps 6/11/16 and the final partial window at 20
+    assert [r["n"] for r in step_recs] == [5, 5, 5, 4]
+    assert sum(r["wall_s"] for r in step_recs) == pytest.approx(1.9)
+    first = [r for r in records if r.get("kind") == "phase"]
+    assert first[0]["phase"] == "compile"
+    assert first[0]["duration_s"] == pytest.approx(0.1)
+
+
+def test_trainer_drain_records_drain_save_phase(tmp_path):
+    clock = FakeClock()
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    saves = []
+
+    def step_fn(state, batch):
+        clock.advance(0.1)
+        return state, {"loss": 0.0}
+
+    trainer = CheckpointingTrainer(
+        None, str(tmp_path / "ckpt"), step_fn=step_fn,
+        init_fn=lambda rng: None, checkpoint_interval=10_000, ledger=led)
+    trainer.save = lambda state, wait=False: saves.append(wait) or 7
+    state = types.SimpleNamespace(step=0)
+    result = trainer.run(state, iter(lambda: object(), None), num_steps=50,
+                         drain_signal=lambda: len(saves) == 0 and
+                         clock.now() > 0.25)
+    trainer.close()
+    led.close()
+    assert result.preempted and saves == [True]
+    records = read_ledger(led.path)
+    assert any(r.get("phase") == "drain_save" for r in records)
+    end = [r for r in records if r["kind"] == "run_end"]
+    assert end and end[0]["preempted"] is True
+
+
+# ------------------------------------------------------- attribution unit
+
+
+def test_window_phases_cover_all_upgrade_states():
+    wire = {getattr(UpgradeState, name) for name in dir(UpgradeState)
+            if isinstance(getattr(UpgradeState, name), str)
+            and not name.startswith("_") and name != "ALL"}
+    assert wire <= set(WINDOW_PHASES)
+
+
+def test_windows_from_journey_segments_and_sum():
+    entries = [("upgrade-required", 100.0), ("cordon-required", 110.0),
+               ("wait-for-jobs-required", 112.0),
+               ("pod-deletion-required", 120.0), ("drain-required", 121.0),
+               ("pod-restart-required", 150.0),
+               ("validation-required", 151.0), ("uncordon-required", 155.0),
+               ("upgrade-done", 156.0)]
+    wins = windows_from_journey(entries)
+    assert len(wins) == 1
+    w = wins[0]
+    assert w.start == 110.0 and w.end == 156.0
+    assert w.to_gate_s == pytest.approx(10.0)
+    assert w.gate_to_restart_s == pytest.approx(30.0)
+    assert w.after_restart_s == pytest.approx(6.0)
+    assert w.window_s == pytest.approx(46.0)
+    # half-open window without `now` is dropped; with `now` it closes
+    open_entries = entries[:-3]
+    assert windows_from_journey(open_entries) == []
+    w2 = windows_from_journey(open_entries, now=200.0)[0]
+    assert w2.end == 200.0
+
+
+def test_slice_window_merges_members():
+    j1 = [("cordon-required", 10.0), ("wait-for-jobs-required", 11.0),
+          ("drain-required", 20.0), ("pod-restart-required", 50.0),
+          ("upgrade-done", 60.0)]
+    j2 = [("cordon-required", 12.0), ("wait-for-jobs-required", 13.0),
+          ("drain-required", 22.0), ("pod-restart-required", 52.0),
+          ("upgrade-done", 64.0)]
+    w = slice_window([j1, j2])
+    assert w.start == 10.0 and w.end == 64.0      # earliest in, latest out
+    assert w.gate_at == 20.0 and w.restart_at == 50.0
+    assert w.window_s == pytest.approx(54.0)
+    assert (w.to_gate_s + w.gate_to_restart_s + w.after_restart_s
+            ) == pytest.approx(w.end - w.start)
+
+
+def test_downtime_summary_formula_and_overlap():
+    win = WindowBreakdown(to_gate_s=5.0, gate_to_restart_s=25.0,
+                          after_restart_s=50.0)
+    s = downtime_summary(win, ckpt_fetch_s=2.0, ckpt_write_s=10.0,
+                         ckpt_restore_s=3.0, rewarmup_s=4.0,
+                         baseline_replay_s=300.0)
+    # write (10) hides entirely inside the 80 s window (the uploader
+    # DaemonSet survives eviction AND the driver restart)
+    assert s["downtime_s"] == pytest.approx(2 + 80 + 3 + 4)
+    assert s["baseline_downtime_s"] == pytest.approx(80 + 300 + 3 + 4)
+    assert s["source"] == "obs.attribution"
+    big_write = downtime_summary(win, ckpt_fetch_s=2.0, ckpt_write_s=90.0,
+                                 ckpt_restore_s=3.0, rewarmup_s=4.0)
+    # a write slower than the window becomes the critical path
+    assert big_write["downtime_s"] == pytest.approx(2 + 90 + 3 + 4)
+
+
+# ------------------------- drain→resume continuity + journey attribution
+
+
+def _drive(mgr, cluster, policy, predicate, ticks=40):
+    node = None
+    for _ in range(ticks):
+        mgr.apply_state(mgr.build_state(NS, {"app": "libtpu"}), policy)
+        cluster.reconcile_daemonsets()
+        node = cluster.client.direct().get_node("n0")
+        if predicate(node):
+            return node
+    raise AssertionError(f"predicate never held; node state "
+                         f"{node.metadata.labels}")
+
+
+def test_ledger_continuity_across_drain_resume_attributes_window(
+        tmp_path, clock):
+    """The PR's acceptance bar: preempted + resumed runs form one
+    contiguous ledger; joining it against the node's REAL journey (the
+    actual state machine driving a fake cluster on the same FakeClock)
+    splits the observed unavailability window into named phases that sum
+    to the window within one fake-clock tick."""
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    keys = KeyFactory("libtpu")
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels={"app": "libtpu"}, revision_hash="v1")
+    cluster.add_node("n0")
+    cluster.add_pod("libtpu-n0", "n0", namespace=NS, owner_ds=ds,
+                    revision_hash="v1")
+    cluster.add_pod("train-0", "n0", labels={"job": "llama"})
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    mgr = ClusterUpgradeStateManager(cluster.client, keys, cluster.recorder,
+                                     clock, synchronous=True)
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=1, max_unavailable="100%",
+        wait_for_completion=WaitForCompletionSpec(pod_selector="job=llama"),
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+
+    path = str(tmp_path / "ckpt" / "goodput.jsonl")
+    led = GoodputLedger(path, clock=clock)
+    led.run_started(0)
+    clock.advance(2.0)
+    led.steps(10, 10, 2.0, 640)
+
+    # cordon lands → the workload's drain signal fires
+    _drive(mgr, cluster, policy, lambda n: n.spec.unschedulable)
+    with led.phase("drain_save"):
+        clock.advance(3.0)
+    led.run_ended(10, preempted=True)
+    led.close()
+    cluster.set_pod_status("default", "train-0", phase="Succeeded")
+
+    # the slice completes its upgrade while the job is gone
+    _drive(mgr, cluster, policy,
+           lambda n: n.metadata.labels.get(keys.state_label)
+           == UpgradeState.DONE and not n.spec.unschedulable)
+
+    # resumed job CONTINUES the same file
+    led2 = GoodputLedger(path, clock=clock)
+    assert led2.resumed
+    led2.run_started(10)
+    with led2.phase("ckpt_restore"):
+        clock.advance(1.5)
+    with led2.phase("rewarmup"):
+        clock.advance(0.5)
+    clock.advance(0.2)
+    led2.steps(11, 1, 0.2, 64)
+    led2.run_ended(11, preempted=False)
+    led2.close()
+
+    records = read_ledger(path)
+    s = summarize(records)
+    assert s["runs"] == 2 and s["steps"] == 11
+    assert s["badput_s"]["drain_save"] == pytest.approx(3.0)
+    assert s["badput_s"]["ckpt_restore"] == pytest.approx(1.5)
+    assert s["badput_s"]["rewarmup"] == pytest.approx(0.5)
+    windows = unavailability_windows(records)
+    assert len(windows) == 1
+
+    node = cluster.client.direct().get_node("n0")
+    entries = parse_journey(
+        node.metadata.annotations.get(keys.journey_annotation))
+    assert entries, "state machine recorded no journey"
+    jw = windows_from_journey(entries)
+    assert len(jw) == 1 and jw[0].window_s > 0
+
+    reports = attribute_downtime(records, entries)
+    assert len(reports) == 1
+    rep = reports[0]
+    tick = 1.0  # the fake clock's cache-barrier poll quantum
+    # the named phases partition the observed window
+    assert sum(rep["phases"].values()) == pytest.approx(rep["total_s"],
+                                                        abs=tick)
+    assert rep["phases"]["drain_save"] == pytest.approx(3.0)
+    assert rep["phases"]["ckpt_restore"] == pytest.approx(1.5)
+    assert rep["phases"]["rewarmup"] == pytest.approx(0.5)
+    # operator segments present and journey-consistent: everything the
+    # workload phases don't claim inside the journey window is attributed
+    # to the three named operator segments (+ idle outside the journey)
+    operator_s = sum(rep["phases"].get(k, 0.0)
+                     for k in ("window_to_gate", "window_gate_to_restart",
+                               "window_after_restart"))
+    assert operator_s > 0
+    overlap = max(0.0, min(rep["end"], jw[0].end)
+                  - max(rep["start"], jw[0].start))
+    workload_inside = sum(
+        min(r["t"] + r["duration_s"], jw[0].end) - max(r["t"], jw[0].start)
+        for r in records if r.get("kind") == "phase"
+        and r["t"] + r.get("duration_s", 0.0) > jw[0].start
+        and r["t"] < jw[0].end)
+    assert operator_s == pytest.approx(overlap - workload_inside, abs=tick)
+
+
+def test_real_trainer_ledger_through_drain_and_resume(tmp_path):
+    """End-to-end on the real JAX trainer (tiny model, CPU): drain →
+    synchronous save with a drain_save phase; resume → ckpt_restore +
+    rewarmup phases in the SAME ledger; the summary sees both runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    ckpt = str(tmp_path / "ckpt")
+    led = GoodputLedger.for_checkpoint_dir(ckpt)
+    trainer = CheckpointingTrainer(cfg, ckpt, checkpoint_interval=100,
+                                   ledger=led, metrics_sync_every=2)
+    state = trainer.init_or_resume(jax.random.PRNGKey(0))
+
+    def batches():
+        key = jax.random.PRNGKey(1)
+        while True:
+            yield jax.random.randint(key, (2, 33), 0, cfg.vocab_size,
+                                     dtype=jnp.int32)
+
+    calls = {"n": 0}
+
+    def drain_signal():
+        calls["n"] += 1
+        return calls["n"] > 3
+    result = trainer.run(state, batches(), num_steps=100,
+                         drain_signal=drain_signal)
+    trainer.close()
+    led.close()
+    assert result.preempted and result.steps_done == 3
+
+    led2 = GoodputLedger.for_checkpoint_dir(ckpt)
+    assert led2.resumed
+    trainer2 = CheckpointingTrainer(cfg, ckpt, checkpoint_interval=100,
+                                    ledger=led2, metrics_sync_every=2)
+    state2 = trainer2.init_or_resume(jax.random.PRNGKey(9))
+    assert int(state2.step) == 3
+    result2 = trainer2.run(state2, batches(), num_steps=2)
+    trainer2.close()
+    led2.close()
+    assert result2.steps_done == 2
+
+    records = read_ledger(led2.path)
+    phases = [r["phase"] for r in records if r.get("kind") == "phase"]
+    assert "compile" in phases and "drain_save" in phases
+    assert "ckpt_restore" in phases and "rewarmup" in phases
+    s = summarize(records)
+    assert s["runs"] == 2
+    assert s["goodput_s"] > 0
+    assert len(s["unavailability_windows"]) == 1

@@ -35,10 +35,11 @@ def codes(findings):
 def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
-            "obs-journey", "import-layering"} <= names
+            "obs-journey", "obs-attribution", "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
-            "LCK003", "STM001", "OBS001", "ARC001"} <= set(all_codes)
+            "LCK003", "STM001", "OBS001", "OBS002", "ARC001"} \
+        <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
@@ -509,6 +510,77 @@ def test_obs001_literal_key_write_fires_and_reads_stay_silent(tmp_path):
     findings = obs_check.run_project(root)
     assert len(findings) == 1
     assert findings[0][0].endswith("tpu/rogue.py")
+
+
+# ---------------------------------------- OBS002 (attribution, mutated)
+
+OBS2_FILES = [obs_check.CONSTS_PATH, obs_check.ATTRIBUTION_PATH]
+
+
+def _obs2_root(tmp_path, mutate=None):
+    root = tmp_path / "repo2"
+    for rel in OBS2_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_obs002_real_repo_files_pass(tmp_path):
+    assert obs_check.run_attribution(_obs2_root(tmp_path)) == []
+
+
+def test_obs002_real_repo_passes():
+    assert obs_check.run_attribution(REPO) == []
+
+
+def test_obs002_missing_phase_fails_naming_state(tmp_path):
+    """Dropping a state's window-phase entry must fail naming the state
+    — its dwell would silently leak out of attributed windows."""
+    root = _obs2_root(tmp_path, mutate={
+        obs_check.ATTRIBUTION_PATH: lambda s: s.replace(
+            '    "pod-restart-required": "after_restart",\n', '')})
+    findings = obs_check.run_attribution(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS002" for (_, _, c, _) in findings)
+    assert "POD_RESTART_REQUIRED" in msgs and "window-phase" in msgs
+
+
+def test_obs002_new_state_without_phase_fails(tmp_path):
+    root = _obs2_root(tmp_path, mutate={
+        obs_check.CONSTS_PATH: lambda s: s.replace(
+            '    FAILED = "upgrade-failed"',
+            '    FAILED = "upgrade-failed"\n'
+            '    LIMBO = "limbo-required"')})
+    findings = obs_check.run_attribution(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "LIMBO" in msgs and "window-phase" in msgs
+
+
+def test_obs002_stale_key_fails(tmp_path):
+    root = _obs2_root(tmp_path, mutate={
+        obs_check.ATTRIBUTION_PATH: lambda s: s.replace(
+            '    "upgrade-done": "outside",',
+            '    "upgrade-done": "outside",\n'
+            '    "ghost-state": "outside",')})
+    findings = obs_check.run_attribution(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "ghost-state" in msgs and "no UpgradeState wire value" in msgs
+
+
+def test_obs002_unknown_segment_name_fails(tmp_path):
+    """A typo'd segment value would attribute dwell to a phase nothing
+    reports — the whitelist catches it."""
+    root = _obs2_root(tmp_path, mutate={
+        obs_check.ATTRIBUTION_PATH: lambda s: s.replace(
+            '    "drain-required": "gate_to_restart",',
+            '    "drain-required": "gate_to_restrat",')})
+    findings = obs_check.run_attribution(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "gate_to_restrat" in msgs and "not one of" in msgs
 
 
 # ------------------------------------------------- ARC001 (fake packages)

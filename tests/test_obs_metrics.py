@@ -168,6 +168,78 @@ def test_upgrade_gauges_carry_registry_help_with_fallback(cluster, clock):
     validate_exposition(text)
 
 
+def test_combined_operator_and_workload_exposition_validates(tmp_path,
+                                                             clock):
+    """Satellite: a combined operator + workload scrape (the two halves
+    concatenated, as a shared Prometheus job would ingest them) passes
+    the exposition validator — no duplicate families across the
+    tpu_operator/tpu_workload prefixes, buckets still monotone — and
+    every workload family carries a REAL registered HELP text."""
+    from k8s_operator_libs_tpu.obs.goodput import GoodputLedger
+    from k8s_operator_libs_tpu.obs.metrics import (HELP_TEXTS,
+                                                   RATIO_BUCKETS,
+                                                   TOKEN_COUNT_BUCKETS)
+    from k8s_operator_libs_tpu.upgrade.metrics import (
+        render_prometheus_multi)
+
+    # operator half: upgrade gauges + hub histograms
+    op_hub = MetricsHub()
+    op_hub.observe("phase_duration_seconds", 12.5,
+                   labels={"component": "libtpu",
+                           "state": "drain-required"})
+    op_hub.observe("reconcile_tick_duration_seconds", 0.2)
+    op_hub.set_gauge("leader", 1.0)
+    op_text = render_prometheus("libtpu", {"upgrades_done": 3,
+                                           "unavailable_nodes": 1})
+    op_text += op_hub.render()
+
+    # workload half: the goodput ledger and a simulated serving tick
+    # feed one hub, rendered under the tpu_workload prefix
+    wl_hub = MetricsHub()
+    ledger = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock,
+                           metrics=wl_hub, flops_per_token=6e9,
+                           peak_flops=459e12)
+    ledger.run_started(0)
+    with ledger.phase("compile"):
+        clock.advance(2.0)
+    clock.advance(1.0)
+    ledger.steps(10, 10, 1.0, 10_000)
+    with ledger.phase("drain_save"):
+        clock.advance(3.0)
+    ledger.run_ended(10, preempted=True)
+    ledger.close()
+    wl_hub.observe("serve_ttft_seconds", 0.8)
+    wl_hub.observe("serve_queue_wait_seconds", 0.3)
+    wl_hub.observe("serve_inter_token_seconds", 0.004)
+    wl_hub.observe("serve_step_duration_seconds", 0.05)
+    wl_hub.observe("serve_request_latency_seconds", 1.9)
+    wl_hub.observe("serve_slot_occupancy_ratio", 0.75,
+                   buckets=RATIO_BUCKETS)
+    wl_hub.observe("serve_kv_page_utilization_ratio", 0.5,
+                   buckets=RATIO_BUCKETS)
+    wl_hub.observe("serve_generated_tokens", 48,
+                   buckets=TOKEN_COUNT_BUCKETS)
+    wl_hub.set_gauge("serve_slots_total", 8)
+    wl_text = render_prometheus_multi({"serve": {"serve_up": 1.0}},
+                                      prefix="tpu_workload")
+    wl_text += wl_hub.render(prefix="tpu_workload")
+
+    families, samples = validate_exposition(op_text + wl_text)
+    assert families["tpu_operator_phase_duration_seconds"] == "histogram"
+    assert families["tpu_workload_badput_seconds"] == "histogram"
+    assert families["tpu_workload_step_duration_seconds"] == "histogram"
+    assert families["tpu_workload_serve_ttft_seconds"] == "histogram"
+    # badput segmented by phase label
+    badput_phases = {lbl["phase"] for _, lbl, _
+                     in samples["tpu_workload_badput_seconds"]}
+    assert {"compile", "drain_save"} <= badput_phases
+    # every new workload family has a registered description — the HELP
+    # rendered is never the underscores-to-spaces fallback
+    for fam in families:
+        if fam.startswith("tpu_workload"):
+            assert fam in HELP_TEXTS, f"{fam} missing from HELP_TEXTS"
+
+
 # ------------------------------------- full simulated operator /metrics
 
 

@@ -1,0 +1,270 @@
+"""Serving-side workload telemetry: the instrumented ContinuousBatcher
+(TTFT / queue-wait / inter-token / occupancy / KV-utilization), its
+serve-step trace spans, the cmd/serve.py /metrics endpoint, and
+cmd/status.py --goodput."""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from test_obs_metrics import validate_exposition
+
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
+from k8s_operator_libs_tpu.obs.goodput import GoodputLedger
+from k8s_operator_libs_tpu.obs.metrics import HELP_TEXTS, MetricsHub
+from k8s_operator_libs_tpu.obs.trace import ListSink, Tracer
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, n, dtype=np.int32)
+
+
+def _hist_sum(hub, name):
+    hist = hub.get_histogram(name)
+    assert hist is not None, f"no histogram family {name}"
+    return sum(total for _, total in hist.series.values())
+
+
+def _hist_count(hub, name):
+    hist = hub.get_histogram(name)
+    return sum(counts[-1] + sum(counts[:-1])
+               for counts, _ in hist.series.values())
+
+
+# ------------------------------------------------------- batcher metrics
+
+
+def test_batcher_records_queue_wait_ttft_and_occupancy(params):
+    hub = MetricsHub()
+    clock = FakeClock()
+    sink = ListSink()
+    srv = ContinuousBatcher(params, CFG, max_slots=2, capacity_per_slot=64,
+                            block_size=8, metrics=hub,
+                            tracer=Tracer(sink=sink, clock=clock),
+                            clock=clock)
+    r0 = srv.submit(_prompt(5), 4)
+    clock.advance(1.5)
+    r1 = srv.submit(_prompt(7, seed=1), 4)
+    r2 = srv.submit(_prompt(3, seed=2), 4)   # queues: only 2 slots
+    srv.step()
+
+    # r0 waited 1.5 s (submitted at t=0, admitted at t=1.5); r1 waited 0
+    assert _hist_sum(hub, "serve_queue_wait_seconds") == pytest.approx(1.5)
+    assert _hist_count(hub, "serve_queue_wait_seconds") == 2
+    assert _hist_sum(hub, "serve_ttft_seconds") == pytest.approx(1.5)
+    # both slots busy, r2 still queued
+    occ = hub.get_histogram("serve_slot_occupancy_ratio")
+    (counts, total), = occ.series.values()
+    assert total == pytest.approx(1.0)
+    kv = hub.get_histogram("serve_kv_page_utilization_ratio")
+    (_, kv_total), = kv.series.values()
+    assert kv_total == pytest.approx(1.0)   # all private blocks allocated
+
+    gauges = hub.render(prefix="tpu_workload")
+    assert "tpu_workload_serve_slots_total 2" in gauges
+    assert "tpu_workload_serve_slots_busy 2" in gauges
+    assert "tpu_workload_serve_queue_depth 1" in gauges
+
+    while not srv.idle:
+        srv.step()
+    done = srv.poll()
+    assert set(done) == {r0, r1, r2}
+    assert _hist_count(hub, "serve_request_latency_seconds") == 3
+    tok = hub.get_histogram("serve_generated_tokens")
+    (_, tok_total), = tok.series.values()
+    assert tok_total == 12                   # 3 requests x 4 tokens
+    after = hub.render(prefix="tpu_workload")
+    assert "tpu_workload_serve_requests_completed 3" in after
+    assert "tpu_workload_serve_requests_submitted 3" in after
+    assert "tpu_workload_serve_slots_busy 0" in after
+    # one serve-step span per step() call, carrying chunk + running attrs
+    names = {r["name"] for r in sink.records}
+    assert names == {"serve-step"}
+    assert all("running" in r["attrs"] for r in sink.records)
+    # inter-token + step-duration observed once per decoding step
+    assert _hist_count(hub, "serve_inter_token_seconds") == len(sink.records)
+
+
+def test_batcher_drain_and_handoff_gauges(params):
+    hub = MetricsHub()
+    srv = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=64,
+                            block_size=8, metrics=hub)
+    srv.submit(_prompt(4), 2)
+    srv.submit(_prompt(4, seed=3), 2)
+    srv.step()                 # admits the first, second stays queued
+    srv.drain()
+    handed = srv.handoff()
+    assert len(handed) == 1
+    text = hub.render(prefix="tpu_workload")
+    assert "tpu_workload_serve_draining 1" in text
+    assert "tpu_workload_serve_requests_handed_off 1" in text
+    while not srv.idle:
+        srv.step()
+    # telemetry never broke the drain contract
+    assert len(srv.poll()) == 1
+
+
+def test_uninstrumented_batcher_unchanged(params):
+    """metrics/tracer default to off — no hub, no spans, identical
+    outputs (the zero-overhead contract)."""
+    srv = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=64,
+                            block_size=8)
+    rid = srv.submit(_prompt(4), 3)
+    while not srv.idle:
+        srv.step()
+    assert len(srv.poll()[rid]) == 7
+
+
+# ----------------------------------------------- cmd/serve.py /metrics
+
+
+def _load_serve():
+    path = os.path.join(os.path.dirname(__file__), "..", "cmd", "serve.py")
+    spec = importlib.util.spec_from_file_location("tpu_serve_obs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_cli_metrics_endpoint(params):
+    mod = _load_serve()
+    rt = mod.ServingRuntime(params, CFG, max_slots=2, capacity=64,
+                            block_size=8, chunk=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), mod.make_handler(rt))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": [1, 2, 3], "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+    finally:
+        httpd.shutdown()
+        rt.stop()
+    families, samples = validate_exposition(body)
+    assert families["tpu_workload_serve_up"] == "gauge"
+    assert samples["tpu_workload_serve_up"][0][1] == {"component": "serve"}
+    assert families["tpu_workload_serve_ttft_seconds"] == "histogram"
+    assert families["tpu_workload_serve_step_duration_seconds"] \
+        == "histogram"
+    # every workload family carries a REAL registered description
+    for fam in families:
+        assert fam in HELP_TEXTS, f"{fam} missing from HELP_TEXTS"
+
+
+# --------------------------------------------- cmd/status.py --goodput
+
+
+def _load_status():
+    path = os.path.join(os.path.dirname(__file__), "..", "cmd", "status.py")
+    spec = importlib.util.spec_from_file_location("tpu_status_obs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ledger_and_journey(tmp_path, clock):
+    """A drained+resumed ledger and a matching journey annotation on a
+    fake-cluster node (timestamps hand-aligned on the same clock)."""
+    path = str(tmp_path / "goodput.jsonl")
+    led = GoodputLedger(path, clock=clock)
+    led.run_started(0)
+    clock.advance(5.0)
+    led.steps(50, 50, 5.0, 3200)
+    with led.phase("drain_save"):          # t=5..8
+        clock.advance(3.0)
+    led.run_ended(50, preempted=True)
+    led.close()
+    clock.advance(40.0)                     # the upgrade window
+    led2 = GoodputLedger(path, clock=clock)
+    led2.run_started(50)
+    with led2.phase("ckpt_restore"):        # t=48..50
+        clock.advance(2.0)
+    with led2.phase("rewarmup"):            # t=50..51
+        clock.advance(1.0)
+    clock.advance(0.5)
+    led2.steps(51, 1, 0.5, 64)
+    led2.run_ended(51, preempted=False)
+    led2.close()
+
+    cluster = FakeCluster()
+    cluster.add_node("n0")
+    keys = KeyFactory("libtpu")
+    journey = [["cordon-required", 4.0], ["wait-for-jobs-required", 4.5],
+               ["pod-deletion-required", 9.0], ["drain-required", 10.0],
+               ["pod-restart-required", 40.0],
+               ["uncordon-required", 44.0], ["upgrade-done", 45.0]]
+    cluster.client.patch_node_metadata(
+        "n0", annotations={keys.journey_annotation: json.dumps(journey)})
+    return path, cluster
+
+
+def test_status_goodput_renders_summary_and_attribution(tmp_path, capsys):
+    clock = FakeClock()
+    path, cluster = _ledger_and_journey(tmp_path, clock)
+    status = _load_status()
+    rc = status.main(["--component", "libtpu", "--goodput", path,
+                      "--goodput-node", "n0", "--json"],
+                     client=cluster.client, now=clock.now())
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"]["runs"] == 2
+    assert out["summary"]["badput_s"]["drain_save"] == pytest.approx(3.0)
+    reports = out["attribution"]["libtpu"]
+    assert len(reports) == 1
+    phases = reports[0]["phases"]
+    # the phases partition the observed window exactly
+    assert sum(phases.values()) == pytest.approx(reports[0]["total_s"])
+    assert phases["drain_save"] == pytest.approx(3.0)
+    assert phases["ckpt_restore"] == pytest.approx(2.0)
+    assert phases["rewarmup"] == pytest.approx(1.0)
+    # journey segments claim the drained-out middle of the window
+    assert phases["window_gate_to_restart"] > 0
+    assert phases["window_after_restart"] > 0
+
+    # human rendering carries the same decomposition
+    rc = status.main(["--component", "libtpu", "--goodput", path,
+                      "--goodput-node", "n0"],
+                     client=cluster.client, now=clock.now())
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "goodput" in text and "drain_save" in text
+    assert "window_gate_to_restart" in text
+
+
+def test_status_goodput_without_node_needs_no_cluster(tmp_path, capsys):
+    clock = FakeClock()
+    path, _ = _ledger_and_journey(tmp_path, clock)
+    status = _load_status()
+    # client=None and no --goodput-node: must not try to build a client
+    rc = status.main(["--component", "libtpu", "--goodput", path],
+                     client=None, now=clock.now())
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "unavailability window" in text

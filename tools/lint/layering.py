@@ -55,9 +55,12 @@ LAYERS: Dict[str, Set[str]] = {
     "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs"},
     "data": {"utils"},
     "ops": {"utils"},
-    "models": {"ops", "utils", "data"},
+    # obs sits below BOTH spines: the workload side (goodput ledger,
+    # serving telemetry) may import it too — obs itself still only sees
+    # core/utils, so the operator/model separation is untouched
+    "models": {"ops", "utils", "data", "obs"},
     "parallel": {"models", "ops", "utils"},
-    "train": {"models", "parallel", "ops", "utils", "data"},
+    "train": {"models", "parallel", "ops", "utils", "data", "obs"},
 }
 
 Finding = Tuple[str, int, str, str]

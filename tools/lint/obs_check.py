@@ -1,5 +1,6 @@
-"""OBS001: upgrade-journey observability closure — thresholds and the
-transition choke point can never drift.
+"""OBS001/OBS002: upgrade-journey observability closure — thresholds,
+the transition choke point, and the downtime-attribution phase table can
+never drift.
 
 The journey subsystem (``k8s_operator_libs_tpu/obs/journey.py``) sits
 BELOW the upgrade package in the layering DAG, so its per-state stuck
@@ -24,6 +25,19 @@ choke-point invariant that makes the journey trustworthy:
   journey key (``.journey_annotation`` / ``JOURNEY_ANNOTATION_FMT`` / a
   ``*-driver-upgrade.journey`` literal) bypasses the journey recording
   and desynchronizes timeline from label — reads are fine, writes fire.
+
+**OBS002** applies the same closure discipline to the downtime
+attribution table (``obs/attribution.py::WINDOW_PHASES``, also keyed by
+wire values because obs sits below upgrade):
+
+- every ``UpgradeState`` wire value must have a window-phase entry — a
+  new pipeline state with no phase would silently leak its dwell out of
+  the attributed unavailability window;
+- no stale keys (a renamed state losing its phase, seen from the table
+  side);
+- every value must be one of the four legal segment names
+  (``outside`` / ``to_gate`` / ``gate_to_restart`` / ``after_restart``)
+  — a typo'd segment would attribute time to a phase nothing reports.
 
 Proven on mutated copies of the real files by tests/test_lint_domain.py,
 like STM001.
@@ -211,3 +225,81 @@ def run_project(root: Path) -> List[Finding]:
 
 register(Check(name="obs-journey", codes=CODES, scope="project",
                run=run_project, domain=True))
+
+
+# --------------------------------------------------- OBS002 (attribution)
+
+ATTRIBUTION_CODES = {
+    "OBS002": "downtime-attribution drift: state without a WINDOW_PHASES "
+              "entry, stale phase key, or an unknown segment name",
+}
+
+ATTRIBUTION_PATH = "k8s_operator_libs_tpu/obs/attribution.py"
+ALLOWED_WINDOW_SEGMENTS = {"outside", "to_gate", "gate_to_restart",
+                           "after_restart"}
+
+
+def _window_phase_table(tree: ast.Module
+                        ) -> Tuple[Dict[str, Tuple[str, int]], int]:
+    """Literal entries of WINDOW_PHASES → ({key: (value, lineno)}, lineno
+    of the table; 0 when missing). Non-literal keys/values are skipped
+    (and will then fail the closure check, which is the right default)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "WINDOW_PHASES"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}, node.lineno
+        entries: Dict[str, Tuple[str, int]] = {}
+        for key, value in zip(node.value.keys, node.value.values):
+            if (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                entries[key.value] = (value.value, key.lineno)
+        return entries, node.lineno
+    return {}, 0
+
+
+def run_attribution(root: Path) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+    members = _state_wire_values(_parse(root, CONSTS_PATH))
+    if not members:
+        return [(CONSTS_PATH, 1, "OBS002",
+                 "no UpgradeState string members found (parse drift?)")]
+    table, table_line = _window_phase_table(_parse(root, ATTRIBUTION_PATH))
+    if table_line == 0:
+        return [(ATTRIBUTION_PATH, 1, "OBS002",
+                 "WINDOW_PHASES table not found (parse drift?)")]
+
+    wire_values = {v for v, _ in members.values()}
+    for name, (value, lineno) in sorted(members.items()):
+        if value not in table:
+            findings.append(
+                (CONSTS_PATH, lineno, "OBS002",
+                 f"state {name} ({value!r}) has no window-phase entry in "
+                 f"WINDOW_PHASES ({ATTRIBUTION_PATH}) — its dwell would "
+                 f"leak out of the attributed unavailability window"))
+    for key, (segment, lineno) in sorted(table.items()):
+        if key and key not in wire_values:
+            findings.append(
+                (ATTRIBUTION_PATH, lineno, "OBS002",
+                 f"window-phase key {key!r} matches no UpgradeState wire "
+                 f"value (renamed or removed state?)"))
+        if segment not in ALLOWED_WINDOW_SEGMENTS:
+            findings.append(
+                (ATTRIBUTION_PATH, lineno, "OBS002",
+                 f"window-phase value {segment!r} for key {key!r} is not "
+                 f"one of {sorted(ALLOWED_WINDOW_SEGMENTS)}"))
+    return findings
+
+
+register(Check(name="obs-attribution", codes=ATTRIBUTION_CODES,
+               scope="project", run=run_attribution, domain=True))
