@@ -12,8 +12,8 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  health-sim lint lint-domain cov-report cov-artifact bench dryrun \
-  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+  test-obs-slo health-sim lint lint-domain cov-report cov-artifact bench \
+  dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -38,6 +38,9 @@ test-obs:  ## observability tests: tracing, journey, stuck detection, exposition
 test-obs-workload:  ## workload telemetry: goodput ledger, serving metrics, downtime attribution (docs/observability.md)
 	$(PYTHON) -m pytest tests/test_goodput.py tests/test_workload_obs.py -q
 
+test-obs-slo:  ## SLO engine: tsdb, error budgets, burn-rate alerting, dashboard (docs/observability.md "SLOs & alerting")
+	$(PYTHON) -m pytest tests/test_slo.py -q
+
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py
 
@@ -50,7 +53,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
-lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, OBS001 journey closure, ARC001 import layering (docs/static-analysis.md)
+lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, ARC001 import layering (docs/static-analysis.md)
 	$(PYTHON) -m tools.lint --domain
 
 COV_MIN ?= 80

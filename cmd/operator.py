@@ -47,6 +47,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: 
 from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
 from k8s_operator_libs_tpu.obs import JsonlSink, MetricsHub, Tracer  # noqa: E402
+from k8s_operator_libs_tpu.obs.slo import SLOOptions  # noqa: E402
 from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
     ManagedComponent, TPUOperator)
 from k8s_operator_libs_tpu.upgrade import metrics as metrics_mod  # noqa: E402
@@ -83,6 +84,21 @@ def load_health(path: str):
     return HealthOptions.from_dict(section)
 
 
+def load_slo(path: str):
+    """Optional top-level ``slo:`` section → SLOOptions (None when absent
+    or explicitly disabled — like health, the SLO engine is opt-in;
+    ``slo: {}`` turns it on with the shipped default objectives)."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if "slo" not in cfg:
+        return None
+    section = cfg.get("slo") or {}
+    if section.get("enabled") is False:
+        return None
+    return SLOOptions.from_dict(section)
+
+
 def build_client(args, components):
     """The reference's two-client split (upgrade_state.go:127-135): a
     long-running operator reads through an informer cache (CachedClient)
@@ -115,11 +131,15 @@ def build_client(args, components):
 
 
 class MetricsServer:
-    """Serves /metrics (Prometheus text) + /healthz. The handler reads a
-    snapshot dict the reconcile loop refreshes after every tick."""
+    """Serves /metrics (Prometheus text), /healthz, and — when the SLO
+    engine is on — the /slo and /alerts JSON views the status dashboard
+    reads. The handler reads a snapshot dict the reconcile loop
+    refreshes after every tick, so ``status --slo`` and ``/alerts``
+    always show the same numbers the gauges carry."""
 
     def __init__(self, port: int):
-        self.snapshot = {"text": "", "healthy": False}
+        self.snapshot = {"text": "", "healthy": False,
+                         "slo": None, "alerts": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -135,6 +155,14 @@ class MetricsServer:
                     body = b"ok" if snapshot["healthy"] else b"not ready"
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
+                elif self.path in ("/slo", "/alerts"):
+                    payload = snapshot[self.path[1:]]
+                    if payload is None:
+                        body = b'{"error": "slo engine disabled"}'
+                        ctype, code = "application/json", 404
+                    else:
+                        body = payload.encode()
+                        ctype, code = "application/json", 200
                 else:
                     body, ctype, code = b"not found", "text/plain", 404
                 self.send_response(code)
@@ -176,6 +204,27 @@ def render_metrics(operator: TPUOperator, states, hub: MetricsHub) -> str:
                                       operator.last_health)
     text += hub.render()
     return text
+
+
+def slo_payload(operator: TPUOperator) -> str:
+    """The /slo JSON: every SLO status the engine computed this tick plus
+    budget-history samples from the tsdb — the sparkline feed for
+    ``status --slo --watch``. Envelope {"kind": ..., "data": ...} like
+    every machine-readable status surface."""
+    names = sorted(operator.last_slo)
+    history = {}
+    for name in names:
+        samples = operator.tsdb.samples(
+            "tpu_operator_slo_error_budget_remaining", {"slo": name})
+        history[name] = [[t, v] for t, v in samples[-90:]]
+    return json.dumps({"kind": "slo", "data": {
+        "slos": [operator.last_slo[name] for name in names],
+        "history": history}})
+
+
+def alerts_payload(operator: TPUOperator) -> str:
+    return json.dumps({"kind": "alerts",
+                       "data": operator.alert_manager.status()})
 
 
 def main(argv=None, stop=None, on_ready=None) -> int:
@@ -225,6 +274,7 @@ def main(argv=None, stop=None, on_ready=None) -> int:
     try:
         components = load_components(args.config)
         health = load_health(args.config)
+        slo = load_slo(args.config)
         client, recorder = build_client(args, components)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -247,10 +297,14 @@ def main(argv=None, stop=None, on_ready=None) -> int:
         "components": ",".join(c.name for c in components)})
     hub.set_gauge("leader", 0.0 if args.leader_elect else 1.0)
     operator = TPUOperator(client, components, recorder=recorder,
-                           health=health, tracer=tracer, metrics=hub)
+                           health=health, tracer=tracer, metrics=hub,
+                           slo=slo)
     if health is not None:
         logger.info("fleet health monitoring on (repair component %s)",
                     operator.health_component)
+    if slo is not None:
+        logger.info("SLO engine on (%d objectives: %s)", len(slo.specs),
+                    ", ".join(s.name for s in slo.specs))
     if args.trace_log:
         logger.info("tracing reconcile spans to %s", args.trace_log)
     stop = stop or threading.Event()
@@ -379,6 +433,9 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                 # healthy = the last tick reconciled every component; an
                 # apiserver outage flips this off so k8s probes can restart us
                 server.snapshot["healthy"] = last_ok
+                if operator.slo_engine is not None:
+                    server.snapshot["slo"] = slo_payload(operator)
+                    server.snapshot["alerts"] = alerts_payload(operator)
             if args.once:
                 break
             remaining = max(0.0, args.interval - (time.monotonic() - t0))

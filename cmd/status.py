@@ -27,10 +27,22 @@ drain-save / restore / re-warmup badput:
     python cmd/status.py --component libtpu \
         --goodput /ckpt/run1/goodput.jsonl --goodput-node v5p-host-3
 
+``--slo`` / ``--alerts`` render the SLO ENGINE's view (error budgets,
+burn rates, alert states) fetched from a running operator's ``/slo`` and
+``/alerts`` endpoints (``--operator-url``) — the exact numbers the
+gauges carry, no cluster access needed. ``--watch`` turns it into a
+live-refresh fleet dashboard with budget-history sparklines from the
+operator's in-process tsdb:
+
+    python cmd/status.py --slo --operator-url http://operator:8080 --watch
+
+``--json`` always emits one ``{"kind": <view>, "data": ...}`` envelope
+(kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``).
+
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
-gates and scripts can wait on it. ``--timeline`` and ``--goodput``
-always exit 0.
+gates and scripts can wait on it. ``--timeline``, ``--goodput``,
+``--slo``, and ``--alerts`` always exit 0.
 """
 
 import argparse
@@ -225,6 +237,156 @@ def _fmt_duration(seconds: float) -> str:
     return f"{seconds:.1f}s"
 
 
+# ------------------------------------------------- SLO / alert dashboard
+
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24, lo: float = 0.0,
+              hi: float = 1.0) -> str:
+    """Unicode sparkline over a FIXED [lo, hi] scale (budget history must
+    compare across refreshes, so no per-frame autoscaling)."""
+    out = []
+    span = hi - lo
+    for v in values[-width:]:
+        frac = 0.0 if span <= 0 else (v - lo) / span
+        frac = min(1.0, max(0.0, frac))
+        out.append(SPARK_CHARS[round(frac * (len(SPARK_CHARS) - 1))])
+    return "".join(out)
+
+
+def fetch_view(operator_url: str, path: str):
+    """GET the operator's /slo or /alerts JSON envelope."""
+    import urllib.request
+    url = operator_url.rstrip("/") + path
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fmt_rate(rate) -> str:
+    return "-" if rate is None else f"{rate:.1f}x"
+
+
+def render_slo(data) -> str:
+    """One row per SLO: budget remaining, fastest burn pair, breach
+    state, and the budget-history sparkline (fixed 0..1 scale)."""
+    slos = data.get("slos") or []
+    history = data.get("history") or {}
+    if not slos:
+        return "no SLOs evaluated yet (engine warming up?)"
+    headers = ("SLO", "TARGET", "WINDOW", "BUDGET", "BURN", "STATE",
+               "HISTORY")
+    table = []
+    for s in slos:
+        burn = s.get("burn") or []
+        fastest = burn[0] if burn else {}
+        hot = next((b for b in burn if b.get("triggered")), None)
+        shown = hot or fastest
+        burn_txt = "-"
+        if shown:
+            burn_txt = (f"{_fmt_rate(shown.get('long_rate'))}/"
+                        f"{shown['long']}")
+        if s.get("no_data"):
+            state = "no-data"
+        elif s.get("breach"):
+            state = s["breach"].upper()
+        else:
+            state = "ok"
+        spark = sparkline([v for _, v in history.get(s["name"], [])])
+        table.append((s["name"], f"{s['target']:.2%}", s["window"],
+                      f"{s['error_budget_remaining']:.1%}", burn_txt,
+                      state, spark))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    breaches = [s["name"] for s in slos if s.get("breach")]
+    lines.append(f"{len(slos)} SLOs, "
+                 f"{len(breaches)} burning ({', '.join(breaches) or '-'})")
+    return "\n".join(lines)
+
+
+def render_alerts(data) -> str:
+    """One row per alert rule, firing first (the server pre-sorts)."""
+    if not data:
+        return "no alert rules evaluated yet"
+    headers = ("RULE", "SEVERITY", "STATE", "SINCE", "MESSAGE")
+    table = []
+    for a in data:
+        since = a.get("firing_since") or a.get("pending_since")
+        since_txt = "-"
+        if since:
+            since_txt = datetime.datetime.fromtimestamp(
+                since, tz=datetime.timezone.utc).strftime(
+                "%Y-%m-%d %H:%M:%S")
+        table.append((a["rule"], a["severity"], a["state"], since_txt,
+                      (a.get("message") or "-")[:60]))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    firing = sum(1 for a in data if a["state"] == "firing")
+    pending = sum(1 for a in data if a["state"] == "pending")
+    lines.append(f"{len(data)} rules: {firing} firing, {pending} pending")
+    return "\n".join(lines)
+
+
+def render_dashboard(slo_data, alerts_data, operator_url: str) -> str:
+    stamp = datetime.datetime.now(tz=datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%S UTC")
+    return "\n".join([
+        f"tpu-operator fleet SLOs  ({operator_url}, {stamp})",
+        "",
+        render_slo(slo_data),
+        "",
+        render_alerts(alerts_data),
+    ])
+
+
+def run_slo_view(args) -> int:
+    """--slo / --alerts (one-shot or --watch live dashboard). Data comes
+    from the operator's HTTP endpoints so this view, the gauges, and the
+    Events all agree."""
+    iterations = 0
+    while True:
+        try:
+            slo_env = (fetch_view(args.operator_url, "/slo")
+                       if (args.slo or args.watch) else None)
+            alerts_env = (fetch_view(args.operator_url, "/alerts")
+                          if (args.alerts or args.watch) else None)
+        except Exception as exc:
+            print(f"error: cannot read {args.operator_url}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if args.watch:
+            body = render_dashboard(
+                (slo_env or {}).get("data") or {},
+                (alerts_env or {}).get("data") or [], args.operator_url)
+            # ANSI clear + home: repaint in place like `watch(1)`
+            print("\x1b[2J\x1b[H" + body, flush=True)
+        elif args.as_json:
+            # the server already speaks the {"kind", "data"} envelope;
+            # emit it verbatim so /slo and --slo can never disagree
+            for env in (slo_env, alerts_env):
+                if env is not None:
+                    print(json.dumps(env, indent=2))
+        else:
+            if slo_env is not None:
+                print(render_slo(slo_env["data"]))
+            if alerts_env is not None:
+                if slo_env is not None:
+                    print()
+                print(render_alerts(alerts_env["data"]))
+        iterations += 1
+        if not args.watch or (args.watch_count
+                              and iterations >= args.watch_count):
+            return 0
+        time.sleep(args.watch_interval)
+
+
 def render_timeline(component: str, node_name: str, rows, stuck) -> str:
     lines = [f"component: {component}  node: {node_name}"]
     if not rows:
@@ -255,8 +417,9 @@ def render_timeline(component: str, node_name: str, rows, stuck) -> str:
 
 def main(argv=None, client=None, now=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--component", action="append", required=True,
-                   help="managed component name (repeatable)")
+    p.add_argument("--component", action="append", default=None,
+                   help="managed component name (repeatable; required for "
+                        "the fleet/timeline/goodput views)")
     p.add_argument("--namespace", default="kube-system")
     p.add_argument("--selector", default=None,
                    help='driver-pod label selector, "k=v,k2=v2"')
@@ -264,7 +427,7 @@ def main(argv=None, client=None, now=None) -> int:
     p.add_argument("--context", default=None)
     p.add_argument("--in-cluster", action="store_true")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable output")
+                   help="machine-readable output ({kind, data} envelope)")
     p.add_argument("--timeline", default=None, metavar="NODE",
                    help="render NODE's upgrade journey (per-phase "
                         "durations) instead of the fleet table")
@@ -274,7 +437,30 @@ def main(argv=None, client=None, now=None) -> int:
     p.add_argument("--goodput-node", default=None, metavar="NODE",
                    help="with --goodput: attribute each unavailability "
                         "window against NODE's upgrade journey")
+    p.add_argument("--slo", action="store_true",
+                   help="render the SLO engine's error budgets and burn "
+                        "rates from a running operator")
+    p.add_argument("--alerts", action="store_true",
+                   help="render the alert rule states from a running "
+                        "operator")
+    p.add_argument("--operator-url", default="http://127.0.0.1:8080",
+                   metavar="URL",
+                   help="operator metrics server for --slo/--alerts "
+                        "(default %(default)s)")
+    p.add_argument("--watch", action="store_true",
+                   help="with --slo/--alerts: live-refresh fleet "
+                        "dashboard (sparkline budget history)")
+    p.add_argument("--watch-interval", type=float, default=2.0,
+                   metavar="SECONDS")
+    p.add_argument("--watch-count", type=int, default=0, metavar="N",
+                   help="stop after N refreshes (0 = forever)")
     args = p.parse_args(argv)
+
+    if args.slo or args.alerts or args.watch:
+        # SLO views read the operator's HTTP endpoints, never the cluster
+        return run_slo_view(args)
+    if not args.component:
+        p.error("--component is required (except with --slo/--alerts)")
     # --goodput without a node never touches the cluster — the ledger is
     # a local file
     if client is None and not (args.goodput and not args.goodput_node):
@@ -286,8 +472,9 @@ def main(argv=None, client=None, now=None) -> int:
             args.goodput, client=client, components=args.component,
             node_name=args.goodput_node, now=now)
         if args.as_json:
-            print(json.dumps({"ledger": args.goodput, "summary": summary,
-                              "attribution": attributions}, indent=2))
+            print(json.dumps({"kind": "goodput", "data": {
+                "ledger": args.goodput, "summary": summary,
+                "attribution": attributions}}, indent=2))
         else:
             print(render_goodput(args.goodput, summary, attributions,
                                  node_name=args.goodput_node))
@@ -304,7 +491,7 @@ def main(argv=None, client=None, now=None) -> int:
                 print(render_timeline(comp, args.timeline, rows, stuck))
                 print()
         if args.as_json:
-            print(json.dumps(out, indent=2))
+            print(json.dumps({"kind": "timeline", "data": out}, indent=2))
         return 0
 
     rc = 0

@@ -25,7 +25,18 @@ al., 2010) and the time-series-first philosophy of Borgmon/Prometheus:
                     and splits each unavailability window into the named
                     phases the bench reports; also owns the downtime
                     formula (``bench.py`` and production metrics are the
-                    same code path).
+                    same code path);
+- :mod:`.tsdb`    — the TEMPORAL layer: a clock-injected, fixed-memory
+                    ring-buffer time-series store scraped from the hub
+                    and gauge collectors once per reconcile tick, with
+                    downsampling for long windows and a bucket-quantile
+                    estimator;
+- :mod:`.slo`     — declarative SLO specs over the tsdb: error-budget
+                    accounting and Google-SRE multi-window multi-burn-
+                    rate evaluation;
+- :mod:`.alerts`  — ``for:``-duration pending→firing→resolved alert
+                    rules with dedup, Kubernetes Events, and the
+                    ``alert_firing`` gauge.
 
 Layering: ``obs`` sits BELOW ``upgrade``/``health``/``tpu`` (they import
 it, never the reverse), so the journey thresholds are keyed by the state
@@ -33,6 +44,7 @@ WIRE VALUES — the OBS001 lint pass proves that table stays closed over
 ``UpgradeState``.
 """
 
+from .alerts import AlertManager, AlertRule
 from .attribution import (WINDOW_PHASES, WindowBreakdown,
                           attribute_downtime, downtime_summary,
                           slice_window, windows_from_journey)
@@ -40,14 +52,21 @@ from .goodput import (GoodputLedger, read_ledger, summarize,
                       unavailability_windows)
 from .journey import (DEFAULT_STUCK_THRESHOLDS, JourneyRecorder,
                       StuckNodeDetector, parse_journey)
-from .metrics import HELP_TEXTS, MetricsHub, help_for
+from .metrics import HELP_TEXTS, MetricsHub, escape_label_value, help_for
+from .slo import (DEFAULT_BURN_WINDOWS, DEFAULT_SLO_SPECS, BurnWindow,
+                  SLOEngine, SLOOptions, SLOSpec, parse_duration)
 from .trace import JsonlSink, ListSink, NullSink, Span, Tracer
+from .tsdb import TimeSeriesStore, quantile_from_buckets
 
 __all__ = [
     "DEFAULT_STUCK_THRESHOLDS", "JourneyRecorder", "StuckNodeDetector",
-    "parse_journey", "HELP_TEXTS", "MetricsHub", "help_for",
-    "JsonlSink", "ListSink", "NullSink", "Span", "Tracer",
+    "parse_journey", "HELP_TEXTS", "MetricsHub", "escape_label_value",
+    "help_for", "JsonlSink", "ListSink", "NullSink", "Span", "Tracer",
     "GoodputLedger", "read_ledger", "summarize", "unavailability_windows",
     "WINDOW_PHASES", "WindowBreakdown", "attribute_downtime",
     "downtime_summary", "slice_window", "windows_from_journey",
+    "TimeSeriesStore", "quantile_from_buckets",
+    "DEFAULT_BURN_WINDOWS", "DEFAULT_SLO_SPECS", "BurnWindow",
+    "SLOEngine", "SLOOptions", "SLOSpec", "parse_duration",
+    "AlertManager", "AlertRule",
 ]

@@ -1,0 +1,180 @@
+"""Alert rule evaluation: ``for:``-duration pending→firing→resolved.
+
+The last mile of the SLO engine: a condition (burn-rate pair triggered)
+becomes an *alert* only after holding for the rule's ``for_s`` duration —
+the Prometheus ``for:`` semantic that keeps a single slow reconcile tick
+from paging anyone. State machine per rule::
+
+    inactive ──condition──▶ pending ──held for_s──▶ firing
+       ▲                       │                       │
+       └───────cleared─────────┘        cleared────────▶ resolved
+                                                   (back to pending on
+                                                    the next episode)
+
+Deduplication is structural: exactly ONE Kubernetes Event per
+pending→firing transition (reason ``SLOAlertFiring``) and one per
+firing→resolved (``SLOAlertResolved``), recorded through the injected
+:class:`~..core.client.EventRecorder` — the same ``ClientEventRecorder``
+wiring the upgrade and health loops already use, so ``kubectl get
+events`` shows budget burns next to cordons and quarantines. A rule that
+stays firing re-emits nothing.
+
+The ``tpu_operator_alert_firing{rule,severity}`` gauge (0/1 per known
+rule) rides the shared :class:`~.metrics.MetricsHub`; :meth:`AlertManager.
+status` is the JSON the operator's ``/alerts`` endpoint and ``status
+--alerts`` render.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.clock import Clock, RealClock
+
+logger = logging.getLogger(__name__)
+
+INACTIVE = "inactive"
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+FIRING_EVENT_REASON = "SLOAlertFiring"
+RESOLVED_EVENT_REASON = "SLOAlertResolved"
+
+# gauge families emitted through the hub (full exposed names; literal —
+# OBS003 closes this over HELP_TEXTS in both directions)
+ALERT_GAUGE_FAMILIES = (
+    "tpu_operator_alert_firing",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One evaluated rule. ``for_s`` is the Prometheus ``for:`` — the
+    condition must hold this long before pending becomes firing."""
+
+    name: str
+    severity: str = "page"
+    for_s: float = 60.0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    description: str = ""
+
+    def __post_init__(self):
+        # labels must stay hashable-independent; freeze a copy so a
+        # caller mutating its dict cannot skew an already-seen rule
+        object.__setattr__(self, "labels", dict(self.labels))
+
+
+class _AlertMeta:
+    def __init__(self, name: str):
+        self.name = name
+
+
+class _AlertObject:
+    """Event anchor: alerts have no node to attach to, so the Event's
+    involved object is a synthetic ``SLOAlert/<rule>``."""
+
+    kind = "SLOAlert"
+
+    def __init__(self, name: str):
+        self.metadata = _AlertMeta(name)
+
+
+class AlertManager:
+    """Tracks rule state across evaluations. Clock-injected; one
+    instance per operator process (the reconcile loop is the only
+    writer, HTTP handlers only read :meth:`status`)."""
+
+    def __init__(self, clock: Optional[Clock] = None, metrics=None,
+                 recorder=None):
+        self._clock = clock or RealClock()
+        self._metrics = metrics
+        self._recorder = recorder
+        self._states: Dict[str, Dict[str, Any]] = {}
+
+    # --------------------------------------------------------- evaluation
+
+    def evaluate(self, conditions: List[Tuple[AlertRule, bool, str]]
+                 ) -> None:
+        """One evaluation pass: ``conditions`` is ``[(rule, active,
+        message), ...]`` — every rule the caller knows about, each tick
+        (a rule missing from the list keeps its last state)."""
+        now = self._clock.wall()
+        for rule, active, message in conditions:
+            st = self._states.get(rule.name)
+            if st is None:
+                st = self._states[rule.name] = {
+                    "rule": rule.name,
+                    "severity": rule.severity,
+                    "labels": dict(rule.labels),
+                    "description": rule.description,
+                    "for_s": rule.for_s,
+                    "state": INACTIVE,
+                    "pending_since": None,
+                    "firing_since": None,
+                    "resolved_at": None,
+                    "message": "",
+                    "events_emitted": 0,
+                }
+            st["for_s"] = rule.for_s
+            if active:
+                st["message"] = message or st["message"]
+                if st["state"] in (INACTIVE, RESOLVED):
+                    st["state"] = PENDING
+                    st["pending_since"] = now
+                if (st["state"] == PENDING
+                        and now - st["pending_since"] >= rule.for_s):
+                    st["state"] = FIRING
+                    st["firing_since"] = now
+                    st["resolved_at"] = None
+                    st["events_emitted"] += 1
+                    self._emit(rule, "Warning", FIRING_EVENT_REASON,
+                               st["message"] or
+                               f"alert {rule.name} firing")
+                    logger.warning("alert %s FIRING: %s", rule.name,
+                                   st["message"])
+            else:
+                if st["state"] == PENDING:
+                    # never fired: no event owed, drop back silently
+                    st["state"] = INACTIVE
+                    st["pending_since"] = None
+                elif st["state"] == FIRING:
+                    st["state"] = RESOLVED
+                    st["resolved_at"] = now
+                    self._emit(rule, "Normal", RESOLVED_EVENT_REASON,
+                               f"alert {rule.name} resolved after "
+                               f"{now - st['firing_since']:.0f}s")
+                    logger.info("alert %s resolved", rule.name)
+        if self._metrics is not None:
+            for st in self._states.values():
+                self._metrics.set_gauge(
+                    "alert_firing",
+                    1.0 if st["state"] == FIRING else 0.0,
+                    labels={"rule": st["rule"],
+                            "severity": st["severity"]})
+
+    def _emit(self, rule: AlertRule, event_type: str, reason: str,
+              message: str) -> None:
+        if self._recorder is None:
+            return
+        try:
+            self._recorder.event(_AlertObject(rule.name), event_type,
+                                 reason, message)
+        except Exception:
+            logger.exception("alert event emit failed for %s", rule.name)
+
+    # -------------------------------------------------------------- reads
+
+    def status(self) -> List[Dict[str, Any]]:
+        """JSON-able rule states, firing first then pending, for the
+        ``/alerts`` endpoint and ``status --alerts``."""
+        order = {FIRING: 0, PENDING: 1, RESOLVED: 2, INACTIVE: 3}
+        return sorted((dict(st) for st in self._states.values()),
+                      key=lambda st: (order.get(st["state"], 9),
+                                      st["rule"]))
+
+    def firing(self) -> List[str]:
+        return [st["rule"] for st in self._states.values()
+                if st["state"] == FIRING]

@@ -51,6 +51,7 @@ import os
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
+from .trace import DEFAULT_MAX_LOG_BYTES, rotate_jsonl
 
 logger = logging.getLogger(__name__)
 
@@ -70,26 +71,34 @@ class GoodputLedger:
 
     def __init__(self, path: str, clock: Optional[Clock] = None,
                  metrics=None, flops_per_token: float = 0.0,
-                 peak_flops: float = 0.0):
+                 peak_flops: float = 0.0,
+                 max_bytes: int = DEFAULT_MAX_LOG_BYTES):
         self.path = path
         self.clock = clock or RealClock()
         self._metrics = metrics
         self.flops_per_token = float(flops_per_token)
         self.peak_flops = float(peak_flops)
+        self._max_bytes = int(max_bytes)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
-        # a non-empty pre-existing file means this process CONTINUES a
-        # prior run's ledger — the resumed-job signal that names the
-        # first-step phase "rewarmup" instead of "compile"
-        self.resumed = os.path.exists(path) and os.path.getsize(path) > 0
+        # a non-empty pre-existing file (or a rotated generation) means
+        # this process CONTINUES a prior run's ledger — the resumed-job
+        # signal that names the first-step phase "rewarmup" instead of
+        # "compile"
+        self.resumed = any(
+            os.path.exists(p) and os.path.getsize(p) > 0
+            for p in (path, path + ".1"))
         self._fh = open(path, "a", encoding="utf-8")
 
     # ------------------------------------------------------------- writes
 
     def _write(self, record: Dict[str, Any]) -> None:
-        self._fh.write(json.dumps(record, separators=(",", ":"),
-                                  sort_keys=True) + "\n")
+        line = json.dumps(record, separators=(",", ":"), sort_keys=True)
+        if (self._max_bytes > 0 and self._fh.tell() > 0
+                and self._fh.tell() + len(line) + 1 > self._max_bytes):
+            self._fh = rotate_jsonl(self._fh, self.path)
+        self._fh.write(line + "\n")
         self._fh.flush()
 
     def run_started(self, step: int) -> None:
@@ -162,19 +171,26 @@ class GoodputLedger:
 
 
 def read_ledger(path: str) -> List[Dict[str, Any]]:
-    """Parse a ledger JSONL file; malformed lines are skipped with a
-    warning (a crash mid-write truncates at most the last line)."""
+    """Parse a ledger JSONL file — the rotated ``.1`` generation first
+    (older records) when one exists, so windows spanning a rotation stay
+    contiguous; malformed lines are skipped with a warning (a crash
+    mid-write truncates at most the last line)."""
     records: List[Dict[str, Any]] = []
-    with open(path, "r", encoding="utf-8") as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                logger.warning("%s:%d: unparseable ledger line; skipped",
-                               path, lineno)
+    paths = [p for p in (path + ".1", path) if os.path.exists(p)]
+    if not paths:
+        # preserve the historical FileNotFoundError for a missing ledger
+        paths = [path]
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    logger.warning("%s:%d: unparseable ledger line; "
+                                   "skipped", p, lineno)
     return records
 
 

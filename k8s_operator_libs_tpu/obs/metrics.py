@@ -131,6 +131,17 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_operator_leader":
         "1 on the replica holding the leader lease (or running without "
         "leader election), 0 on hot standbys",
+    # SLO engine + alert manager families (obs/slo.py, obs/alerts.py —
+    # OBS003 closes these over the emitted-family tables both ways)
+    "tpu_operator_slo_error_budget_remaining":
+        "Fraction of the SLO's rolling-window error budget still unspent "
+        "(1 = untouched, 0 = exhausted, negative = overspent)",
+    "tpu_operator_slo_burn_rate":
+        "Error-budget burn rate over the fastest long window (1 = "
+        "spending exactly the budget over the SLO window)",
+    "tpu_operator_alert_firing":
+        "1 while the burn-rate alert rule is firing (past its for: "
+        "duration), else 0",
     # workload families (obs/goodput.py ledger + models/serve.py batcher,
     # exposed by cmd/train.py and cmd/serve.py under the tpu_workload
     # prefix — distinct from the operator's so a combined scrape never
@@ -225,9 +236,15 @@ def _fmt_float(v: float) -> str:
     return repr(v)
 
 
-def _escape_label(value: str) -> str:
+def escape_label_value(value: str) -> str:
+    """Prometheus label-value escaping (backslash, double-quote, newline)
+    — shared with the gauge renderer in upgrade/metrics.py so every label
+    on the combined endpoint goes through one escape path."""
     return (value.replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+_escape_label = escape_label_value
 
 
 def _label_str(labels: Dict[str, str], extra: str = "") -> str:
@@ -317,6 +334,30 @@ class MetricsHub:
     def histogram_families(self) -> List[str]:
         with self._lock:
             return sorted(self._hists)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy for the tsdb scraper (names UNprefixed, as
+        stored): ``{"gauges": {name: [(labels, value), ...]},
+        "histograms": {name: [(labels, [(le, cumulative_count), ...
+        (+Inf, total)], sum, count), ...]}}``."""
+        with self._lock:
+            gauges = {name: [(dict(key), value)
+                             for key, value in series.items()]
+                      for name, series in self._gauges.items()}
+            hists: Dict[str, list] = {}
+            for name, hist in self._hists.items():
+                fam = []
+                for key, (counts, total) in hist.series.items():
+                    cumulative = 0
+                    cum = []
+                    for bound, c in zip(hist.buckets, counts):
+                        cumulative += c
+                        cum.append((bound, cumulative))
+                    cumulative += counts[-1]
+                    cum.append((float("inf"), cumulative))
+                    fam.append((dict(key), cum, total, cumulative))
+                hists[name] = fam
+        return {"gauges": gauges, "histograms": hists}
 
     def get_histogram(self, name: str) -> Optional[_Histogram]:
         with self._lock:

@@ -25,10 +25,24 @@ from __future__ import annotations
 import abc
 import itertools
 import json
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
 from ..utils.clock import Clock, RealClock
+
+# JSONL sinks (span traces here, the goodput ledger in .goodput) rotate
+# once the live file crosses this cap: one rename to a ".1" sibling, so
+# total disk stays bounded at ~2x the cap per sink
+DEFAULT_MAX_LOG_BYTES = 64 * 1024 * 1024
+
+
+def rotate_jsonl(fh, path: str):
+    """Close ``fh``, move ``path`` to ``path + ".1"`` (replacing any
+    previous rotation), and reopen ``path`` fresh for append."""
+    fh.close()
+    os.replace(path, path + ".1")
+    return open(path, "a", encoding="utf-8")
 
 
 class Sink(abc.ABC):
@@ -57,16 +71,23 @@ class ListSink(Sink):
 
 class JsonlSink(Sink):
     """One JSON object per line, flushed per span — the file is tailable
-    while the operator runs, and a crash loses at most the open span."""
+    while the operator runs, and a crash loses at most the open span.
+    Size-capped: past ``max_bytes`` the live file rotates to a ``.1``
+    sibling (one generation kept), so a long-running operator's
+    ``--trace-log`` can never fill the disk."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = DEFAULT_MAX_LOG_BYTES):
         self._path = path
+        self._max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._fh = open(path, "a", encoding="utf-8")
 
     def emit(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record, separators=(",", ":"), sort_keys=True)
         with self._lock:
+            if (self._max_bytes > 0 and self._fh.tell() > 0
+                    and self._fh.tell() + len(line) + 1 > self._max_bytes):
+                self._fh = rotate_jsonl(self._fh, self._path)
             self._fh.write(line + "\n")
             self._fh.flush()
 

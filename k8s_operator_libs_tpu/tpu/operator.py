@@ -23,10 +23,15 @@ from typing import Dict, List, Optional
 
 from ..api.v1alpha1 import DriverUpgradePolicySpec
 from ..core.client import Client, EventRecorder
+from ..health import metrics as health_metrics
 from ..health.consts import HealthVerdict
 from ..health.monitor import (FleetHealthMonitor, HealthOptions,
                               HealthReport)
+from ..obs.alerts import AlertManager
 from ..obs.journey import StuckNodeDetector
+from ..obs.slo import SLOEngine, SLOOptions
+from ..obs.tsdb import TimeSeriesStore
+from ..upgrade import metrics as upgrade_metrics
 from ..upgrade.groups import GroupPolicy
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from ..upgrade.util import KeyFactory, log_event
@@ -57,7 +62,8 @@ class TPUOperator:
                  synchronous: bool = False,
                  health: Optional[HealthOptions] = None,
                  tracer=None, metrics=None,
-                 stuck_thresholds: Optional[Dict[str, float]] = None):
+                 stuck_thresholds: Optional[Dict[str, float]] = None,
+                 slo: Optional[SLOOptions] = None):
         self.client = client
         self.components = components
         self.clock = clock or RealClock()
@@ -122,6 +128,27 @@ class TPUOperator:
                 driver_labels=repair_comp.driver_labels,
                 grouper=TPUSliceGrouper(), recorder=recorder,
                 clock=self.clock, options=health, metrics=metrics)
+        # SLO layer (obs/slo.py): the tsdb scrapes the hub + gauge
+        # collectors once per tick, the engine turns the history into
+        # error budgets and burn rates, and the alert manager drives
+        # pending -> firing -> resolved with Kubernetes Events. All of it
+        # lives strictly AFTER the reconcile work in the tick — a failed
+        # evaluation can never wedge an upgrade.
+        self.tsdb: Optional[TimeSeriesStore] = None
+        self.slo_engine: Optional[SLOEngine] = None
+        self.alert_manager: Optional[AlertManager] = None
+        self.last_slo: Dict[str, dict] = {}
+        self._slo_options = slo
+        if slo is not None:
+            self.tsdb = TimeSeriesStore(
+                clock=self.clock, raw_points=slo.raw_points,
+                downsample_every=slo.downsample_every,
+                coarse_points=slo.coarse_points)
+            self.slo_engine = SLOEngine(self.tsdb, slo.specs,
+                                        clock=self.clock, metrics=metrics)
+            self.alert_manager = AlertManager(clock=self.clock,
+                                              metrics=metrics,
+                                              recorder=recorder)
 
     # ---------------------------------------------------------- workloads
 
@@ -207,6 +234,13 @@ class TPUOperator:
         if self.metrics is not None:
             self.metrics.observe("reconcile_tick_duration_seconds",
                                  max(0.0, self.clock.now() - t0))
+        if self.slo_engine is not None:
+            with self._span("slo-tick"):
+                try:
+                    self._slo_tick(states)
+                except Exception:
+                    logger.exception("SLO tick failed; reconcile result "
+                                     "unaffected")
         return states
 
     # ------------------------------------------------------- observability
@@ -215,6 +249,46 @@ class TPUOperator:
         if self.tracer is None:
             return contextlib.nullcontext()
         return self.tracer.span(name, **attrs)
+
+    def _slo_tick(self, states: Dict[str, Optional[object]]) -> None:
+        """Scrape this tick's signals into the tsdb, then evaluate every
+        SLO and alert rule. The gauge collectors run on the states the
+        tick already joined — no extra apiserver LISTs, and nothing here
+        touches the reconcile hot path."""
+        extra: Dict[str, list] = {}
+        for comp in self.components:
+            state = states.get(comp.name)
+            if state is None:
+                continue
+            collected = upgrade_metrics.collect(self.managers[comp.name],
+                                                state)
+            for name, value in collected.items():
+                full = upgrade_metrics.sanitize_metric_name(
+                    f"tpu_operator_{name}")
+                extra.setdefault(full, []).append(
+                    ({"component": comp.name}, float(value)))
+        if self.last_health is not None:
+            for name, value in health_metrics.collect(
+                    self.last_health).items():
+                full = upgrade_metrics.sanitize_metric_name(
+                    f"{health_metrics.HEALTH_PREFIX}_{name}")
+                extra.setdefault(full, []).append(
+                    ({"component": self.health_component or ""},
+                     float(value)))
+        # an unlabelled aggregate per family so label-free SLO specs
+        # (e.g. slice-unavailability) see the fleet, not one component;
+        # max, not sum — every component's manager counts the same
+        # cordoned/not-Ready nodes, so summing would double-count them
+        for full, entries in list(extra.items()):
+            if len(entries) > 1 or entries[0][0]:
+                extra[full] = entries + [
+                    ({}, max(value for _, value in entries))]
+        self.tsdb.scrape(hub=self.metrics, extra_gauges=extra)
+        self.last_slo = self.slo_engine.evaluate()
+        opts = self._slo_options
+        self.alert_manager.evaluate(self.slo_engine.alert_conditions(
+            self.last_slo, page_for_s=opts.page_for_s,
+            ticket_for_s=opts.ticket_for_s))
 
     def _check_stuck_nodes(self, states: Dict[str, Optional[object]]) -> None:
         """Run each component's stuck detector over the nodes this tick's

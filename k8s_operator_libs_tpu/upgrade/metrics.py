@@ -12,7 +12,7 @@ from __future__ import annotations
 import re
 from typing import Dict
 
-from ..obs.metrics import help_for
+from ..obs.metrics import escape_label_value, help_for
 from .consts import UpgradeState
 from .upgrade_state import ClusterUpgradeState, ClusterUpgradeStateManager
 
@@ -63,8 +63,12 @@ def render_prometheus_multi(per_component: Dict[str, Dict[str, float]],
         for component in sorted(per_component):
             metrics = per_component[component]
             if name in metrics:
+                # component names are config-controlled strings: escape
+                # them like every hub label, or a quote/backslash in the
+                # YAML silently corrupts the whole exposition
+                value = escape_label_value(str(component))
                 lines.append(
-                    f'{metric}{{component="{component}"}} {metrics[name]}')
+                    f'{metric}{{component="{value}"}} {metrics[name]}')
     return "\n".join(lines) + "\n" if lines else ""
 
 

@@ -35,10 +35,11 @@ def codes(findings):
 def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
-            "obs-journey", "obs-attribution", "import-layering"} <= names
+            "obs-journey", "obs-attribution", "obs-slo",
+            "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
-            "LCK003", "STM001", "OBS001", "OBS002", "ARC001"} \
+            "LCK003", "STM001", "OBS001", "OBS002", "OBS003", "ARC001"} \
         <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
@@ -581,6 +582,86 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
     findings = obs_check.run_attribution(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "gate_to_restrat" in msgs and "not one of" in msgs
+
+
+# ------------------------------------- OBS003 (SLO catalog, mutated)
+
+OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
+              obs_check.METRICS_PATH]
+
+
+def _obs3_root(tmp_path, mutate=None):
+    root = tmp_path / "repo3"
+    for rel in OBS3_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_obs003_real_repo_files_pass(tmp_path):
+    assert obs_check.run_slo(_obs3_root(tmp_path)) == []
+
+
+def test_obs003_real_repo_passes():
+    assert obs_check.run_slo(REPO) == []
+
+
+def test_obs003_spec_with_unregistered_metric_fails(tmp_path):
+    """A typo'd metric family in a default SLO spec would evaluate to
+    "no data" forever — the pass fails naming the SLO and the family."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.SLO_PATH: lambda s: s.replace(
+            '"metric": "tpu_operator_drain_duration_seconds"',
+            '"metric": "tpu_operator_drain_duration_secondz"')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "drain-latency" in msgs
+    assert "tpu_operator_drain_duration_secondz" in msgs
+
+
+def test_obs003_emitted_family_without_help_fails(tmp_path):
+    """A new emitted gauge family with no HELP_TEXTS entry would render
+    with the underscores-to-spaces fallback."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.ALERTS_PATH: lambda s: s.replace(
+            '    "tpu_operator_alert_firing",',
+            '    "tpu_operator_alert_firing",\n'
+            '    "tpu_operator_alert_pending",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_operator_alert_pending" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_stale_help_entry_fails(tmp_path):
+    """A tpu_operator_slo_* HELP entry nothing emits is a renamed or
+    removed gauge seen from the catalog side."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_operator_alert_firing":',
+            '    "tpu_operator_slo_ghost": "phantom budget gauge",\n'
+            '    "tpu_operator_alert_firing":')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_operator_slo_ghost" in msgs
+    assert "no emitted family" in msgs
+
+
+def test_obs003_non_slo_help_entries_stay_exempt(tmp_path):
+    """Only the slo/alert prefixes are closed over the emitted tables —
+    the rest of the catalog (phase histograms, workload families) is
+    owned by other layers and must not fire here."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_operator_alert_firing":',
+            '    "tpu_operator_some_new_histogram": "fine",\n'
+            '    "tpu_operator_alert_firing":')})
+    assert obs_check.run_slo(root) == []
 
 
 # ------------------------------------------------- ARC001 (fake packages)

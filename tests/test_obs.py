@@ -376,10 +376,14 @@ def test_status_timeline_renders_canned_upgrade_run(capsys):
     assert "+" in out
     assert "transitions" in out
 
-    # machine-readable variant carries the same rows
+    # machine-readable variant carries the same rows, inside the shared
+    # {"kind", "data"} envelope every status view emits
     rc = status.main(["--component", "libtpu", "--timeline", "n0",
                       "--json"], client=cluster.client, now=clock.wall())
-    payload = json.loads(capsys.readouterr().out)
+    envelope = json.loads(capsys.readouterr().out)
+    assert set(envelope) == {"kind", "data"}
+    assert envelope["kind"] == "timeline"
+    payload = envelope["data"]
     states = [r["state"] for r in payload["libtpu"]["timeline"]]
     assert states[0] == "upgrade-required"
     assert states[-1] == "upgrade-done"

@@ -39,13 +39,19 @@ NS = "kube-system"
 
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$")
+# one label pair with STRICT value escaping: only \\ \" \n escapes, no
+# raw backslash/quote/newline may appear in a value
+_LABEL_PAIR = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\\\|\\"|\\n)*"'
+_LABELS_RE = re.compile(r"^\{%s(?:,%s)*,?\}$" % (_LABEL_PAIR, _LABEL_PAIR))
 
 
 def validate_exposition(text):
     """Prometheus text-format validator. Checks, per the exposition spec:
     HELP then TYPE then samples for each family, each family declared once
     and contiguous, sample names belonging to the declared family
-    (histograms: only _bucket/_sum/_count), parseable values; histograms:
+    (histograms: only _bucket/_sum/_count), parseable values, label
+    values with only legal escapes (unescaped backslashes/quotes fail),
+    counter-typed families named `*_total`; histograms:
     `le` bounds strictly increasing, cumulative bucket counts
     non-decreasing, +Inf present and equal to _count. Returns
     (families {name: type}, samples {family: [(name, labels, value)]})."""
@@ -68,12 +74,21 @@ def validate_exposition(text):
                 f"TYPE {name} not immediately after its HELP"
             mtype = mtype.strip()
             assert mtype in ("gauge", "counter", "histogram"), mtype
+            if mtype == "counter":
+                # Prometheus counter naming convention: cumulative
+                # families end in _total; anything else confuses every
+                # downstream rate()/increase() consumer
+                assert name.endswith("_total"), \
+                    f"counter family {name} not named *_total"
             families[name] = mtype
             current, pending_help = name, None
         else:
             m = _SAMPLE_RE.match(line)
             assert m, f"unparseable sample line {line!r}"
             sname, labelstr, value = m.groups()
+            if labelstr:
+                assert _LABELS_RE.match(labelstr), \
+                    f"malformed/unescaped label block {labelstr!r}"
             assert current is not None, f"sample {sname} outside any family"
             if families[current] == "histogram":
                 assert (sname.startswith(current)
@@ -139,6 +154,31 @@ def test_validator_rejects_malformed_expositions():
         validate_exposition('# HELP h x\n# TYPE h histogram\n'
                             'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\n'
                             'h_sum 1\nh_count 1\n')
+    # hardening: unescaped quote/backslash in a label value is rejected
+    with pytest.raises(AssertionError, match="unescaped|unparseable"):
+        validate_exposition('# HELP a b\n# TYPE a gauge\n'
+                            'a{x="ba\\d"} 1\n')
+    # counter-style families must follow the *_total naming convention
+    with pytest.raises(AssertionError, match="_total"):
+        validate_exposition("# HELP c d\n# TYPE c counter\nc 1\n")
+    validate_exposition(  # escaped values and *_total counters pass
+        '# HELP a b\n# TYPE a gauge\na{x="q\\"uo\\\\te\\n"} 1\n'
+        "# HELP c_total d\n# TYPE c_total counter\nc_total 1\n")
+
+
+def test_render_path_escapes_label_values():
+    """Satellite: backslash, quote, and newline in label values are
+    escaped by BOTH render paths (hub + component gauges) — the combined
+    text stays validator-clean."""
+    from k8s_operator_libs_tpu.upgrade.metrics import render_prometheus
+    hub = MetricsHub()
+    hub.set_gauge("leader", 1.0, labels={"id": 'we"ird\\name\nx'})
+    text = hub.render()
+    assert '\\"ird' in text and "\\\\name" in text and "\\nx" in text
+    validate_exposition(text)
+    comp_text = render_prometheus('libt"pu\\v\n2', {"upgrades_done": 1})
+    validate_exposition(comp_text)
+    assert 'component="libt\\"pu\\\\v\\n2"' in comp_text
 
 
 def test_hub_render_passes_validator_and_help_registry():

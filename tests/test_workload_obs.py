@@ -233,7 +233,10 @@ def test_status_goodput_renders_summary_and_attribution(tmp_path, capsys):
                       "--goodput-node", "n0", "--json"],
                      client=cluster.client, now=clock.now())
     assert rc == 0
-    out = json.loads(capsys.readouterr().out)
+    envelope = json.loads(capsys.readouterr().out)
+    assert set(envelope) == {"kind", "data"}
+    assert envelope["kind"] == "goodput"
+    out = envelope["data"]
     assert out["summary"]["runs"] == 2
     assert out["summary"]["badput_s"]["drain_save"] == pytest.approx(3.0)
     reports = out["attribution"]["libtpu"]

@@ -39,6 +39,18 @@ wire values because obs sits below upgrade):
   (``outside`` / ``to_gate`` / ``gate_to_restart`` / ``after_restart``)
   — a typo'd segment would attribute time to a phase nothing reports.
 
+**OBS003** closes the SLO/alerting layer (``obs/slo.py`` /
+``obs/alerts.py``) over the shared metric catalog
+(``obs/metrics.py::HELP_TEXTS``):
+
+- every metric family a ``DEFAULT_SLO_SPECS`` objective watches must
+  have a HELP_TEXTS entry — a typo'd family silently evaluates to "no
+  data" forever;
+- every family in the literal ``SLO_GAUGE_FAMILIES`` /
+  ``ALERT_GAUGE_FAMILIES`` emitted-family tables must be registered;
+- every ``tpu_operator_slo_*`` / ``tpu_operator_alert_*`` HELP entry
+  must match an emitted family (no stale catalog entries).
+
 Proven on mutated copies of the real files by tests/test_lint_domain.py,
 like STM001.
 """
@@ -303,3 +315,161 @@ def run_attribution(root: Path) -> List[Finding]:
 
 register(Check(name="obs-attribution", codes=ATTRIBUTION_CODES,
                scope="project", run=run_attribution, domain=True))
+
+
+# ------------------------------------------------ OBS003 (SLO/alerting)
+
+SLO_CODES = {
+    "OBS003": "SLO/alerting metric drift: an SLO spec references an "
+              "unregistered metric family, an emitted slo/alert gauge "
+              "family has no HELP_TEXTS entry, or a tpu_operator_slo_*/"
+              "tpu_operator_alert_* HELP entry matches no emitted family",
+}
+
+SLO_PATH = "k8s_operator_libs_tpu/obs/slo.py"
+ALERTS_PATH = "k8s_operator_libs_tpu/obs/alerts.py"
+METRICS_PATH = "k8s_operator_libs_tpu/obs/metrics.py"
+# HELP entries under these prefixes must correspond to families the
+# engine/alert manager actually emits (no stale catalog entries)
+SLO_FAMILY_PREFIXES = ("tpu_operator_slo_", "tpu_operator_alert_")
+
+
+def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
+    """Literal string keys of HELP_TEXTS → ({key: lineno}, table lineno;
+    0 when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == "HELP_TEXTS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}, node.lineno
+        keys: Dict[str, int] = {}
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                keys[key.value] = key.lineno
+        return keys, node.lineno
+    return {}, 0
+
+
+def _string_tuple(tree: ast.Module, name: str
+                  ) -> Tuple[Dict[str, int], int]:
+    """Literal string elements of a module-level tuple/list assignment →
+    ({value: lineno}, assignment lineno; 0 when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == name):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return {}, node.lineno
+        out: Dict[str, int] = {}
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out[elt.value] = elt.lineno
+        return out, node.lineno
+    return {}, 0
+
+
+def _default_spec_metrics(tree: ast.Module
+                          ) -> Tuple[List[Tuple[str, str, int]], int]:
+    """(slo name, metric family, lineno) triples from the literal
+    DEFAULT_SLO_SPECS table; table lineno (0 when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "DEFAULT_SLO_SPECS"):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return [], node.lineno
+        out: List[Tuple[str, str, int]] = []
+        for elt in node.value.elts:
+            if not isinstance(elt, ast.Dict):
+                continue
+            entry: Dict[str, Tuple[str, int]] = {}
+            for key, value in zip(elt.keys, elt.values):
+                if (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                        and isinstance(value, ast.Constant)
+                        and isinstance(value.value, str)):
+                    entry[key.value] = (value.value, value.lineno)
+            if "metric" in entry:
+                metric, lineno = entry["metric"]
+                name = entry.get("name", ("?", lineno))[0]
+                out.append((name, metric, lineno))
+        return out, node.lineno
+    return [], 0
+
+
+def run_slo(root: Path) -> List[Finding]:
+    root = Path(root)
+    findings: List[Finding] = []
+
+    help_keys, help_line = _help_text_keys(_parse(root, METRICS_PATH))
+    if help_line == 0:
+        return [(METRICS_PATH, 1, "OBS003",
+                 "HELP_TEXTS table not found (parse drift?)")]
+    specs, specs_line = _default_spec_metrics(_parse(root, SLO_PATH))
+    if specs_line == 0:
+        return [(SLO_PATH, 1, "OBS003",
+                 "DEFAULT_SLO_SPECS table not found (parse drift?)")]
+    slo_fams, slo_fams_line = _string_tuple(
+        _parse(root, SLO_PATH), "SLO_GAUGE_FAMILIES")
+    alert_fams, alert_fams_line = _string_tuple(
+        _parse(root, ALERTS_PATH), "ALERT_GAUGE_FAMILIES")
+    if slo_fams_line == 0:
+        return [(SLO_PATH, 1, "OBS003",
+                 "SLO_GAUGE_FAMILIES table not found (parse drift?)")]
+    if alert_fams_line == 0:
+        return [(ALERTS_PATH, 1, "OBS003",
+                 "ALERT_GAUGE_FAMILIES table not found (parse drift?)")]
+
+    # direction 1: every metric an SLO spec watches must be a registered
+    # family — a typo'd family silently evaluates to "no data" forever
+    for name, metric, lineno in specs:
+        if metric not in help_keys:
+            findings.append(
+                (SLO_PATH, lineno, "OBS003",
+                 f"SLO {name!r} references metric family {metric!r} with "
+                 f"no HELP_TEXTS entry ({METRICS_PATH}) — unregistered "
+                 f"families never appear in any exposition"))
+
+    # direction 1b: every family the engine/alert manager emits must be
+    # registered, or its HELP falls back to underscores-to-spaces
+    emitted = {**{f: (SLO_PATH, ln) for f, ln in slo_fams.items()},
+               **{f: (ALERTS_PATH, ln) for f, ln in alert_fams.items()}}
+    for family, (rel, lineno) in sorted(emitted.items()):
+        if family not in help_keys:
+            findings.append(
+                (rel, lineno, "OBS003",
+                 f"emitted gauge family {family!r} has no HELP_TEXTS "
+                 f"entry ({METRICS_PATH})"))
+
+    # direction 2: no stale catalog entries — a tpu_operator_slo_* /
+    # tpu_operator_alert_* HELP entry whose family nothing emits is a
+    # renamed/removed gauge seen from the registry side
+    for key, lineno in sorted(help_keys.items()):
+        if key.startswith(SLO_FAMILY_PREFIXES) and key not in emitted:
+            findings.append(
+                (METRICS_PATH, lineno, "OBS003",
+                 f"HELP_TEXTS entry {key!r} matches no emitted family in "
+                 f"SLO_GAUGE_FAMILIES ({SLO_PATH}) or ALERT_GAUGE_FAMILIES "
+                 f"({ALERTS_PATH}) (renamed or removed gauge?)"))
+    return findings
+
+
+register(Check(name="obs-slo", codes=SLO_CODES, scope="project",
+               run=run_slo, domain=True))
