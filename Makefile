@@ -13,7 +13,7 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
   test-obs-slo health-sim lint lint-domain cov-report cov-artifact bench \
-  dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+  bench-decode dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -66,6 +66,10 @@ cov-artifact:  ## full-suite run that REFRESHES the committed cov.json
 
 bench:
 	$(PYTHON) bench.py
+
+bench-decode:  ## decode-path smoke (tiny config, CPU interpret mode): the fused paged kernel is SELECTED on the hot path and matches the gather reference (bf16 + int8, ragged, dead blocks), and the speculative batcher stays token-exact (docs/serving-performance.md)
+	$(PYTHON) -m pytest tests/test_paged_fused.py -q
+	$(PYTHON) -m pytest tests/test_serve.py -q -k spec
 
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) -c \

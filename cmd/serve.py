@@ -80,7 +80,8 @@ class ServingRuntime:
     """Batcher + stepper thread + completion events."""
 
     def __init__(self, params, cfg, max_slots, capacity, block_size,
-                 chunk, shared_prefix=None, hub=None, tracer=None):
+                 chunk, shared_prefix=None, hub=None, tracer=None,
+                 draft=None, spec_k=4):
         from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
         from k8s_operator_libs_tpu.obs import MetricsHub
         self.hub = hub if hub is not None else MetricsHub()
@@ -88,7 +89,8 @@ class ServingRuntime:
                                      capacity_per_slot=capacity,
                                      block_size=block_size,
                                      shared_prefix=shared_prefix,
-                                     metrics=self.hub, tracer=tracer)
+                                     metrics=self.hub, tracer=tracer,
+                                     draft=draft, spec_k=spec_k)
         self.chunk = chunk
         self.lock = threading.Lock()
         self.results = {}
@@ -317,6 +319,14 @@ def main(argv=None):
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--chunk", type=int, default=4,
                     help="decode ticks per device call (serve.step(n))")
+    ap.add_argument("--speculative", default="off",
+                    choices=("off", "self-int8"),
+                    help="speculative decoding: self-int8 drafts with the "
+                         "target's own int8-quantized weights (no second "
+                         "model; docs/serving-performance.md)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculation depth: draft tokens proposed per "
+                         "verify round")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--grace", type=float, default=30.0,
                     help="termination grace period (s): the SIGTERM drain "
@@ -337,9 +347,10 @@ def main(argv=None):
     tracer = Tracer(sink=JsonlSink(args.trace_log)) if args.trace_log \
         else None
     params, cfg = build_params(args)
+    draft = "self-int8" if args.speculative == "self-int8" else None
     rt = ServingRuntime(params, cfg, args.max_slots, args.capacity,
                         args.block_size, args.chunk, hub=hub,
-                        tracer=tracer)
+                        tracer=tracer, draft=draft, spec_k=args.spec_k)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(rt))
 
     def on_term(signum, frame):
@@ -348,8 +359,9 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
-    logger.info("tpu-serve on :%d (%s, %d slots, chunk %d)", args.port,
-                args.model, args.max_slots, args.chunk)
+    logger.info("tpu-serve on :%d (%s, %d slots, chunk %d, speculative "
+                "%s)", args.port, args.model, args.max_slots, args.chunk,
+                args.speculative)
     httpd.serve_forever()
     rt.stop()
     logger.info("drained; exiting")

@@ -103,6 +103,35 @@ def quantized_size_bytes(params: Params) -> int:
                for p in jax.tree_util.tree_leaves(params))
 
 
+def stream_bytes(params: Params) -> int:
+    """Bytes of weights a decode step actually STREAMS: every leaf except
+    the embedding table, whose per-token row gather reads B rows, not the
+    table (the same exclusion bench.py applies to both the roofline
+    denominator and the stream-probe numerator). Works on float and
+    quantized trees alike."""
+    return quantized_size_bytes(params) - int(
+        params["embed"].size * params["embed"].dtype.itemsize)
+
+
+def expected_speedup(params: Params, qparams: Params,
+                     kv_bytes_per_seq: float = 0.0,
+                     batch: int = 1) -> float:
+    """The bytes-per-token ratio bf16/int8 — the physics ceiling for the
+    int8-vs-bf16 decode tokens/s ratio when both paths are
+    bandwidth-bound with equal non-streaming overheads:
+
+        ratio = (stream_bytes(f) + B·kv) / (stream_bytes(q) + B·kv)
+
+    The KV term is identical on both sides (weight-only quantization),
+    so growing B·kv pulls the ratio toward 1 — which is why the int8 win
+    must be judged at the weight-dominated serving shape. bench.py
+    asserts the MEASURED ratio stays within tolerance of this number
+    (the r05 regression class: int8 shipping slower per byte than bf16
+    — 27.9% vs 37.8% of roofline — without anything failing)."""
+    kv = float(batch) * float(kv_bytes_per_seq)
+    return (stream_bytes(params) + kv) / (stream_bytes(qparams) + kv)
+
+
 def _forward_quant(params: Params, tokens: jax.Array, cache: KVCache,
                    cfg: LlamaConfig) -> Tuple[jax.Array, KVCache]:
     """generate._forward_cached with _qmat hooked in for every quantized
@@ -112,6 +141,46 @@ def _forward_quant(params: Params, tokens: jax.Array, cache: KVCache,
         params, tokens, cache, cfg,
         matmul=lambda x, layer, name: _qmat(x, layer[name]),
         lm_head_fn=lambda x, p: _qmat(x, p["lm_head"]))
+
+
+def _forward_paged_quant(params: Params, tokens: jax.Array, cache,
+                         cfg: LlamaConfig):
+    """paged._forward_paged with _qmat hooked in for every quantized
+    matmul: int8 weights stream at half the bytes, the int8→compute
+    convert fuses into each dot's operand read, and the layer-ahead
+    weight prefetch inside _forward_paged's scan prefetches the HALVED
+    tree — the paged+int8 serving configuration's forward pass."""
+    from .paged import _forward_paged
+    return _forward_paged(
+        params, tokens, cache, cfg,
+        matmul=lambda x, layer, name: _qmat(x, layer[name]),
+        lm_head_fn=lambda x, p: _qmat(x, p["lm_head"]))
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "max_new_tokens", "temperature",
+                          "block_size", "top_k", "top_p", "kv_int8"))
+def paged_quantized_generate(params: Params, prompt: jax.Array,
+                             cfg: LlamaConfig, max_new_tokens: int = 32,
+                             temperature: float = 0.0,
+                             rng: Optional[jax.Array] = None,
+                             prompt_lengths: Optional[jax.Array] = None,
+                             block_size: int = None,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None,
+                             kv_int8: bool = False) -> jax.Array:
+    """Greedy/sampled decode over the paged cache with int8 WEIGHTS
+    (quantize_params tree) — compose with ``kv_int8=True`` for the full
+    paged+int8 serving configuration: half the weight bytes AND half the
+    KV bytes per token, the shape bench.py's
+    ``decode_760m_paged_int8_*`` keys measure. Same loop/rng protocol
+    as paged.paged_generate."""
+    from .paged import DEFAULT_BLOCK_SIZE, _paged_generate_impl
+    return _paged_generate_impl(
+        _forward_paged_quant, params, prompt, cfg, max_new_tokens,
+        temperature, rng, prompt_lengths,
+        block_size if block_size is not None else DEFAULT_BLOCK_SIZE,
+        top_k, top_p, kv_int8)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",

@@ -93,7 +93,8 @@ class ContinuousBatcher:
                  capacity_per_slot: int = 512,
                  block_size: int = DEFAULT_BLOCK_SIZE,
                  shared_prefix=None, forward=None,
-                 metrics=None, tracer=None, clock=None):
+                 metrics=None, tracer=None, clock=None,
+                 draft=None, spec_k: int = 4):
         """``forward`` overrides the paged forward pass — signature
         ``(params, tokens, cache, cfg) -> (logits, cache)``, default
         :func:`~.paged._forward_paged`. The MoE family rides this hook
@@ -116,11 +117,36 @@ class ContinuousBatcher:
         ``metrics`` (an ``obs.MetricsHub``, duck-typed) turns the batcher
         into its own telemetry source: TTFT, queue-wait, inter-token and
         step-duration histograms plus slot-occupancy / KV-page-
-        utilization samples per step and the live slot/queue gauges.
-        ``tracer`` (``obs.Tracer``) emits one ``serve-step`` span per
-        :meth:`step` call. ``clock`` injects time for both (default
-        monotonic wall clock); all three default to off/real and add no
-        overhead when unset."""
+        utilization samples per step and the live slot/queue gauges —
+        and, per decode call, the effective weight-stream GB/s gauge
+        (the production twin of bench.py's stream probe). ``tracer``
+        (``obs.Tracer``) emits one ``serve-step`` span per :meth:`step`
+        call. ``clock`` injects time for both (default monotonic wall
+        clock); all three default to off/real and add no overhead when
+        unset.
+
+        ``draft`` turns on SPECULATIVE decoding (Leviathan et al.,
+        greedy variant — see models/speculative.py): each :meth:`step`
+        runs one fused draft-propose + target-verify round instead of
+        one-token ticks, so every device call advances each slot by
+        1..spec_k+1 confirmed tokens. Because the paged cache keeps
+        per-sequence lengths, acceptance is PER SLOT (no batch-minimum
+        sync like the contiguous-cache speculative_generate) and a
+        rejection is just that slot's length rewind. Outputs are
+        token-identical to the non-speculative batcher for ANY draft —
+        the target's verify pass is authoritative, a 0%-acceptance
+        draft only loses the speedup. Accepted values:
+
+        - ``"self-int8"`` — quantized SELF-draft: the target's own
+          weights in int8 propose (no second model; ~half the draft
+          weight stream);
+        - ``(draft_params, draft_cfg, draft_forward)`` — an explicit
+          draft model; ``draft_forward`` defaults to the paged forward
+          when None. The draft keeps its OWN block pools behind the
+          same table/lengths, so admission/retirement stay untouched.
+
+        Acceptance flows into the ``spec_accept_ratio`` histogram and
+        TTFT/inter-token SLOs pick the speedup up for free."""
         self.params = params
         self.cfg = cfg
         self._forward = forward or _forward_paged
@@ -145,6 +171,41 @@ class ContinuousBatcher:
         shape = (L, n_blocks, block_size, KV, Dh)
         self._k = jnp.zeros(shape, cfg.dtype)
         self._v = jnp.zeros(shape, cfg.dtype)
+
+        # speculative draft mode (see docstring): the draft keeps its OWN
+        # block pools behind the SAME table/lengths, so slot admission,
+        # retirement and block recycling stay one code path
+        self._spec = None
+        if draft is not None:
+            if spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if draft == "self-int8":
+                from .quant import _forward_paged_quant, quantize_params
+                dparams, dcfg, dfwd = (quantize_params(params), cfg,
+                                       _forward_paged_quant)
+            else:
+                dparams, dcfg, dfwd = draft
+                dfwd = dfwd or _forward_paged
+            dshape = (dcfg.n_layers, n_blocks, block_size,
+                      dcfg.n_kv_heads, dcfg.head_dim)
+            self._dk = jnp.zeros(dshape, dcfg.dtype)
+            self._dv = jnp.zeros(dshape, dcfg.dtype)
+            self._spec = {"params": dparams, "cfg": dcfg, "fwd": dfwd,
+                          "k": int(spec_k)}
+            self._spec_fn = None
+            self._dprefill_cache: Dict[int, Any] = {}
+
+        # weight-stream gauge basis: bytes the fused decode streams per
+        # tick (embedding excluded — a per-token row gather), same
+        # exclusion as bench.py's roofline/stream probe
+        self._stream_bytes = self._draft_stream_bytes = 0
+        if isinstance(params, dict) and "embed" in params:
+            from .quant import stream_bytes
+            self._stream_bytes = stream_bytes(params)
+            if (self._spec is not None
+                    and isinstance(self._spec["params"], dict)
+                    and "embed" in self._spec["params"]):
+                self._draft_stream_bytes = stream_bytes(self._spec["params"])
         # host-side mirrors: tables/lengths upload with each device call.
         # Row layout: [prefix blocks 0..n_pb) | private slots, scratch
         # when free] — position p maps to row index p // block_size, so
@@ -199,6 +260,19 @@ class ContinuousBatcher:
 
         self._k, self._v = prefix_fill(self.params, self._k, self._v,
                                        jnp.asarray(tokens))
+        if self._spec is not None:
+            dcfg, dfwd = self._spec["cfg"], self._spec["fwd"]
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def dprefix_fill(params, k, v, prompt):
+                cache = PagedKVCache(k=k, v=v, table=table,
+                                     lengths=jnp.zeros((1,), jnp.int32))
+                _, cache = dfwd(params, prompt[None], cache, dcfg)
+                return cache.k, cache.v
+
+            self._dk, self._dv = dprefix_fill(self._spec["params"],
+                                              self._dk, self._dv,
+                                              jnp.asarray(tokens))
 
     # ------------------------------------------------------------ compiled
 
@@ -229,6 +303,70 @@ class ContinuousBatcher:
 
         self._decode_cache[n] = decode
         return decode
+
+    def _build_spec(self):
+        """One compiled speculative ROUND over every slot: k+1 draft
+        self-steps (the extra step writes the last proposal's draft-cache
+        row for the full-accept case — its own proposal is discarded,
+        mirroring speculative_generate), one (k+1)-wide target verify
+        forward, per-slot greedy acceptance (models/speculative.py
+        accept_counts — per-sequence lengths make the rewind per slot,
+        no batch-minimum sync). Returns the new pools, the emitted slab
+        [slots, k+1] (each slot's accepted drafts then the target's
+        correction at its acceptance index) and the counts [slots]."""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        cfg, fwd = self.cfg, self._forward
+        dcfg, dfwd, kk = (self._spec["cfg"], self._spec["fwd"],
+                          self._spec["k"])
+        from .speculative import accept_counts
+
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def spec_round(params, dparams, k, v, dk, dv, table, lengths,
+                       toks):
+            def draft_body(carry, _):
+                dkp, dvp, lens, tok = carry
+                cache = PagedKVCache(k=dkp, v=dvp, table=table,
+                                     lengths=lens)
+                logits, cache = dfwd(dparams, tok[:, None], cache, dcfg)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (cache.k, cache.v, cache.lengths, nxt), nxt
+
+            (dk, dv, _, _), props = jax.lax.scan(
+                draft_body, (dk, dv, lengths, toks), None, length=kk + 1)
+            drafts = jnp.moveaxis(props, 0, 1)[:, :kk]          # [S, k]
+            window = jnp.concatenate([toks[:, None], drafts], axis=1)
+            cache = PagedKVCache(k=k, v=v, table=table, lengths=lengths)
+            v_logits, cache = fwd(params, window, cache, cfg)
+            # greedy[:, i] is the target's pick AFTER window[:, :i+1]
+            greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+            acc = accept_counts(drafts == greedy[:, :kk])       # [S]
+            idx = jnp.arange(kk + 1, dtype=jnp.int32)
+            corr = jnp.take_along_axis(greedy, acc[:, None], axis=1)
+            slab = jnp.where(idx[None, :] < acc[:, None],
+                             jnp.pad(drafts, ((0, 0), (0, 1))), corr)
+            return cache.k, cache.v, dk, dv, slab, acc
+
+        self._spec_fn = spec_round
+        return spec_round
+
+    def _prefill_draft_fn(self, bucket: int):
+        """Draft twin of :meth:`_prefill_fn`: writes the request's prompt
+        rows into the draft pools (same table row, same positions); the
+        logits are discarded — the first speculative round starts from
+        the TARGET prefill's next token."""
+        if bucket not in self._dprefill_cache:
+            dcfg, dfwd = self._spec["cfg"], self._spec["fwd"]
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def dprefill(params, k, v, table, prompt, start):
+                cache = PagedKVCache(k=k, v=v, table=table[None],
+                                     lengths=start[None])
+                _, cache = dfwd(params, prompt[None], cache, dcfg)
+                return cache.k, cache.v
+
+            self._dprefill_cache[bucket] = dprefill
+        return self._dprefill_cache[bucket]
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_cache:
@@ -327,7 +465,12 @@ class ContinuousBatcher:
         next occupant's prefill overwrites in-order. Admission happens
         only at chunk boundaries, so large n trades admission latency
         for round-trip savings; per-request OUTPUTS are identical to the
-        n=1 loop (pinned in tests)."""
+        n=1 loop (pinned in tests).
+
+        In draft mode each call runs ONE speculative round instead
+        (``n`` is accepted but does not multiply rounds — the round
+        already advances every slot up to spec_k+1 tokens per device
+        call); outputs stay identical to the non-speculative loop."""
         if n < 1:
             raise ValueError("step(n) needs n >= 1")
         if self._tracer is not None:
@@ -374,6 +517,15 @@ class ContinuousBatcher:
         # is >= 1.
         cap = min(self._slot_limit - int(self._lengths[r.slot])
                   for r in self._running.values())
+        if self._spec is not None and cap >= self._spec["k"] + 1:
+            self._step_spec_round(span, t0)
+            return
+        # (spec mode falls through here only when a slot is within k
+        # rows of its capacity: the (k+1)-wide verify window no longer
+        # fits, so the step degrades to plain ticks. The draft cache
+        # misses those rows — that slot's acceptance decays until the
+        # slot turns over — but outputs never change: the target is
+        # authoritative either way.)
         if n > cap:
             n = max((c for c in self._decode_cache if c <= cap),
                     default=1)
@@ -390,6 +542,11 @@ class ContinuousBatcher:
             # so this is honest decode time; / n = inter-token latency
             decode_s = max(0.0, self._clock.now() - t_dev)
             self._metrics.observe("serve_inter_token_seconds", decode_s / n)
+            if self._stream_bytes:
+                self._metrics.set_gauge(
+                    "weight_stream_gbs",
+                    round(self._stream_bytes * n
+                          / max(decode_s, 1e-9) / 1e9, 3))
         finished = []
         for rid, req in self._running.items():
             s = req.slot
@@ -404,6 +561,66 @@ class ContinuousBatcher:
                     break
             else:
                 self._last_tok[s] = toks[n - 1, s]
+        for rid in finished:
+            self._retire(self._running.pop(rid))
+        if self._metrics is not None:
+            self._metrics.observe("serve_step_duration_seconds",
+                                  max(0.0, self._clock.now() - t0))
+            self._refresh_gauges()
+
+    def _step_spec_round(self, span, t0) -> None:
+        """One speculative round: a single device call advances every
+        running slot by 1..k+1 confirmed tokens. Per slot, the tokens
+        appended this round are the pending last token plus that slot's
+        accepted drafts; the target's correction becomes the new pending
+        token. The device wrote k+1 KV rows past each slot's length —
+        the host advances lengths only over the confirmed ones, so the
+        rejected rows sit past ``lengths``, masked off and overwritten
+        by the next round (the paged twin of speculative_generate's
+        cache-length rewind, but PER SLOT)."""
+        kk = self._spec["k"]
+        if span is not None:
+            span.set("spec_k", kk)
+        t_dev = self._clock.now()
+        k, v, dk, dv, slab, acc = self._build_spec()(
+            self.params, self._spec["params"], self._k, self._v,
+            self._dk, self._dv, jnp.asarray(self._table),
+            jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
+        self._k, self._v = k, v
+        self._dk, self._dv = dk, dv
+        slab = np.asarray(slab)              # [slots, k+1]
+        acc = np.asarray(acc)                # [slots]
+        decode_s = max(0.0, self._clock.now() - t_dev)
+        finished = []
+        emitted = 0
+        for rid, req in self._running.items():
+            s = req.slot
+            a = int(acc[s])
+            if self._metrics is not None:
+                self._metrics.observe("spec_accept_ratio", a / kk,
+                                      buckets=_RATIO_BUCKETS)
+            round_toks = ([int(self._last_tok[s])]
+                          + [int(t) for t in slab[s, :a]])
+            for tok in round_toks:
+                req.generated.append(tok)
+                self._lengths[s] += 1
+                emitted += 1
+                if len(req.generated) >= req.max_new:
+                    finished.append(rid)
+                    break
+            else:
+                self._last_tok[s] = int(slab[s, a])
+        if self._metrics is not None and self._running:
+            per_slot = emitted / len(self._running)
+            self._metrics.observe("serve_inter_token_seconds",
+                                  decode_s / max(per_slot, 1.0))
+            if self._stream_bytes:
+                # one target verify stream + k+1 draft streams per round
+                bytes_round = (self._stream_bytes
+                               + (kk + 1) * self._draft_stream_bytes)
+                self._metrics.set_gauge(
+                    "weight_stream_gbs",
+                    round(bytes_round / max(decode_s, 1e-9) / 1e9, 3))
         for rid in finished:
             self._retire(self._running.pop(rid))
         if self._metrics is not None:
@@ -452,6 +669,11 @@ class ContinuousBatcher:
             jnp.asarray(Tp, jnp.int32),
             jnp.asarray(self._prefix_aligned, jnp.int32))
         self._k, self._v = k, v
+        if self._spec is not None:
+            self._dk, self._dv = self._prefill_draft_fn(bucket)(
+                self._spec["params"], self._dk, self._dv,
+                jnp.asarray(self._table[slot]), jnp.asarray(padded),
+                jnp.asarray(self._prefix_aligned, jnp.int32))
         # padding rows were written past Tp — rewind, decode overwrites
         self._lengths[slot] = self._prefix_aligned + Tp
         self._last_tok[slot] = int(nxt)

@@ -73,6 +73,15 @@ from .llama import LlamaConfig
 Params = Dict[str, Any]
 
 
+def accept_counts(match: jax.Array) -> jax.Array:
+    """Leading-True run length per row of a [B, k] accept/match matrix —
+    how many draft tokens are confirmed before the first rejection.
+    Shared by :func:`speculative_generate` and the continuous batcher's
+    draft mode (models/serve.py), whose paged per-sequence lengths let
+    it apply the count PER SLOT instead of batch-synchronized."""
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+
+
 @partial(jax.jit, static_argnames=("target_cfg", "draft_cfg",
                                    "max_new_tokens", "k", "draft_forward",
                                    "temperature", "top_k", "top_p"))
@@ -179,8 +188,7 @@ def speculative_generate(target_params: Params, draft_params: Params,
             greedy = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
             # greedy[:, i] is the target's pick AFTER window[:, :i+1]
             match = drafts == greedy[:, :k]                        # [B,k]
-        acc_per_seq = jnp.sum(jnp.cumprod(match.astype(jnp.int32),
-                                          axis=1), axis=1)         # [B]
+        acc_per_seq = accept_counts(match)                         # [B]
         a = jnp.min(acc_per_seq)        # batch-synchronized acceptance
         a = jnp.minimum(a, jnp.int32(k))
 

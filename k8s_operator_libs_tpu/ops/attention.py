@@ -720,6 +720,197 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return reference_attention(q, k, v, causal)
 
 
+# ------------------------------------------------- paged decode (serving)
+
+# DMA slots in the paged-decode block pipeline: slot i holds block mb with
+# mb ≡ i (mod DEPTH), so DEPTH-1 block fetches stay in flight while the
+# online softmax consumes the current one. 4 slots keep VMEM at
+# O(4·block_size) — independent of sequence capacity, unlike the r5
+# kernel's full [cap, KV, Dh] staging buffer — while covering the ~µs
+# per-DMA latency that a 2-slot pipeline exposes on 8-32 KB blocks.
+PAGED_PIPELINE_DEPTH = 4
+
+
+def paged_decode_kernel(table_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref,
+                        k_buf, v_buf, sem, *, block_size: int, n_kv: int):
+    """One sequence's single-token paged attention, fully fused: walk the
+    block table with a DEPTH-slot double-buffered DMA pipeline and fold
+    each arriving block straight into an ONLINE softmax (flash-style
+    running max/denominator/accumulator in fori-loop carries) — the DMA
+    for block mb+DEPTH-1 is issued before block mb's score/prob math, so
+    the fetch latency rides under the compute instead of serializing
+    with it. Nothing full-capacity is ever resident: VMEM is
+    O(DEPTH·block_size), so the kernel has no upper capacity bound (the
+    r5 design staged all live blocks into one [cap, KV, Dh] buffer,
+    waited for every copy, then attended — paying an idle DMA phase and
+    an 8 MB VMEM ceiling). Dead blocks are never fetched (the walk stops
+    at n_live), so there is no dead-block zeroing pass; masked tail rows
+    inside the last live block underflow to exactly 0 in the exp.
+
+    GQA is grouped (cache never repeated): per K/V head the G query
+    heads score one [G, block] tile; the online stats are kept for all
+    H rows at once.
+
+    Grid (B,); scalar-prefetched table [B, MB] / lengths [B]; q/o blocks
+    [1, H, Dh]; k/v pools [NB, BS, KV, Dh] unblocked (memory_space=ANY);
+    scratch: [DEPTH, BS, KV, Dh] per pool + a [DEPTH] DMA semaphore
+    array (one per slot — both the K and V copy for a slot signal it)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    H, Dh = q_ref.shape[1], q_ref.shape[2]
+    G = H // n_kv
+    depth = k_buf.shape[0]
+    scale = 1.0 / math.sqrt(Dh)
+    q_pos = len_ref[b]                       # decode position = cache len
+    n_live = q_pos // block_size + 1         # blocks with visible keys
+
+    def copies(mb):
+        slot = jax.lax.rem(mb, depth)
+        idx = table_ref[b, mb]
+        return (pltpu.make_async_copy(kp_ref.at[idx], k_buf.at[slot],
+                                      sem.at[slot]),
+                pltpu.make_async_copy(vp_ref.at[idx], v_buf.at[slot],
+                                      sem.at[slot]))
+
+    def start(mb, _):
+        ck, cv = copies(mb)
+        ck.start()
+        cv.start()
+        return 0
+
+    # warm-up: fill the pipeline DEPTH-1 deep
+    jax.lax.fori_loop(0, jnp.minimum(n_live, depth - 1), start, 0)
+
+    def body(mb, carry):
+        m, l, acc = carry
+
+        @pl.when(mb + depth - 1 < n_live)
+        def _prefetch():
+            start(mb + depth - 1, 0)
+
+        ck, cv = copies(mb)
+        ck.wait()
+        cv.wait()
+        slot = jax.lax.rem(mb, depth)
+        k_pos = mb * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        valid = k_pos <= q_pos                               # [1, BS]
+        s_parts = []
+        for kv in range(n_kv):                # static loop, KV is small
+            q_kv = q_ref[0, kv * G:(kv + 1) * G, :]          # [G, Dh]
+            s_parts.append(jax.lax.dot_general(
+                q_kv, k_buf[slot][:, kv, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = jnp.concatenate(s_parts, axis=0) * scale         # [H, BS]
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [H, BS]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv_parts = []
+        for kv in range(n_kv):
+            pv_parts.append(jax.lax.dot_general(
+                p[kv * G:(kv + 1) * G].astype(v_buf.dtype),
+                v_buf[slot][:, kv, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_new = acc * alpha + jnp.concatenate(pv_parts, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, Dh), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_kernel_q(table_ref, len_ref, q_ref, kp_ref, vp_ref,
+                          ksp_ref, vsp_ref, o_ref, k_buf, v_buf, ks_buf,
+                          vs_buf, sem, *, block_size: int, n_kv: int):
+    """int8 twin of :func:`paged_decode_kernel`: the pools hold per-row
+    symmetric int8 and [NB, BS, KV] fp32 scales. Each pipeline slot DMAs
+    HALF the K/V bytes (plus 1/Dh of scales) and the dequant happens
+    IN-REGISTER inside the online-softmax step — the int8 block converts
+    to the compute dtype as the dot's operand and the row scales fold
+    into the score/probability COLUMNS ([1, block] multiplies), so no
+    dequantized copy of any block ever exists in VMEM or HBM."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    H, Dh = q_ref.shape[1], q_ref.shape[2]
+    G = H // n_kv
+    depth = k_buf.shape[0]
+    scale = 1.0 / math.sqrt(Dh)
+    q_pos = len_ref[b]
+    n_live = q_pos // block_size + 1
+
+    def copies(mb):
+        slot = jax.lax.rem(mb, depth)
+        idx = table_ref[b, mb]
+        return (pltpu.make_async_copy(kp_ref.at[idx], k_buf.at[slot],
+                                      sem.at[slot]),
+                pltpu.make_async_copy(vp_ref.at[idx], v_buf.at[slot],
+                                      sem.at[slot]),
+                pltpu.make_async_copy(ksp_ref.at[idx], ks_buf.at[slot],
+                                      sem.at[slot]),
+                pltpu.make_async_copy(vsp_ref.at[idx], vs_buf.at[slot],
+                                      sem.at[slot]))
+
+    def start(mb, _):
+        for c in copies(mb):
+            c.start()
+        return 0
+
+    jax.lax.fori_loop(0, jnp.minimum(n_live, depth - 1), start, 0)
+
+    def body(mb, carry):
+        m, l, acc = carry
+
+        @pl.when(mb + depth - 1 < n_live)
+        def _prefetch():
+            start(mb + depth - 1, 0)
+
+        for c in copies(mb):
+            c.wait()
+        slot = jax.lax.rem(mb, depth)
+        dtype = q_ref.dtype
+        k_pos = mb * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        valid = k_pos <= q_pos
+        s_parts, vs_cols = [], []
+        for kv in range(n_kv):
+            q_kv = q_ref[0, kv * G:(kv + 1) * G, :]              # [G, Dh]
+            k_bf = k_buf[slot][:, kv, :].astype(dtype)           # [BS, Dh]
+            ks_col = jnp.swapaxes(ks_buf[slot][:, kv:kv + 1], 0, 1)
+            vs_cols.append(jnp.swapaxes(vs_buf[slot][:, kv:kv + 1], 0, 1))
+            s_parts.append(jax.lax.dot_general(
+                q_kv, k_bf, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * ks_col)
+        s = jnp.concatenate(s_parts, axis=0) * scale             # [H, BS]
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv_parts = []
+        for kv in range(n_kv):
+            w = (p[kv * G:(kv + 1) * G] * vs_cols[kv]).astype(dtype)
+            v_bf = v_buf[slot][:, kv, :].astype(dtype)
+            pv_parts.append(jax.lax.dot_general(
+                w, v_bf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_new = acc * alpha + jnp.concatenate(pv_parts, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, Dh), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
 def reference_attention_with_lse(q, k, v, causal: bool = True):
     """reference_attention that also returns the per-row logsumexp of the
     scaled scores — the residual chunk-merging needs (ring attention)."""

@@ -681,3 +681,123 @@ def test_sampled_speculative_temp0_is_existing_greedy():
                                 max_new_tokens=7, k=3, temperature=0.0)
     ref = generate(t_params, prompt, cfg_t, max_new_tokens=7)
     np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+
+# --- r6 adversarial drafts: stub draft forwards ignore params/cache
+# content and return crafted logits while advancing the cache length
+# (module-level: draft_forward is a static jit argname, so the hook
+# must be hashable across calls).
+
+def _tied_uniform_draft(params, tokens, cache, cfg):
+    """EXACTLY tied (all-zero) logits — the filter_logits tie pin
+    (PR 1: value-threshold with strict <) means top_k keeps the WHOLE
+    vocab, so the draft proposes uniformly over V no matter what top_k
+    the caller composed."""
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.models.generate import KVCache
+    B, T = tokens.shape
+    logits = jnp.zeros((B, T, cfg.vocab_size), jnp.float32)
+    return logits, KVCache(k=cache.k, v=cache.v,
+                           length=cache.length + T)
+
+
+def _point_mass_draft(params, tokens, cache, cfg):
+    """Near-one-hot mass on token 0 (the rest exactly tied): proposals
+    are almost always token 0, whose filtered target probability is
+    ~0 — every round exercises the reject-then-residual path, and the
+    residual max(p_t - p_d, 0) zeroes exactly the proposal column."""
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.models.generate import KVCache
+    B, T = tokens.shape
+    logits = jnp.zeros((B, T, cfg.vocab_size), jnp.float32)
+    logits = logits.at[..., 0].set(20.0)
+    return logits, KVCache(k=cache.k, v=cache.v,
+                           length=cache.length + T)
+
+
+def _argmin_draft(params, tokens, cache, cfg):
+    """The target's own forward with NEGATED logits: greedy proposals
+    are the target's argmin, so acceptance is structurally 0% (fp32,
+    generically untied logits) — every round is reject-at-0."""
+    from k8s_operator_libs_tpu.models.generate import _forward_cached
+    logits, cache = _forward_cached(params, tokens, cache, cfg)
+    return -logits, cache
+
+
+def test_speculative_zero_acceptance_draft_is_token_exact():
+    """A 0%-acceptance draft (argmin of the target) must still produce
+    the target's exact greedy tokens — the draft only costs speed. Each
+    round degrades to emitting exactly one corrected token, i.e. the
+    non-speculative path in k+1-sized steps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    from k8s_operator_libs_tpu.models.speculative import speculative_generate
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    ref = generate(params, prompt, cfg, max_new_tokens=9)
+    out = speculative_generate(params, params, prompt, cfg, cfg,
+                               max_new_tokens=9, k=3,
+                               draft_forward=_argmin_draft)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sampled_speculative_adversarial_drafts_keep_distribution():
+    """Rejection resampling must be distribution-EQUAL to target-only
+    sampling for ANY draft distribution — pinned on the two adversarial
+    extremes the tie semantics of filter_logits make constructible:
+
+    - exactly TIED draft logits: by the PR 1 value-threshold pin top_k
+      keeps the whole vocab, so p_d is uniform over V while the target
+      samples its filtered top-8 — most proposals have p_t == 0 and the
+      emission is dominated by the residual draw;
+    - a point mass on one token the target (almost) never picks: the
+      accept test fails near-always and the residual max(p_t - p_d, 0)
+      must renormalize around the zeroed proposal column.
+
+    Statistical pin as in the honest-draft test: per-position marginals
+    of 1024 independent sequences vs vanilla sampling, TV < 0.15
+    (top_k=8 support puts the sampling-noise floor ~0.06 at N=1024);
+    support must stay inside the target's filtered top-8."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.models.generate import generate
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+    from k8s_operator_libs_tpu.models.speculative import speculative_generate
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    N, Tp, new = 1024, 5, 3
+    base = jax.random.randint(jax.random.PRNGKey(2), (1, Tp), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    prompt = jnp.broadcast_to(base, (N, Tp))
+    kwargs = dict(temperature=0.5, top_k=8, top_p=0.9)
+
+    vanilla = np.asarray(generate(
+        params, prompt, cfg, max_new_tokens=new,
+        rng=jax.random.PRNGKey(7), **kwargs))
+    V = cfg.vocab_size
+    for name, draft in (("tied-uniform", _tied_uniform_draft),
+                        ("point-mass", _point_mass_draft)):
+        spec = np.asarray(speculative_generate(
+            params, params, prompt, cfg, cfg, max_new_tokens=new,
+            k=3, draft_forward=draft, rng=jax.random.PRNGKey(11),
+            **kwargs))
+        for pos in range(Tp, Tp + new):
+            pv = np.bincount(vanilla[:, pos], minlength=V) / N
+            ps = np.bincount(spec[:, pos], minlength=V) / N
+            tv = 0.5 * np.abs(pv - ps).sum()
+            assert tv < 0.15, (f"{name} draft, position {pos}: "
+                               f"TV {tv:.3f} vs vanilla")
+        assert len(np.unique(spec[:, Tp])) <= 8, \
+            f"{name} draft leaked tokens outside the target's top-8"

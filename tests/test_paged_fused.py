@@ -1,0 +1,226 @@
+"""CPU interpret-mode pins for the FUSED paged-decode kernels (r6:
+ops/attention.py paged_decode_kernel / paged_decode_kernel_q — the
+DEPTH-slot double-buffered DMA pipeline feeding an online softmax).
+
+The kernels replaced the r5 gather-then-attend design (batch-start all
+copies, wait, one big masked softmax over a full-capacity VMEM buffer),
+so the load-bearing properties to pin are:
+
+- bit-for-tolerance parity with the reference masked softmax over the
+  gathered view, for bf16-style float pools AND the int8 twin (dequant
+  now happens in-register inside the online update);
+- ragged lengths: each sequence's online walk stops at ITS OWN live
+  block and masks ITS OWN tail;
+- dead blocks are never touched: pool blocks outside every live table
+  prefix can hold NaN without poisoning the output (the r5 kernel
+  zeroed stale VMEM instead; the pipelined kernel simply never fetches
+  them);
+- the whole-model paths still agree: paged_generate through the fused
+  kernel matches the gather path, and the int8-weights twin
+  (quant.paged_quantized_generate) matches the contiguous quantized
+  decode token-for-token, including the prefill-rewind + scale-pool
+  case from PR 1 (ragged prompts, kv_int8=True).
+"""
+
+import math
+from unittest import mock
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from k8s_operator_libs_tpu.models import paged
+from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+
+# head_dim must be lane-aligned (128) for the kernel dispatch gate
+CFG = LlamaConfig.tiny(d_model=512, n_heads=4, n_kv_heads=2,
+                       dtype=jnp.float32)
+
+
+def _reference(q, k_view, v_view, lengths):
+    """Masked softmax over the gathered contiguous view — the math the
+    fused online walk must reproduce. q [B, 1, H, Dh]; views
+    [B, cap, KV, Dh]; lengths [B] (decode position per sequence)."""
+    B, _, H, Dh = q.shape
+    KV = k_view.shape[2]
+    G = H // KV
+    scale = 1.0 / math.sqrt(Dh)
+    q_g = np.asarray(q, np.float32).reshape(B, KV, G, Dh)
+    k = np.asarray(k_view, np.float32)
+    v = np.asarray(v_view, np.float32)
+    out = np.zeros((B, 1, H, Dh), np.float32)
+    for b in range(B):
+        n_vis = int(lengths[b]) + 1
+        for kv in range(KV):
+            s = q_g[b, kv] @ k[b, :n_vis, kv].T * scale      # [G, n_vis]
+            s -= s.max(axis=-1, keepdims=True)
+            p = np.exp(s)
+            p /= p.sum(axis=-1, keepdims=True)
+            out[b, 0, kv * G:(kv + 1) * G] = p @ v[b, :n_vis, kv]
+    return out
+
+
+def _pool_setup(rng, nb=16, bs=8, mb=4, kv=2, dh=128, B=3):
+    """Random pool + a table whose rows use distinct, shuffled block ids
+    (exercising the indirection), with ragged live lengths."""
+    k_pool = rng.standard_normal((nb, bs, kv, dh)).astype(np.float32)
+    v_pool = rng.standard_normal((nb, bs, kv, dh)).astype(np.float32)
+    ids = rng.permutation(nb - 1)[:B * mb].reshape(B, mb).astype(np.int32)
+    lengths = np.asarray([3, 17, 30], np.int32)   # 1, 3, 4 live blocks
+    return k_pool, v_pool, ids, lengths
+
+
+def test_fused_kernel_matches_reference_ragged():
+    rng = np.random.default_rng(0)
+    k_pool, v_pool, table, lengths = _pool_setup(rng)
+    B, mb = table.shape
+    bs = k_pool.shape[1]
+    q = rng.standard_normal((B, 1, 4, 128)).astype(np.float32)
+
+    k_view = k_pool[table].reshape(B, mb * bs, 2, 128)
+    v_view = v_pool[table].reshape(B, mb * bs, 2, 128)
+    ref = _reference(jnp.asarray(q), k_view, v_view, lengths)
+
+    paged.INTERPRET = True
+    try:
+        out = paged._attend_paged_kernel(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(lengths))
+    finally:
+        paged.INTERPRET = False
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_kernel_never_reads_dead_blocks():
+    """Blocks past every sequence's live prefix — including the unused
+    tail of each table row — can be NaN: the pipeline walks exactly
+    n_live blocks per sequence, so they are never fetched."""
+    rng = np.random.default_rng(1)
+    k_pool, v_pool, table, lengths = _pool_setup(rng)
+    B, mb = table.shape
+    bs = k_pool.shape[1]
+    live = set()
+    for b in range(B):
+        for m in range(int(lengths[b]) // bs + 1):
+            live.add(int(table[b, m]))
+    for blk in set(range(k_pool.shape[0])) - live:
+        k_pool[blk] = np.nan
+        v_pool[blk] = np.nan
+    q = rng.standard_normal((B, 1, 4, 128)).astype(np.float32)
+    k_view = np.nan_to_num(k_pool[table]).reshape(B, mb * bs, 2, 128)
+    v_view = np.nan_to_num(v_pool[table]).reshape(B, mb * bs, 2, 128)
+    ref = _reference(jnp.asarray(q), k_view, v_view, lengths)
+
+    paged.INTERPRET = True
+    try:
+        out = np.asarray(paged._attend_paged_kernel(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(table), jnp.asarray(lengths)))
+    finally:
+        paged.INTERPRET = False
+    assert np.isfinite(out).all(), "dead-block NaNs leaked into the output"
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_kernel_int8_matches_dequantized_reference():
+    """int8 twin: per-row symmetric int8 pools + fp32 scales, dequant
+    IN-REGISTER inside the online walk, must match the reference math
+    over the explicitly dequantized gather within fp tolerance."""
+    rng = np.random.default_rng(2)
+    k_pool_f, v_pool_f, table, lengths = _pool_setup(rng)
+    B, mb = table.shape
+    bs = k_pool_f.shape[1]
+
+    def quant(pool):
+        s = np.abs(pool).max(axis=-1) / 127.0          # [NB, BS, KV]
+        s = np.maximum(s, 1e-12)
+        q8 = np.clip(np.round(pool / s[..., None]), -127, 127)
+        return q8.astype(np.int8), s.astype(np.float32)
+
+    k8, ks = quant(k_pool_f)
+    v8, vs = quant(v_pool_f)
+    deq_k = k8.astype(np.float32) * ks[..., None]
+    deq_v = v8.astype(np.float32) * vs[..., None]
+    q = rng.standard_normal((B, 1, 4, 128)).astype(np.float32)
+    ref = _reference(jnp.asarray(q),
+                     deq_k[table].reshape(B, mb * bs, 2, 128),
+                     deq_v[table].reshape(B, mb * bs, 2, 128), lengths)
+
+    paged.INTERPRET = True
+    try:
+        out = paged._attend_paged_kernel(
+            jnp.asarray(q), jnp.asarray(k8), jnp.asarray(v8),
+            jnp.asarray(table), jnp.asarray(lengths),
+            jnp.asarray(ks), jnp.asarray(vs))
+    finally:
+        paged.INTERPRET = False
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_paged_quantized_generate_matches_contiguous_quantized():
+    """int8 WEIGHTS on the paged cache (the serving configuration's
+    weight half): token-identical to the contiguous-cache quantized
+    decode — same quantized tree, same greedy loop, only the cache
+    layout (and the fused kernel + weight prefetch) differ."""
+    from k8s_operator_libs_tpu.models.quant import (paged_quantized_generate,
+                                                    quantize_params,
+                                                    quantized_generate)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    ref = np.asarray(quantized_generate(qparams, prompt, cfg,
+                                        max_new_tokens=7))
+    out = np.asarray(paged_quantized_generate(qparams, prompt, cfg,
+                                              max_new_tokens=7,
+                                              block_size=4))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_paged_int8_full_config_ragged_prefill_rewind():
+    """The full paged+int8 configuration (int8 weights AND int8 KV
+    pools) through the fused kernel, on a RAGGED batch — the PR 1
+    prefill-rewind + scale-pool case: the length rewind after a padded
+    prefill must keep the scale pools, and each sequence must decode
+    from its own offset. Pinned against per-sequence solo decodes of
+    the same quantized tree (kv-int8 rounding ~1/127 can in principle
+    flip a near-tied greedy pick, so the pin allows a small per-token
+    disagreement rate but requires prompts to round-trip exactly)."""
+    from k8s_operator_libs_tpu.models.quant import (paged_quantized_generate,
+                                                    quantize_params)
+    cfg = LlamaConfig.tiny(d_model=512, n_heads=4, n_kv_heads=2,
+                           dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    p0 = jax.random.randint(jax.random.PRNGKey(1), (1, 9), 0,
+                            cfg.vocab_size, dtype=jnp.int32)
+    p1 = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0,
+                            cfg.vocab_size, dtype=jnp.int32)
+    padded = jnp.zeros((2, 9), jnp.int32)
+    padded = padded.at[0].set(p0[0]).at[1, :5].set(p1[0])
+
+    paged.INTERPRET = True
+    try:
+        with mock.patch.object(paged, "_paged_decode_kernel_q",
+                               side_effect=paged._paged_decode_kernel_q) \
+                as spy:
+            out = np.asarray(paged_quantized_generate(
+                qparams, padded, cfg, max_new_tokens=6, block_size=4,
+                prompt_lengths=jnp.asarray([9, 5], jnp.int32),
+                kv_int8=True))
+        assert spy.called, "fused int8 kernel was not engaged"
+        solo0 = np.asarray(paged_quantized_generate(
+            qparams, p0, cfg, max_new_tokens=6, block_size=4,
+            kv_int8=True))
+        solo1 = np.asarray(paged_quantized_generate(
+            qparams, p1, cfg, max_new_tokens=6, block_size=4,
+            kv_int8=True))
+    finally:
+        paged.INTERPRET = False
+    np.testing.assert_array_equal(out[0, :9 + 6], solo0[0])
+    # ragged sequence: prompt region must match exactly; generated
+    # region sits after ITS prompt (positions 5..11 of the solo decode)
+    np.testing.assert_array_equal(out[1, :5], solo1[0, :5])
+    np.testing.assert_array_equal(out[1, 9:], solo1[0, 5:11])

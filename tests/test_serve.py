@@ -310,3 +310,115 @@ def test_moe_on_continuous_batcher_matches_solo():
         np.testing.assert_array_equal(
             done[rid], solo,
             err_msg=f"MoE request {rid} diverged from its solo decode")
+
+
+# ------------------------------------------------- speculative draft mode
+
+
+def _argmin_paged_draft(params, tokens, cache, cfg):
+    """Worst-case draft for the batcher: the target's own paged forward
+    with NEGATED logits, so every greedy proposal is the target's argmin
+    — acceptance is structurally 0% (fp32, generically untied logits)."""
+    from k8s_operator_libs_tpu.models.paged import _forward_paged
+    logits, cache = _forward_paged(params, tokens, cache, cfg)
+    return -logits, cache
+
+
+def test_spec_batcher_matches_solo_and_observes_acceptance():
+    """Draft mode's contract is the batcher's own NON-INTERFERENCE pin
+    unchanged: with the quantized self-draft proposing, every request's
+    tokens must still equal its solo greedy decode (the target verify is
+    authoritative), with staggered arrivals forcing interleave/retire/
+    recycle over the draft's twin pools. Acceptance flows into the hub's
+    spec_accept_ratio histogram. Capacity is sized so the longest slot
+    ends within spec_k of its limit — the tail of that request exercises
+    the documented fallback from rounds to plain ticks mid-flight."""
+    from k8s_operator_libs_tpu.obs import MetricsHub
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    hub = MetricsHub()
+    srv = ContinuousBatcher(params, CFG, max_slots=2,
+                            capacity_per_slot=32, block_size=8,
+                            draft="self-int8", spec_k=3, metrics=hub)
+    assert srv._spec is not None and srv._spec["k"] == 3
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 12, 7)]
+    news = [6, 4, 20, 8]          # request 2 runs to 12+20=32 == capacity
+    r0 = srv.submit(prompts[0], news[0])
+    r1 = srv.submit(prompts[1], news[1])
+    results = {}
+    ticks = 0
+    extra = {}
+    while not srv.idle:
+        srv.step()
+        results.update(srv.poll())
+        ticks += 1
+        if ticks == 2:
+            extra[2] = srv.submit(prompts[2], news[2])
+        if ticks == 3:
+            extra[3] = srv.submit(prompts[3], news[3])
+        assert ticks < 200, "spec server did not converge"
+    results.update(srv.poll())
+
+    for rid, p, n in ((r0, prompts[0], news[0]), (r1, prompts[1], news[1]),
+                      (extra[2], prompts[2], news[2]),
+                      (extra[3], prompts[3], news[3])):
+        np.testing.assert_array_equal(
+            results[rid], _solo(params, p, n),
+            err_msg=f"request {rid} diverged from its solo decode "
+                    f"under speculative rounds")
+    # fleet fully recycled, acceptance histogram populated
+    assert len(srv._free_slots) == 2
+    hist = hub.get_histogram("spec_accept_ratio")
+    assert hist is not None
+    count = sum(counts[-1] + sum(counts[:-1])
+                for counts, _ in hist.series.values())
+    assert count > 0, "no speculative rounds were observed"
+
+
+def test_spec_batcher_zero_acceptance_degrades_gracefully():
+    """A 0%-acceptance draft (argmin of the target) must cost only
+    speed: outputs stay token-identical to solo decodes, every round
+    still advances each slot by exactly one confirmed token (the
+    pending token — the non-speculative floor), and the accept-ratio
+    histogram records all-zero ratios rather than wedging."""
+    from k8s_operator_libs_tpu.obs import MetricsHub
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    hub = MetricsHub()
+    srv = ContinuousBatcher(params, CFG, max_slots=2,
+                            capacity_per_slot=64, block_size=8,
+                            draft=(params, CFG, _argmin_paged_draft),
+                            spec_k=3, metrics=hub)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+               for n in (6, 10)]
+    news = [7, 5]
+    rids = [srv.submit(p, n) for p, n in zip(prompts, news)]
+    done = {}
+    rounds = 0
+    while not srv.idle:
+        srv.step()
+        done.update(srv.poll())
+        rounds += 1
+        assert rounds < 40
+    done.update(srv.poll())
+    for rid, p, n in zip(rids, prompts, news):
+        np.testing.assert_array_equal(
+            done[rid], _solo(params, p, n),
+            err_msg=f"request {rid} diverged under a 0%-acceptance draft")
+    hist = hub.get_histogram("spec_accept_ratio")
+    assert hist is not None
+    total = sum(t for _, t in hist.series.values())
+    count = sum(counts[-1] + sum(counts[:-1])
+                for counts, _ in hist.series.values())
+    assert count > 0 and total == 0.0, (
+        f"argmin draft should never be accepted "
+        f"(sum={total}, count={count})")
+
+
+def test_spec_batcher_rejects_bad_spec_k():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    import pytest
+    with pytest.raises(ValueError, match="spec_k"):
+        ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=32,
+                          block_size=8, draft="self-int8", spec_k=0)
