@@ -12,8 +12,9 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo health-sim lint lint-domain cov-report cov-artifact bench \
-  bench-decode dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+  test-obs-slo test-chaos health-sim chaos lint lint-domain cov-report \
+  cov-artifact bench bench-decode dryrun apply-crds-dry clean \
+  $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -41,15 +42,22 @@ test-obs-workload:  ## workload telemetry: goodput ledger, serving metrics, down
 test-obs-slo:  ## SLO engine: tsdb, error budgets, burn-rate alerting, dashboard (docs/observability.md "SLOs & alerting")
 	$(PYTHON) -m pytest tests/test_slo.py -q
 
+test-chaos:  ## chaos harness + elastic training suites (docs/chaos.md)
+	$(PYTHON) -m pytest tests/test_chaos.py tests/test_elastic.py -q
+
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py
+
+SEEDS ?= 20
+chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md)
+	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS)
 
 lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — see docs/static-analysis.md) + import sanity
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
 	$(PYTHON) -m tools.lint --generic
 	$(PYTHON) -c "import k8s_operator_libs_tpu as m; import k8s_operator_libs_tpu.upgrade, \
 	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
-	  k8s_operator_libs_tpu.health, \
+	  k8s_operator_libs_tpu.health, k8s_operator_libs_tpu.chaos, \
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 

@@ -346,25 +346,55 @@ def render_dashboard(slo_data, alerts_data, operator_url: str) -> str:
     ])
 
 
-def run_slo_view(args) -> int:
+def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
     """--slo / --alerts (one-shot or --watch live dashboard). Data comes
     from the operator's HTTP endpoints so this view, the gauges, and the
-    Events all agree."""
+    Events all agree.
+
+    ``--watch`` survives transient metrics-endpoint failures: a fetch
+    error keeps the last good frame on screen under a "STALE since <t>"
+    banner and retries on the normal interval — a dashboard must outlive
+    the operator's rolling restart, not traceback-exit in the middle of
+    the incident it is being watched for. One-shot mode still exits 2
+    (scripts need the error). ``fetch``/``sleep``/``now`` are injectable
+    for tests."""
+    now = now or time.time
     iterations = 0
+    last_slo_env = None
+    last_alerts_env = None
+    stale_since = None
     while True:
+        fetch_error = None
         try:
-            slo_env = (fetch_view(args.operator_url, "/slo")
+            slo_env = (fetch(args.operator_url, "/slo")
                        if (args.slo or args.watch) else None)
-            alerts_env = (fetch_view(args.operator_url, "/alerts")
+            alerts_env = (fetch(args.operator_url, "/alerts")
                           if (args.alerts or args.watch) else None)
         except Exception as exc:
-            print(f"error: cannot read {args.operator_url}: {exc}",
-                  file=sys.stderr)
-            return 2
+            if not args.watch:
+                print(f"error: cannot read {args.operator_url}: {exc}",
+                      file=sys.stderr)
+                return 2
+            # watch mode: keep the last good frame, banner the staleness
+            fetch_error = exc
+            if stale_since is None:
+                stale_since = now()
+            slo_env, alerts_env = last_slo_env, last_alerts_env
+        else:
+            stale_since = None
+            last_slo_env, last_alerts_env = slo_env, alerts_env
         if args.watch:
             body = render_dashboard(
                 (slo_env or {}).get("data") or {},
                 (alerts_env or {}).get("data") or [], args.operator_url)
+            if fetch_error is not None:
+                stamp = datetime.datetime.fromtimestamp(
+                    stale_since, tz=datetime.timezone.utc).strftime(
+                    "%Y-%m-%d %H:%M:%S UTC")
+                body = (f"STALE since {stamp} — cannot read "
+                        f"{args.operator_url}: {fetch_error} "
+                        f"(retrying every {args.watch_interval:g}s)\n"
+                        + body)
             # ANSI clear + home: repaint in place like `watch(1)`
             print("\x1b[2J\x1b[H" + body, flush=True)
         elif args.as_json:
@@ -384,7 +414,7 @@ def run_slo_view(args) -> int:
         if not args.watch or (args.watch_count
                               and iterations >= args.watch_count):
             return 0
-        time.sleep(args.watch_interval)
+        sleep(args.watch_interval)
 
 
 def render_timeline(component: str, node_name: str, rows, stuck) -> str:

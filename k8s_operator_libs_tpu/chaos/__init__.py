@@ -1,0 +1,31 @@
+"""Seeded chaos harness: fault injection, standing invariants, campaigns.
+
+The robustness counterpart of ``tools/health_sim.py``'s single scripted
+replay: correlated multi-slice failures, apiserver latency/flake/conflict
+injection, watch lag, leader failover mid-phase, eviction 429 storms and
+spot-reclaim notices — every run continuously asserting the invariants
+the rest of the repo claims (maxUnavailable budget, journey continuity
+across failover, attribution summing to the window, exactly-one-Event
+dedup, alert-machine transition legality). See docs/chaos.md.
+"""
+
+from .campaign import (CampaignResult, SimJob, build_fleet, run_campaign,
+                       run_scenario, shrink_failure)
+from .faults import (FAULT_TYPES, RECLAIM_DEADLINE_ANNOTATION,
+                     RECLAIM_TAINT_KEY, FaultEvent)
+from .injector import ChaosClient, ChaosInjector
+from .invariants import (FAULT_COVERAGE, INVARIANT_NAMES, CampaignView,
+                         Invariant, Violation, default_invariants)
+from .scenario import (FAULT_PARSERS, FleetSpec, Scenario, ScenarioError,
+                       parse_scenario, random_scenario)
+
+__all__ = [
+    "CampaignResult", "SimJob", "build_fleet", "run_campaign",
+    "run_scenario", "shrink_failure",
+    "FAULT_TYPES", "RECLAIM_DEADLINE_ANNOTATION", "RECLAIM_TAINT_KEY",
+    "FaultEvent", "ChaosClient", "ChaosInjector",
+    "FAULT_COVERAGE", "INVARIANT_NAMES", "CampaignView", "Invariant",
+    "Violation", "default_invariants",
+    "FAULT_PARSERS", "FleetSpec", "Scenario", "ScenarioError",
+    "parse_scenario", "random_scenario",
+]

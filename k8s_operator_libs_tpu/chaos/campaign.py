@@ -1,0 +1,369 @@
+"""Chaos campaign runner: seeded scenarios to convergence, invariants on.
+
+One :func:`run_scenario` call is the whole story: build the fleet from
+the scenario's :class:`~.scenario.FleetSpec`, stand up TWO operator
+candidates behind real :class:`~..core.leaderelection.LeaderElector`\\ s
+(so leader-loss faults drive a genuine failover through the lease
+protocol, not a test shortcut), wrap every client in the seeded
+:class:`~.injector.ChaosInjector`, and tick the world on a FakeClock —
+injecting faults, reconciling under the current leader, replaying the
+DaemonSet controller, stepping a simulated checkpoint-resume workload,
+and evaluating every standing :mod:`invariant <.invariants>` — until the
+fleet converges back to healthy or the tick budget runs out.
+
+A failing run returns its seed + the injector's tick trace (the exact
+fault schedule), and :func:`shrink_failure` greedily drops faults that
+are not needed to reproduce — the smallest scenario that still fails is
+what goes in the bug report.
+
+``make chaos SEEDS=N`` (tools/chaos_campaign.py) runs N seeded random
+scenarios; ``make test-chaos`` replays the pinned ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+from ..api.v1alpha1 import (DrainSpec, DriverUpgradePolicySpec,
+                            scaled_int_or_percent)
+from ..core.fakecluster import FakeCluster
+from ..core.leaderelection import LeaderElector
+from ..health.classifier import ClassifierConfig
+from ..health.monitor import HealthOptions
+from ..health.remediation import RemediationPolicy
+from ..obs.goodput import GoodputLedger
+from ..obs.metrics import MetricsHub
+from ..obs.slo import SLOOptions
+from ..tpu.operator import ManagedComponent, TPUOperator
+from ..tpu.topology import (GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL,
+                            GKE_TOPOLOGY_LABEL)
+from ..upgrade.consts import UpgradeState
+from ..upgrade.util import KeyFactory
+from ..utils.clock import FakeClock
+from .faults import RECLAIM_TAINT_KEY
+from .injector import ChaosInjector
+from .invariants import (CampaignView, Invariant, Violation,
+                         default_invariants)
+from .scenario import Scenario
+
+logger = logging.getLogger(__name__)
+
+NS = "kube-system"
+COMPONENT = "libtpu"
+LEASE_NAME = "tpu-operator"
+LEASE_NS = NS
+LEASE_DURATION_S = 45.0
+LEASE_RETRY_S = 10.0
+DRIVER_LABELS = {"app": COMPONENT}
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    scenario: str
+    seed: int
+    converged: bool
+    ticks: int
+    modelled_s: float
+    violations: List[Violation]
+    trace: List[str]
+    failovers: int = 0
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.violations) or not self.converged
+
+    def report(self) -> str:
+        status = "PASS" if not self.failed else "FAIL"
+        lines = [f"{status} {self.scenario} seed={self.seed} "
+                 f"ticks={self.ticks} modelled={self.modelled_s:.0f}s "
+                 f"failovers={self.failovers} "
+                 f"violations={len(self.violations)}"]
+        if self.failed:
+            if not self.converged:
+                lines.append("  did NOT converge")
+            lines += [f"  {v}" for v in self.violations[:10]]
+            lines.append(f"  replay: tools/chaos_campaign.py --seeds 1 "
+                         f"--base-seed {self.seed}")
+            lines += [f"  {t}" for t in self.trace]
+        return "\n".join(lines)
+
+
+def build_fleet(cluster: FakeCluster, fleet) -> List[str]:
+    """Slices + solo nodes + the managed driver DaemonSet, one pod per
+    node at revision v1 (the health_sim topology, parameterized)."""
+    ds = cluster.add_daemonset(COMPONENT, namespace=NS,
+                               labels=dict(DRIVER_LABELS),
+                               revision_hash="v1")
+    nodes: List[str] = []
+    for s in range(fleet.slices):
+        labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                  GKE_TOPOLOGY_LABEL: "4x4",
+                  GKE_NODEPOOL_LABEL: f"pool-{s}"}
+        for host in fleet.slice_hosts(s):
+            cluster.add_node(host, labels=labels)
+            cluster.add_pod(f"drv-{host}", host, namespace=NS, owner_ds=ds,
+                            revision_hash="v1")
+            nodes.append(host)
+    for i in range(fleet.solo_nodes):
+        name = f"solo-{i}"
+        cluster.add_node(name, labels={
+            GKE_ACCELERATOR_LABEL: "tpu-v5-lite-device",
+            GKE_TOPOLOGY_LABEL: "2x4", GKE_NODEPOOL_LABEL: name})
+        cluster.add_pod(f"drv-{name}", name, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+        nodes.append(name)
+    return nodes
+
+
+def _make_operator(client, recorder, clock, max_unavailable: str
+                   ) -> TPUOperator:
+    return TPUOperator(
+        client,
+        components=[ManagedComponent(
+            name=COMPONENT, namespace=NS,
+            driver_labels=dict(DRIVER_LABELS),
+            policy=DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=0,
+                max_unavailable=max_unavailable,
+                drain=DrainSpec(enable=True, force=True,
+                                timeout_second=120)))],
+        recorder=recorder, clock=clock, synchronous=True,
+        metrics=MetricsHub(),
+        health=HealthOptions(
+            classifier=ClassifierConfig(damping_seconds=30.0,
+                                        persist_seconds=60.0),
+            policy=RemediationPolicy(recovery_seconds=45.0,
+                                     backoff_base_seconds=60.0,
+                                     max_unavailable=max_unavailable)),
+        slo=SLOOptions.from_dict({}))
+
+
+class SimJob:
+    """The campaign's simulated checkpoint-resume workload, pinned to one
+    node: it drain-saves and exits (``preempted=True``) when its node is
+    cordoned OR carries a spot-reclaim taint, and resumes — continuing
+    the SAME ledger file — once the node returns. Its ledger is what the
+    attribution invariant sums against the node's journey."""
+
+    def __init__(self, path: str, node_name: str, clock):
+        self.path = path
+        self.node_name = node_name
+        self.clock = clock
+        self.ledger: Optional[GoodputLedger] = None
+        self.running = False
+        self.fresh = False
+        self.step = 0
+
+    def tick(self, cluster: FakeCluster) -> None:
+        try:
+            node = cluster.client.direct().get_node(self.node_name)
+        except KeyError:
+            return
+        preempt = (node.spec.unschedulable
+                   or any(t.key == RECLAIM_TAINT_KEY
+                          for t in node.spec.taints))
+        if self.running and preempt:
+            with self.ledger.phase("drain_save"):
+                self.clock.sleep(1.0)
+            self.ledger.run_ended(self.step, preempted=True)
+            self.ledger.close()
+            self.ledger = None
+            self.running = False
+        elif not self.running and not preempt:
+            self.ledger = GoodputLedger(self.path, clock=self.clock)
+            self.ledger.run_started(self.step)
+            with self.ledger.phase("ckpt_restore"):
+                self.clock.sleep(1.0)
+            self.running = True
+            self.fresh = True
+        elif self.running:
+            self.step += 1
+            if self.fresh:
+                self.ledger.first_step(self.step, 1.0, 64)
+                self.fresh = False
+            else:
+                self.ledger.steps(self.step, 1, 1.0, 64)
+
+    def close(self) -> None:
+        if self.ledger is not None:
+            self.ledger.close()
+            self.ledger = None
+
+
+def run_scenario(scenario: Scenario, seed: int,
+                 workdir: Optional[str] = None,
+                 invariants: Optional[List[Invariant]] = None,
+                 hooks: Optional[List[Callable]] = None,
+                 stop_on_violation: bool = True) -> CampaignResult:
+    """Run one scenario under one seed to convergence (or violation /
+    tick exhaustion). ``hooks`` run each tick after the reconcile and
+    before the invariant pass — tests inject rogue out-of-band writes
+    there to prove the checkers catch them."""
+    clock = FakeClock(10_000.0)
+    cluster = FakeCluster(clock=clock, cache_lag=0.5)
+    fleet_nodes = build_fleet(cluster, scenario.fleet)
+    keys = KeyFactory(COMPONENT)
+    injector = ChaosInjector(cluster, clock, seed, scenario.faults,
+                             namespace=NS, driver_labels=DRIVER_LABELS,
+                             lease_duration_s=LEASE_DURATION_S)
+    candidates = []
+    for identity in ("op-a", "op-b"):
+        client = injector.client(identity)
+        elector = LeaderElector(client, LEASE_NAME, LEASE_NS, identity,
+                                lease_duration_s=LEASE_DURATION_S,
+                                retry_period_s=LEASE_RETRY_S, clock=clock)
+        op = _make_operator(client, cluster.recorder, clock,
+                            scenario.max_unavailable)
+        candidates.append((identity, elector, op))
+
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="chaos-campaign-")
+        workdir = tmp.name
+    job = SimJob(os.path.join(workdir, "goodput.jsonl"),
+                 scenario.fleet.slice_hosts(0)[0], clock)
+    checks = invariants if invariants is not None else default_invariants()
+    budget = scaled_int_or_percent(scenario.max_unavailable,
+                                   len(fleet_nodes), round_up=True)
+    violations: List[Violation] = []
+    bumped = scenario.upgrade_at is None
+    prev_leader: Optional[str] = None
+    failovers = 0
+    converged = False
+    tick = 0
+    try:
+        for tick in range(scenario.max_ticks):
+            now = clock.now() - 10_000.0
+            injector.tick()
+            if not bumped and now >= scenario.upgrade_at:
+                cluster.bump_daemonset_revision(COMPONENT, NS, "v2")
+                injector.trace.append(
+                    f"t={now:7.1f}s  UPGRADE daemonset revision -> v2")
+                bumped = True
+            leaders = []
+            for identity, elector, op in candidates:
+                if elector.tick_safely():
+                    leaders.append(identity)
+            if len(leaders) == 1 and leaders[0] != prev_leader:
+                if prev_leader is not None:
+                    failovers += 1
+                    injector.trace.append(
+                        f"t={now:7.1f}s  FAILOVER {prev_leader} -> "
+                        f"{leaders[0]}")
+                prev_leader = leaders[0]
+            for identity, elector, op in candidates:
+                if elector.is_leader:
+                    op.reconcile()
+            cluster.reconcile_daemonsets()
+            job.tick(cluster)
+            for hook in hooks or []:
+                hook(cluster=cluster, clock=clock, keys=keys, tick=tick)
+            nodes = {n.metadata.name: n
+                     for n in cluster.client.direct().list_nodes()}
+            view = CampaignView(
+                tick=tick, t=now, nodes=nodes, keys=keys, budget=budget,
+                fault_notready=injector.notready_nodes(),
+                leaders=leaders,
+                recorder_events=list(cluster.recorder.events),
+                alert_status={identity: (op.alert_manager.status()
+                                         if op.alert_manager else [])
+                              for identity, _, op in candidates},
+                ledger_path=job.path, workload_node=job.node_name,
+                tick_seconds=scenario.tick_seconds)
+            for inv in checks:
+                violations.extend(inv.check(view))
+            if violations and stop_on_violation:
+                break
+            # convergence may not be declared while the rollout trigger
+            # or any fault window is still ahead — a healthy t=0 fleet is
+            # not a survived scenario
+            if bumped and injector.quiet() and _converged(
+                    cluster, keys, nodes,
+                    bumped=scenario.upgrade_at is not None, job=job):
+                converged = True
+                break
+            clock.advance(scenario.tick_seconds)
+    finally:
+        job.close()
+        if tmp is not None:
+            tmp.cleanup()
+    return CampaignResult(
+        scenario=scenario.name, seed=seed, converged=converged,
+        ticks=tick + 1, modelled_s=clock.now() - 10_000.0,
+        violations=violations, trace=list(injector.trace),
+        failovers=failovers)
+
+
+def _converged(cluster: FakeCluster, keys: KeyFactory,
+               nodes: Dict[str, object], bumped: bool,
+               job: SimJob) -> bool:
+    """Back to healthy: every node schedulable, Ready, unquarantined and
+    untainted, every upgrade state terminal, every driver pod ready (and
+    at the new revision when a rollout ran), the workload running."""
+    from ..health import consts as hconsts
+    for node in nodes.values():
+        if node.spec.unschedulable or not node.is_ready():
+            return False
+        if hconsts.QUARANTINE_LABEL in node.metadata.labels:
+            return False
+        if any(t.key == RECLAIM_TAINT_KEY for t in node.spec.taints):
+            return False
+        state = node.metadata.labels.get(keys.state_label, "")
+        if state not in ("", UpgradeState.DONE):
+            return False
+    pods = cluster.client.direct().list_pods(
+        namespace=NS, label_selector=DRIVER_LABELS)
+    if len(pods) != len(nodes):
+        return False
+    for pod in pods:
+        if not all(cs.ready for cs in pod.status.container_statuses):
+            return False
+        if bumped and pod.metadata.labels.get(
+                "controller-revision-hash") != "v2":
+            return False
+    return job.running
+
+
+def shrink_failure(scenario: Scenario, seed: int,
+                   **kwargs) -> Scenario:
+    """Greedy delta-debugging: drop one fault at a time; keep the drop
+    whenever the scenario still fails. Returns the minimal scenario that
+    reproduces (possibly the original). Reruns are cheap — everything is
+    a FakeClock simulation."""
+    current = scenario
+    shrunk = True
+    while shrunk and len(current.faults) > 1:
+        shrunk = False
+        for i in range(len(current.faults)):
+            candidate = dataclasses.replace(
+                current,
+                faults=current.faults[:i] + current.faults[i + 1:])
+            if run_scenario(candidate, seed, **kwargs).failed:
+                current = candidate
+                shrunk = True
+                break
+    return current
+
+
+def run_campaign(seeds: int, base_seed: int = 0,
+                 scenario_fn=None) -> List[CampaignResult]:
+    """N seeded scenarios (``scenario_fn(seed) -> Scenario``, default
+    :func:`~.scenario.random_scenario`); every result returned, failures
+    already shrunk."""
+    from .scenario import random_scenario
+    scenario_fn = scenario_fn or random_scenario
+    results: List[CampaignResult] = []
+    for i in range(seeds):
+        seed = base_seed + i
+        scenario = scenario_fn(seed)
+        result = run_scenario(scenario, seed)
+        if result.failed:
+            minimal = shrink_failure(scenario, seed)
+            result.trace.append(
+                "shrunk reproducer:\n" + minimal.describe())
+        results.append(result)
+    return results

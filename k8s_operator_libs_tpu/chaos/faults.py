@@ -1,0 +1,79 @@
+"""Fault catalog for the seeded chaos harness.
+
+:data:`FAULT_TYPES` is the CLOSED enum of everything the injector knows
+how to break. Closed matters: the scenario-spec parsers
+(:mod:`.scenario`) and the invariant coverage map (:mod:`.invariants`)
+are keyed by these strings, and the CHS001 lint pass
+(``tools/lint/chaos_check.py``) proves both stay closed over this tuple
+in both directions — adding a fault the parsers can't parse, or one no
+invariant claims to stress, fails ``make lint-domain`` before it fails a
+3 a.m. campaign run.
+
+The catalog (docs/chaos.md has the full fault semantics):
+
+``apiserver-latency``  every client call pays a seeded-random delay
+``apiserver-flake``    client calls fail with transient 5xx at a rate
+``conflict-storm``     write calls fail with 409 conflicts at a rate
+``watch-lag``          the informer cache's staleness window widens
+``driver-crashloop``   driver pods on target slices go not-ready with
+                       restart counts past the failure threshold
+``node-notready``      target nodes' Ready condition flips False
+``leader-loss``        the current leader's lease traffic is partitioned
+                       past its renew deadline (standby takes over)
+``eviction-storm``     workload pods on target nodes return 429 to the
+                       next N eviction attempts (a PDB storm)
+``spot-reclaim``       target nodes get a reclaim taint + deadline
+                       annotation (the spot/preemption notice contract
+                       the elastic trainer consumes)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+# the closed fault-type enum — CHS001 keeps scenario parsers and the
+# invariant coverage map closed over this tuple in both directions
+FAULT_TYPES = (
+    "apiserver-latency",
+    "apiserver-flake",
+    "conflict-storm",
+    "watch-lag",
+    "driver-crashloop",
+    "node-notready",
+    "leader-loss",
+    "eviction-storm",
+    "spot-reclaim",
+)
+
+# Spot/preemption reclaim notice wire contract: the cloud (or the chaos
+# injector playing it) taints the node and stamps the absolute deadline
+# (wall seconds) after which the chips disappear. The workload side
+# (train/harness.py elastic mode, the campaign's simulated job) watches
+# for the taint and must be checkpointed before the deadline.
+RECLAIM_TAINT_KEY = "tpu.dev/spot-reclaim"
+RECLAIM_TAINT_EFFECT = "NoSchedule"
+RECLAIM_DEADLINE_ANNOTATION = "tpu.dev/spot-reclaim-deadline"
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One scheduled fault: ``type`` (a :data:`FAULT_TYPES` member) goes
+    active at ``at`` (modelled seconds from campaign start) for
+    ``duration`` seconds against ``targets`` (node names; empty = the
+    parser's default targeting), with type-specific ``params``."""
+
+    type: str
+    at: float
+    duration: float = 0.0
+    targets: List[str] = dataclasses.field(default_factory=list)
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def until(self) -> float:
+        return self.at + self.duration
+
+    def describe(self) -> str:
+        tgt = ",".join(self.targets) if self.targets else "-"
+        return (f"{self.type} at={self.at:.0f}s dur={self.duration:.0f}s "
+                f"targets={tgt} {self.params or ''}".rstrip())

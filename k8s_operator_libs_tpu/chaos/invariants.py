@@ -1,0 +1,368 @@
+"""Standing invariants, evaluated continuously over a chaos run.
+
+Each :class:`Invariant` is checked EVERY campaign tick — not just at the
+end — so a violation is reported at the tick it first holds, with the
+fault trace up to that point (the replayable evidence). The checkers are
+deliberately stateful: journey continuity and alert-transition legality
+are properties of *sequences* of observations, not snapshots.
+
+The catalog (:data:`INVARIANT_NAMES`):
+
+``budget``            the operator never takes more than the
+                      maxUnavailable budget out of service itself:
+                      cordoned nodes plus admitted-but-not-yet-cordoned
+                      nodes (state label ``cordon-required`` — the same
+                      lookahead GetUpgradesAvailable and the health
+                      remediator charge) never exceed the budget.
+                      Fault-injected NotReady nodes consume budget
+                      headroom but are not the operator's doing.
+``single-leader``     at most one election candidate believes it is the
+                      leader at any tick.
+``journey``           per-node journey annotations are monotone
+                      (timestamps never regress), deduplicated (no
+                      consecutive repeats), move only along legal
+                      pipeline edges, and are CONTINUOUS across leader
+                      failover — each tick's journey extends the last
+                      tick's (trimming allowed only at the entry cap).
+``event-dedup``       exactly one Event per dedup key: StuckNode events
+                      never exceed the journey's entries into the stuck
+                      state; SLOAlertFiring/Resolved events match the
+                      observed state-machine transitions one-to-one.
+``alert-transitions`` the alert state machine never skips an edge
+                      (inactive→firing without pending, etc.).
+``attribution``       every unavailability window the workload ledger
+                      observes splits into phases that SUM to the
+                      window; journey-derived window segments partition
+                      their window exactly.
+
+:data:`FAULT_COVERAGE` maps every fault type to the invariants it
+stresses — CHS001 keeps it closed over ``FAULT_TYPES`` in both
+directions and over :data:`INVARIANT_NAMES`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs.attribution import attribute_downtime, windows_from_journey
+from ..obs.goodput import read_ledger
+from ..obs.journey import MAX_JOURNEY_ENTRIES, parse_journey
+from ..upgrade.consts import UpgradeState
+
+INVARIANT_NAMES = (
+    "budget",
+    "single-leader",
+    "journey",
+    "event-dedup",
+    "alert-transitions",
+    "attribution",
+)
+
+# fault type -> invariants that fault is designed to stress; CHS001
+# proves the keys equal FAULT_TYPES and every value is a known invariant
+# (and that no invariant is orphaned — unstressed checkers rot)
+FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
+    "apiserver-latency": ("budget", "journey", "single-leader"),
+    "apiserver-flake": ("budget", "journey", "event-dedup"),
+    "conflict-storm": ("budget", "journey"),
+    "watch-lag": ("budget", "journey"),
+    "driver-crashloop": ("budget", "journey", "event-dedup",
+                         "alert-transitions"),
+    "node-notready": ("budget", "alert-transitions"),
+    "leader-loss": ("single-leader", "journey", "event-dedup"),
+    "eviction-storm": ("budget", "journey", "attribution"),
+    "spot-reclaim": ("attribution", "event-dedup"),
+}
+
+# Legal pipeline edges (upgrade_state.py processing order + the failure
+# and auto-recovery transitions the managers write). The journey checker
+# flags anything else — a skipped phase means a write bypassed the
+# machine.
+LEGAL_TRANSITIONS: Dict[str, Tuple[str, ...]] = {
+    UpgradeState.UNKNOWN: (UpgradeState.UPGRADE_REQUIRED, UpgradeState.DONE),
+    UpgradeState.UPGRADE_REQUIRED: (UpgradeState.CORDON_REQUIRED,),
+    UpgradeState.CORDON_REQUIRED: (UpgradeState.WAIT_FOR_JOBS_REQUIRED,),
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED: (
+        UpgradeState.POD_DELETION_REQUIRED, UpgradeState.DRAIN_REQUIRED),
+    UpgradeState.POD_DELETION_REQUIRED: (
+        UpgradeState.DRAIN_REQUIRED, UpgradeState.FAILED),
+    UpgradeState.DRAIN_REQUIRED: (
+        UpgradeState.POD_RESTART_REQUIRED, UpgradeState.FAILED),
+    UpgradeState.POD_RESTART_REQUIRED: (
+        UpgradeState.VALIDATION_REQUIRED, UpgradeState.UNCORDON_REQUIRED,
+        UpgradeState.DONE, UpgradeState.FAILED),
+    UpgradeState.VALIDATION_REQUIRED: (
+        UpgradeState.UNCORDON_REQUIRED, UpgradeState.DONE,
+        UpgradeState.FAILED),
+    UpgradeState.UNCORDON_REQUIRED: (UpgradeState.DONE,),
+    UpgradeState.FAILED: (
+        UpgradeState.UNCORDON_REQUIRED, UpgradeState.DONE),
+    UpgradeState.DONE: (UpgradeState.UPGRADE_REQUIRED,),
+}
+
+_ALERT_EDGES = {
+    "inactive": ("inactive", "pending"),
+    "pending": ("pending", "firing", "inactive"),
+    "firing": ("firing", "resolved"),
+    "resolved": ("resolved", "pending"),
+}
+
+_STUCK_MSG_RE = re.compile(
+    r"Node (\S+) stuck in (\S+) .*component (\S+)\)")
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    tick: int
+    t: float
+    detail: str
+
+    def __str__(self) -> str:
+        return (f"[{self.invariant}] tick={self.tick} t={self.t:.1f}s: "
+                f"{self.detail}")
+
+
+@dataclasses.dataclass
+class CampaignView:
+    """What the checkers see each tick — assembled by the campaign."""
+
+    tick: int
+    t: float                                  # modelled seconds from start
+    nodes: Dict[str, object]                  # name -> Node (direct reads)
+    keys: object                              # the component's KeyFactory
+    budget: int                               # scaled maxUnavailable
+    fault_notready: set                       # injector-flipped nodes
+    leaders: List[str]                        # identities claiming lease
+    recorder_events: Sequence[object]         # cluster.recorder.events
+    alert_status: Dict[str, List[dict]]       # op identity -> status()
+    ledger_path: Optional[str] = None         # simulated workload ledger
+    workload_node: Optional[str] = None
+    tick_seconds: float = 15.0
+
+
+class Invariant:
+    name = "invariant"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        raise NotImplementedError
+
+    def _v(self, view: CampaignView, detail: str) -> Violation:
+        return Violation(self.name, view.tick, view.t, detail)
+
+
+class BudgetInvariant(Invariant):
+    name = "budget"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        taken = []
+        for name, node in view.nodes.items():
+            state = node.metadata.labels.get(view.keys.state_label, "")
+            if (node.spec.unschedulable
+                    or state == UpgradeState.CORDON_REQUIRED):
+                taken.append(name)
+        if len(taken) > view.budget:
+            return [self._v(view,
+                            f"operator holds {len(taken)} nodes out of "
+                            f"service ({sorted(taken)}) > maxUnavailable "
+                            f"budget {view.budget}")]
+        return []
+
+
+class SingleLeaderInvariant(Invariant):
+    name = "single-leader"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        if len(view.leaders) > 1:
+            return [self._v(view, f"dual leadership: {view.leaders}")]
+        return []
+
+
+class JourneyInvariant(Invariant):
+    name = "journey"
+
+    def __init__(self):
+        self._prev: Dict[str, List[Tuple[str, float]]] = {}
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        out: List[Violation] = []
+        for name, node in view.nodes.items():
+            entries = parse_journey(
+                node.metadata.annotations.get(view.keys.journey_annotation))
+            for (s1, t1), (s2, t2) in zip(entries, entries[1:]):
+                if t2 < t1:
+                    out.append(self._v(
+                        view, f"{name}: journey time regressed "
+                        f"{s1}@{t1} -> {s2}@{t2}"))
+                if s1 == s2:
+                    out.append(self._v(
+                        view, f"{name}: journey repeats state {s2} "
+                        f"consecutively (idempotent rewrite leaked)"))
+                legal = LEGAL_TRANSITIONS.get(s1)
+                if legal is not None and s2 not in legal:
+                    out.append(self._v(
+                        view, f"{name}: illegal transition "
+                        f"{s1 or 'unknown'} -> {s2} (legal: "
+                        f"{', '.join(legal) or 'none'})"))
+            prev = self._prev.get(name)
+            if prev is not None and not self._extends(prev, entries):
+                out.append(self._v(
+                    view, f"{name}: journey not continuous — previous "
+                    f"{prev[-3:]} is no prefix of current "
+                    f"{entries[-3:]} (reset across failover?)"))
+            self._prev[name] = entries
+        return out
+
+    @staticmethod
+    def _extends(prev: List[Tuple[str, float]],
+                 cur: List[Tuple[str, float]]) -> bool:
+        if cur[:len(prev)] == prev:
+            return True
+        # trimming the oldest entries is legal only at the cap
+        if len(cur) >= MAX_JOURNEY_ENTRIES:
+            for drop in range(1, len(prev) + 1):
+                tail = prev[drop:]
+                if cur[:len(tail)] == tail:
+                    return True
+        return False
+
+
+class AlertTransitionInvariant(Invariant):
+    """Checks edge legality AND counts →firing / →resolved transitions
+    (per alert-manager instance) for the event-dedup checker."""
+
+    name = "alert-transitions"
+
+    def __init__(self):
+        self._prev: Dict[Tuple[str, str], str] = {}
+        self.firing_transitions: Dict[str, int] = {}
+        self.resolved_transitions: Dict[str, int] = {}
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        out: List[Violation] = []
+        for op_id, status in view.alert_status.items():
+            for st in status:
+                key = (op_id, st["rule"])
+                prev = self._prev.get(key, "inactive")
+                cur = st["state"]
+                if cur not in _ALERT_EDGES.get(prev, ()):
+                    out.append(self._v(
+                        view, f"alert {st['rule']} ({op_id}) skipped a "
+                        f"transition: {prev} -> {cur}"))
+                if cur == "firing" and prev != "firing":
+                    self.firing_transitions[st["rule"]] = \
+                        self.firing_transitions.get(st["rule"], 0) + 1
+                if cur == "resolved" and prev != "resolved":
+                    self.resolved_transitions[st["rule"]] = \
+                        self.resolved_transitions.get(st["rule"], 0) + 1
+                self._prev[key] = cur
+        return out
+
+
+class EventDedupInvariant(Invariant):
+    name = "event-dedup"
+
+    def __init__(self, alerts: Optional[AlertTransitionInvariant] = None):
+        self._alerts = alerts
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        out: List[Violation] = []
+        stuck_counts: Dict[Tuple[str, str], int] = {}
+        fire_counts: Dict[str, int] = {}
+        resolve_counts: Dict[str, int] = {}
+        for ev in view.recorder_events:
+            if ev.reason == "StuckNode":
+                m = _STUCK_MSG_RE.search(ev.message)
+                if m:
+                    key = (m.group(1), m.group(2))
+                    stuck_counts[key] = stuck_counts.get(key, 0) + 1
+            elif ev.reason == "SLOAlertFiring":
+                fire_counts[ev.object_name] = \
+                    fire_counts.get(ev.object_name, 0) + 1
+            elif ev.reason == "SLOAlertResolved":
+                resolve_counts[ev.object_name] = \
+                    resolve_counts.get(ev.object_name, 0) + 1
+        # one StuckNode event per (node, state ENTRY): events can never
+        # outnumber the journey's entries into that state
+        for (node_name, state), count in stuck_counts.items():
+            node = view.nodes.get(node_name)
+            if node is None:
+                continue
+            entries = parse_journey(node.metadata.annotations.get(
+                view.keys.journey_annotation))
+            if len(entries) >= MAX_JOURNEY_ENTRIES:
+                continue  # trimmed: entry count no longer evidentiary
+            entered = sum(1 for s, _ in entries if s == state)
+            if count > entered:
+                out.append(self._v(
+                    view, f"{count} StuckNode events for {node_name} in "
+                    f"{state} but only {entered} journey entr"
+                    f"{'y' if entered == 1 else 'ies'} — dedup broken"))
+        # one Event per observed alert transition, exactly
+        if self._alerts is not None:
+            for rule, n in fire_counts.items():
+                want = self._alerts.firing_transitions.get(rule, 0)
+                if n != want:
+                    out.append(self._v(
+                        view, f"{n} SLOAlertFiring events for {rule} vs "
+                        f"{want} observed pending->firing transitions"))
+            for rule, n in resolve_counts.items():
+                want = self._alerts.resolved_transitions.get(rule, 0)
+                if n != want:
+                    out.append(self._v(
+                        view, f"{n} SLOAlertResolved events for {rule} "
+                        f"vs {want} observed firing->resolved "
+                        f"transitions"))
+        return out
+
+
+class AttributionInvariant(Invariant):
+    name = "attribution"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        out: List[Violation] = []
+        quantum = max(1.0, view.tick_seconds / 2.0)
+        # journey-derived windows: the three segments partition exactly
+        for name, node in view.nodes.items():
+            entries = parse_journey(node.metadata.annotations.get(
+                view.keys.journey_annotation))
+            for w in windows_from_journey(entries):
+                span = (w.end - w.start) if w.end is not None else None
+                if span is not None and abs(w.window_s - span) > 1e-6:
+                    out.append(self._v(
+                        view, f"{name}: journey window segments sum to "
+                        f"{w.window_s:.3f}s but the window spans "
+                        f"{span:.3f}s"))
+        # ledger windows: attributed phases sum to each window
+        if view.ledger_path and view.workload_node:
+            node = view.nodes.get(view.workload_node)
+            if node is not None:
+                try:
+                    records = read_ledger(view.ledger_path)
+                except FileNotFoundError:
+                    return out
+                entries = parse_journey(node.metadata.annotations.get(
+                    view.keys.journey_annotation))
+                for rep in attribute_downtime(records, entries):
+                    total = sum(rep["phases"].values())
+                    if abs(total - rep["total_s"]) > quantum:
+                        out.append(self._v(
+                            view, f"attributed phases sum to "
+                            f"{total:.2f}s but the window is "
+                            f"{rep['total_s']:.2f}s "
+                            f"({rep['phases']})"))
+        return out
+
+
+def default_invariants() -> List[Invariant]:
+    alerts = AlertTransitionInvariant()
+    return [
+        BudgetInvariant(),
+        SingleLeaderInvariant(),
+        JourneyInvariant(),
+        alerts,
+        EventDedupInvariant(alerts),
+        AttributionInvariant(),
+    ]

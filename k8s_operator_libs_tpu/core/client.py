@@ -36,6 +36,14 @@ class ConflictError(RuntimeError):
     """resourceVersion conflict on update (apierrors.IsConflict analog)."""
 
 
+class ServerError(RuntimeError):
+    """HTTP 5xx from the apiserver: a transient server-side failure
+    (overload, rolling restart, etcd leader change). Retryable — the
+    reconcile loop's per-component isolation and the drain helper's
+    backoff both treat it as such; the chaos injector raises it to prove
+    they do."""
+
+
 class InvalidError(ValueError):
     """HTTP 422 Unprocessable Entity: the object failed apiserver
     validation (apierrors.IsInvalid analog) — e.g. a taint appended

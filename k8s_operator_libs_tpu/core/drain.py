@@ -11,10 +11,12 @@ module reimplements the helper's core semantics against our abstract Client.
 from __future__ import annotations
 
 import dataclasses
+import random
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from .client import Client, NotFoundError, TooManyRequestsError
+from .client import (Client, ConflictError, NotFoundError,
+                     TooManyRequestsError)
 from .objects import Pod
 
 # An AdditionalFilter: pod -> (delete?, reason). Matches kubectl drain's
@@ -42,6 +44,27 @@ class Helper:
     on_pod_deletion_finished: Optional[Callable[[Pod], None]] = None
     clock: Clock = dataclasses.field(default_factory=RealClock)
     use_eviction: bool = True
+    # Eviction retry schedule: bounded exponential backoff with seeded
+    # jitter on 429 (PDB) / 409 (conflict) responses. kubectl drain retries
+    # at a fixed 5 s; under a chaos-injected 429 storm that cadence
+    # hammers the apiserver in lockstep across every draining node, so the
+    # schedule grows 5 → 10 → 20 → ... capped at ``retry_max_seconds``,
+    # spread by ±``retry_jitter`` fraction. The jitter RNG is seeded
+    # (deterministic by default) and the waits ride the injected clock, so
+    # chaos runs and unit tests can pin the exact schedule.
+    retry_base_seconds: float = 5.0
+    retry_max_seconds: float = 60.0
+    retry_jitter: float = 0.2
+    retry_seed: int = 0
+
+    def _retry_schedule(self):
+        """Infinite backoff generator: base * 2^n capped, jittered."""
+        rng = random.Random(self.retry_seed)
+        delay = self.retry_base_seconds
+        while True:
+            jitter = 1.0 + self.retry_jitter * rng.uniform(-1.0, 1.0)
+            yield max(0.0, delay * jitter)
+            delay = min(self.retry_max_seconds, delay * 2.0)
 
     # ----------------------------------------------------------------- cordon
 
@@ -94,6 +117,7 @@ class Helper:
         no_timeout = self.timeout_seconds <= 0
         deadline = self.clock.now() + self.timeout_seconds
         pending = list(pods)
+        schedule = self._retry_schedule()
         while pending:
             still_blocked: List[Pod] = []
             for pod in pending:
@@ -108,10 +132,12 @@ class Helper:
                                           self.grace_period_seconds)
                 except NotFoundError:
                     pass
-                except TooManyRequestsError:
-                    # a PodDisruptionBudget blocks this eviction right now;
-                    # kubectl drain retries every 5 s until its timeout —
-                    # same here
+                except (TooManyRequestsError, ConflictError):
+                    # a PodDisruptionBudget blocks this eviction right now
+                    # (429), or the write raced another client (409) —
+                    # kubectl drain retries until its timeout; so do we,
+                    # on the jittered backoff schedule instead of its
+                    # fixed 5 s cadence
                     still_blocked.append(pod)
             if not still_blocked:
                 break
@@ -120,7 +146,7 @@ class Helper:
                     f"global timeout reached with evictions still blocked "
                     f"by disruption budgets: "
                     f"{[p.metadata.name for p in still_blocked]}")
-            self.clock.sleep(5.0)
+            self.clock.sleep(next(schedule))
             pending = still_blocked
         for pod in pods:
             while True:

@@ -119,6 +119,35 @@ class LeaderElector:
                 self._on_lost()
         return self._is_leader
 
+    def tick_safely(self) -> bool:
+        """:meth:`tick` with client-go renew-deadline semantics on
+        transport failure: an exception from the apiserver (blip, rolling
+        restart, chaos-injected 5xx) KEEPS leadership while the lease we
+        hold is still alive — the record still names us, so no standby can
+        take over anyway. Only when the outage outlives the renew deadline
+        (strictly inside the lease duration, so the old holder steps down
+        BEFORE a standby can acquire) is leadership demoted. Used by
+        :meth:`run_background` and by synchronous drivers (the chaos
+        campaign ticks candidates on a fake clock)."""
+        try:
+            return self.tick()
+        except Exception:
+            logger.exception("leader-election tick failed")
+            # demote at a renew DEADLINE strictly inside the lease
+            # (client-go: renewDeadline < leaseDuration): a standby
+            # acquires only after the full lease, so the margin —
+            # two retry periods, covering our own polling lag —
+            # guarantees the old holder has stepped down first;
+            # equal thresholds would allow a dual-leader window
+            deadline = max(self.retry_period,
+                           self._duration - 2 * self.retry_period)
+            lapsed = (self._clock.now() - self._last_renew_ok > deadline)
+            if self._is_leader and lapsed:
+                self._is_leader = False
+                if self._on_lost is not None:
+                    self._on_lost()
+            return self._is_leader
+
     def run_background(self, stop_event: threading.Event,
                        on_lost=None) -> threading.Thread:
         """Renew/acquire on a daemon thread every ``retry_period`` until
@@ -135,30 +164,7 @@ class LeaderElector:
 
         def loop():
             while not (stop_event.is_set() or self._bg_stop.is_set()):
-                try:
-                    self.tick()
-                except Exception:
-                    # transport hiccup (apiserver blip, rolling restart):
-                    # KEEP leadership while the lease we hold is still
-                    # alive — the apiserver record still names us, so no
-                    # standby can take over anyway. Only when the outage
-                    # outlives the lease duration has leadership truly
-                    # lapsed (client-go's renew-deadline semantics).
-                    logger.exception("leader-election tick failed")
-                    # demote at a renew DEADLINE strictly inside the lease
-                    # (client-go: renewDeadline < leaseDuration): a standby
-                    # acquires only after the full lease, so the margin —
-                    # two retry periods, covering our own polling lag —
-                    # guarantees the old holder has stepped down first;
-                    # equal thresholds would allow a dual-leader window
-                    deadline = max(self.retry_period,
-                                   self._duration - 2 * self.retry_period)
-                    lapsed = (self._clock.now() - self._last_renew_ok
-                              > deadline)
-                    if self._is_leader and lapsed:
-                        self._is_leader = False
-                        if self._on_lost is not None:
-                            self._on_lost()
+                self.tick_safely()
                 self._bg_stop.wait(self.retry_period)
         t = threading.Thread(target=loop, name="leader-elector", daemon=True)
         self._bg_thread = t

@@ -14,6 +14,12 @@ Badput phases (:data:`PHASES`):
 ``ckpt_save``     periodic checkpoint dispatch (async — normally tiny)
 ``drain_save``    the synchronous drain-triggered save before exit
 ``ckpt_restore``  restoring the latest checkpoint on resume
+``degraded``      elastic mode: the window the job ran on a SHRUNKEN
+                  mesh after a partial reclaim — the job was *up*, just
+                  slower. Priced, not raw: its badput contribution is
+                  ``seconds_lost`` (duration x lost capacity fraction),
+                  so ``downtime_summary`` and dashboards can tell "down"
+                  from "running at reduced throughput"
 ``idle_gap``      derived, never written: wall time between one run's
                   last record and the next run's first — the
                   evicted/rescheduled window the job was not running
@@ -58,7 +64,8 @@ logger = logging.getLogger(__name__)
 LEDGER_BASENAME = "goodput.jsonl"
 
 # writable badput phases; "idle_gap" is derived between runs, never written
-PHASES = ("compile", "rewarmup", "ckpt_save", "drain_save", "ckpt_restore")
+PHASES = ("compile", "rewarmup", "ckpt_save", "drain_save", "ckpt_restore",
+          "degraded")
 
 
 class GoodputLedger:
@@ -146,6 +153,21 @@ class GoodputLedger:
         finally:
             self.record_phase(name, t0_wall,
                               max(0.0, self.clock.now() - t0_mono), **extra)
+
+    def degraded(self, start_wall: float, duration_s: float,
+                 devices_before: int, devices_after: int) -> None:
+        """Elastic shrink pricing: the job ran ``duration_s`` on
+        ``devices_after`` of its original ``devices_before`` chips. The
+        raw duration was (reduced) goodput — the *priced* loss is the
+        capacity fraction gone, recorded as ``seconds_lost`` so
+        :func:`summarize` charges the shrink without double-counting the
+        wall time the steps already booked."""
+        devices_before = max(1, int(devices_before))
+        lost = max(0.0, 1.0 - devices_after / devices_before)
+        self.record_phase("degraded", start_wall, float(duration_s),
+                          devices_before=devices_before,
+                          devices_after=int(devices_after),
+                          seconds_lost=round(duration_s * lost, 6))
 
     def first_step(self, step: int, wall_s: float, tokens: int) -> None:
         """The first step of a run is compile/rewarmup badput, not
@@ -276,7 +298,18 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     runs = split_runs(records)
     idle_gap_s = sum(end - start
                      for start, end in unavailability_windows(records))
-    badput_s = sum(p["seconds"] for p in phases.values()) + idle_gap_s
+
+    # "degraded" is concurrent with goodput (the job RAN, on fewer
+    # chips): its steps already booked their wall time above, so the
+    # badput charge is the PRICED capacity loss (seconds_lost), not the
+    # raw duration — charging both would double-count the window
+    def _charge(name: str, agg: Dict[str, float]) -> float:
+        if name == "degraded":
+            return agg.get("seconds_lost", agg["seconds"])
+        return agg["seconds"]
+
+    badput_s = sum(_charge(name, agg)
+                   for name, agg in phases.items()) + idle_gap_s
     total_s = (max(times) - min(times)) if times else 0.0
     accounted = goodput_s + badput_s
     return {
@@ -284,7 +317,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "steps": steps,
         "tokens": tokens,
         "goodput_s": goodput_s,
-        "badput_s": {**{name: agg["seconds"] for name, agg in
+        "badput_s": {**{name: _charge(name, agg) for name, agg in
                         sorted(phases.items())},
                      "idle_gap": idle_gap_s},
         "phases": phases,

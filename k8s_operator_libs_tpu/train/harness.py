@@ -40,15 +40,30 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import jax
 import orbax.checkpoint as ocp
 
 from ..models.llama import LlamaConfig
 from ..parallel.fsdp import TrainState, init_train_state, make_train_step
+from ..parallel.mesh import make_mesh
 
 logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ReclaimNotice:
+    """A spot/preemption reclaim notice for (part of) the job's slice:
+    ``surviving_devices`` are the chips the job keeps (empty = total
+    reclaim), ``deadline_s`` the grace before the reclaimed chips
+    disappear. Delivered by the platform as a node taint + deadline
+    annotation (chaos/faults.py RECLAIM_TAINT_KEY); the ``reclaim_signal``
+    callable injected into :meth:`CheckpointingTrainer.run` adapts that
+    to the training loop."""
+
+    surviving_devices: Sequence[Any]
+    deadline_s: float = 120.0
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
@@ -81,6 +96,8 @@ class TrainResult:
     preempted: bool          # True = exited for a drain, checkpoint saved
     last_checkpoint_step: int
     wall_time_s: float
+    reshards: int = 0        # elastic mode: how many shrinks happened
+    device_count: Optional[int] = None  # devices at exit (elastic mode)
 
 
 def _block_on(metrics) -> None:
@@ -112,7 +129,11 @@ class CheckpointingTrainer:
                  init_fn: Optional[Callable] = None,
                  grad_accum: int = 1,
                  ledger=None,
-                 metrics_sync_every: int = 10):
+                 metrics_sync_every: int = 10,
+                 elastic: bool = False,
+                 mesh_factory: Optional[Callable] = None,
+                 step_factory: Optional[Callable] = None,
+                 init_factory: Optional[Callable] = None):
         """``step_fn(state, batch) -> (state, metrics)`` and
         ``init_fn(rng) -> TrainState`` default to the Llama FSDP pair; pass
         both to train another model family (MoE) or parallelism (sp/pp/ep)
@@ -129,13 +150,42 @@ class CheckpointingTrainer:
         device stream: the loop synchronizes only every that many steps
         and at checkpoint/drain/final boundaries — never per step, so
         recording never serializes dispatch (pinned by a sync-counting
-        test)."""
+        test).
+
+        ``elastic=True`` turns a partial :class:`ReclaimNotice` into a
+        shrink instead of an exit: drain-save, re-derive a smaller mesh
+        over the surviving devices (``mesh_factory(devices) -> Mesh``,
+        default a pure-FSDP :func:`~..parallel.mesh.make_mesh`), reshard
+        the checkpoint onto it, and resume — with the ledger pricing the
+        reduced-capacity window as a ``degraded`` badput phase. The
+        default step/init functions are rebuilt for the new mesh from
+        ``cfg``/``optimizer``; jobs that inject custom ``step_fn`` /
+        ``init_fn`` must also inject ``step_factory(mesh)`` /
+        ``init_factory(mesh)`` so the shrink can rebuild them."""
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
         self.checkpoint_interval = checkpoint_interval
         self.ledger = ledger
         self.metrics_sync_every = max(1, int(metrics_sync_every))
+        self.elastic = bool(elastic)
+        self._grad_accum = grad_accum
+        self._mesh_factory = mesh_factory or (
+            lambda devices: make_mesh(devices=list(devices)))
+        self._step_factory = step_factory
+        self._init_factory = init_factory
+        if self.elastic and step_fn is not None and step_factory is None:
+            raise ValueError("elastic=True with a custom step_fn needs a "
+                             "step_factory(mesh) to rebuild it on shrink")
+        if self.elastic and init_fn is not None and init_factory is None:
+            raise ValueError("elastic=True with a custom init_fn needs an "
+                             "init_factory(mesh) to rebuild it on shrink")
+        try:
+            self._device_count = (int(mesh.devices.size) if mesh is not None
+                                  else len(jax.devices()))
+        except Exception:
+            self._device_count = 1
+        self._resume_rng = None
         self._mngr = ocp.CheckpointManager(
             checkpoint_dir,
             options=ocp.CheckpointManagerOptions(
@@ -156,6 +206,7 @@ class CheckpointingTrainer:
     def init_or_resume(self, rng: jax.Array) -> TrainState:
         """Fresh init, or restore the latest checkpoint re-sharded onto this
         job's mesh."""
+        self._resume_rng = rng
         latest = self._mngr.latest_step()
         if latest is None:
             logger.info("no checkpoint found, initializing from scratch")
@@ -193,12 +244,26 @@ class CheckpointingTrainer:
             num_steps: int,
             drain_signal: Optional[Callable[[], bool]] = None,
             on_step: Optional[Callable[[int, dict], None]] = None,
-            sync_every: Optional[int] = None) -> TrainResult:
+            sync_every: Optional[int] = None,
+            reclaim_signal: Optional[
+                Callable[[], Optional[ReclaimNotice]]] = None
+            ) -> TrainResult:
         """Train until num_steps more steps are done or a drain is signalled.
 
         Drain → synchronous checkpoint → return (preempted=True). Periodic
         checkpoints every checkpoint_interval steps are async (orbax
         overlaps them with compute).
+
+        ``reclaim_signal()`` returning a :class:`ReclaimNotice` is the
+        spot/preemption path. A total reclaim (no survivors) — or any
+        reclaim on a non-elastic trainer — behaves exactly like a drain:
+        synchronous save, ``run_ended(preempted=True)``, so the ledger
+        opens the unavailability window at the save whether the exit was
+        operator-coordinated or cloud-initiated. With ``elastic=True``
+        and surviving devices, the trainer instead drain-saves,
+        re-derives a smaller mesh, reshards the checkpoint onto it, and
+        RESUMES — no stall, no run boundary; the ledger records the
+        shrink window as a priced ``degraded`` phase.
 
         ``on_step(step, metrics)`` receives the HOST-side step counter and
         the raw (possibly still in-flight) device metrics — the loop no
@@ -218,6 +283,8 @@ class CheckpointingTrainer:
         last_ckpt = self._mngr.latest_step() or start_step
         done = 0
         preempted = False
+        reshards = 0
+        degraded_open = None  # (start wall, devices before, devices after)
         win_t0 = now()       # start of the current unsynced step window
         win_steps = 0
         win_tokens = 0
@@ -232,6 +299,43 @@ class CheckpointingTrainer:
                     last_ckpt = self.save(state, wait=True)
                 preempted = True
                 break
+            notice = reclaim_signal() if reclaim_signal is not None else None
+            if notice is not None:
+                survivors = list(notice.surviving_devices or [])
+                if ledger is not None and win_steps > 0:
+                    # close the open goodput window before the save so
+                    # the ledger's timeline stays contiguous
+                    ledger.steps(start_step + done, win_steps,
+                                 max(0.0, now() - win_t0), win_tokens)
+                    win_steps = win_tokens = 0
+                if not self.elastic or not survivors:
+                    logger.info(
+                        "reclaim notice at step %d (%d survivors, elastic="
+                        "%s): checkpoint + exit", start_step + done,
+                        len(survivors), self.elastic)
+                    if ledger is not None:
+                        with ledger.phase("drain_save"):
+                            last_ckpt = self.save(state, wait=True)
+                    else:
+                        last_ckpt = self.save(state, wait=True)
+                    preempted = True
+                    break
+                before = self._device_count
+                if degraded_open is not None and ledger is not None:
+                    b0, a0, s0 = degraded_open[1], degraded_open[2], \
+                        degraded_open[0]
+                    ledger.degraded(s0, max(0.0, ledger.clock.wall() - s0),
+                                    b0, a0)
+                    degraded_open = None
+                state, last_ckpt = self._shrink(state, survivors, ledger)
+                reshards += 1
+                if ledger is not None:
+                    degraded_open = (ledger.clock.wall(), before,
+                                     len(survivors))
+                win_t0 = now()
+                win_steps = 0
+                win_tokens = 0
+                continue
             batch = next(data)
             state, metrics = self._step_fn(state, batch)
             done += 1
@@ -263,7 +367,47 @@ class CheckpointingTrainer:
                 else:
                     last_ckpt = self.save(state)  # async
         if ledger is not None:
+            if degraded_open is not None:
+                start_wall, before, after = degraded_open
+                ledger.degraded(start_wall,
+                                max(0.0, ledger.clock.wall() - start_wall),
+                                before, after)
             ledger.run_ended(start_step + done, preempted)
         return TrainResult(state=state, steps_done=done, preempted=preempted,
                            last_checkpoint_step=last_ckpt,
-                           wall_time_s=max(0.0, now() - t0))
+                           wall_time_s=max(0.0, now() - t0),
+                           reshards=reshards,
+                           device_count=self._device_count)
+
+    def _shrink(self, state: TrainState, survivors: List[Any],
+                ledger) -> "tuple[TrainState, int]":
+        """Elastic shrink: synchronous drain-save, re-derive the mesh
+        over the surviving devices, rebuild step/init for it, and restore
+        the checkpoint re-sharded onto the shrunk mesh. Returns (restored
+        state, checkpoint step). The restore rides init_or_resume, so the
+        ledger books it as a ``ckpt_restore`` phase like any resume."""
+        if ledger is not None:
+            with ledger.phase("drain_save"):
+                ckpt_step = self.save(state, wait=True)
+        else:
+            ckpt_step = self.save(state, wait=True)
+        new_mesh = self._mesh_factory(survivors)
+        self.mesh = new_mesh
+        if self._step_factory is not None:
+            self._step_fn = self._step_factory(new_mesh)
+        else:
+            self._step_fn = make_train_step(self.cfg, self.optimizer,
+                                            new_mesh, self._grad_accum)
+        if self._init_factory is not None:
+            self._init_fn = self._init_factory(new_mesh)
+        else:
+            self._init_fn = (
+                lambda rng: init_train_state(rng, self.cfg, self.optimizer,
+                                             new_mesh))
+        rng = (self._resume_rng if self._resume_rng is not None
+               else jax.random.PRNGKey(0))
+        restored = self.init_or_resume(rng)
+        self._device_count = len(survivors)
+        logger.info("elastic shrink: resumed at step %d on %d devices",
+                    int(restored.step), len(survivors))
+        return restored, ckpt_step

@@ -279,7 +279,7 @@ class ClusterUpgradeStateManager:
         with self._span("process_pod_restart_nodes"):
             self.process_pod_restart_nodes(current_state, groups)
         with self._span("process_upgrade_failed_nodes"):
-            self.process_upgrade_failed_nodes(current_state)
+            self.process_upgrade_failed_nodes(current_state, groups)
         with self._span("process_validation_required_nodes"):
             self.process_validation_required_nodes(current_state)
         with self._span("process_uncordon_required_nodes"):
@@ -532,13 +532,48 @@ class ClusterUpgradeStateManager:
         self._update_nodes_to_uncordon_or_done_state(to_uncordon)
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
-    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
+    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState,
+                                     groups: Optional[Dict[str, GroupView]]
+                                     = None) -> None:
         """ProcessUpgradeFailedNodes (:835-877): auto-recovery — once the
         driver pod is back in sync and Ready (after manual intervention per
-        docs/automatic-ofed-upgrade.md:89-98), promote to uncordon/done."""
+        docs/automatic-ofed-upgrade.md:89-98), promote to uncordon/done.
+
+        Extension (no reference analog; found by the chaos campaign): a
+        FAILED node whose pod has RECOVERED — no longer failing, but still
+        at the OLD revision — could never auto-recover: the pod-restart
+        handler only walks its own bucket, and the health remediator
+        defers to the in-flight pipeline ("it will restart the drivers
+        anyway" — false exactly here). A transient crashloop that tripped
+        the failure threshold then wedged the node (and, through the
+        group uncordon barrier, its whole slice) until a human deleted
+        the pod. Restart such healthy-but-outdated pods here, behind the
+        same group restart barrier (quiesced ICI domain). A pod that is
+        STILL failing keeps the reference's manual-intervention contract
+        — auto-deleting it would retry a persistent crashloop forever."""
+        if groups is None:
+            groups = build_group_views(state, self.grouper)
+        pods_to_restart: List[Pod] = []
         for ns in state.bucket(UpgradeState.FAILED):
             if self._is_driver_pod_in_sync(ns):
                 self._update_node_to_uncordon_or_done_state(ns.node)
+                continue
+            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+            if is_synced and not is_orphaned:
+                continue  # right revision, not Ready yet: keep waiting
+            if self._is_driver_pod_failing(ns.driver_pod):
+                continue  # still broken: manual intervention (reference)
+            if ns.driver_pod.metadata.deletion_timestamp is not None:
+                continue  # already terminating
+            if self.group_policy.atomic:
+                group = groups[self.grouper.group_key(ns.node)]
+                if not group.all_in(AT_OR_PAST_POD_RESTART):
+                    continue  # ICI domain not quiesced yet
+            logger.info("restarting recovered-but-outdated driver pod %s "
+                        "on failed node %s", ns.driver_pod.metadata.name,
+                        ns.node.metadata.name)
+            pods_to_restart.append(ns.driver_pod)
+        self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
         """ProcessValidationRequiredNodes (:880-911)."""

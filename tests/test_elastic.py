@@ -1,0 +1,246 @@
+"""Elastic training: partial reclaim → shrink + resume instead of stall.
+
+The acceptance bars pinned here:
+
+- a reclaim notice on a NON-elastic trainer (or a total reclaim) exits
+  exactly like an operator drain: synchronous save, ``run_ended(
+  preempted=True)`` — the ledger opens the cross-restart unavailability
+  window whether the exit was operator-coordinated or cloud-initiated;
+- an elastic trainer keeps ONE contiguous run across a shrink: no run
+  boundary, no unavailability window, and the ledger prices the
+  reduced-capacity tail as a ``degraded`` phase (duration x lost
+  capacity fraction);
+- the CPU-only reshard e2e: train K steps on the 8-device virtual mesh,
+  reclaim 4, and the run RESUMES on the re-derived 4-device mesh with
+  step/loss continuity — numerically identical to a cold start that
+  restores the same checkpoint on 4 devices.
+"""
+
+import os
+import types
+
+import pytest
+
+from k8s_operator_libs_tpu.obs.goodput import (GoodputLedger, read_ledger,
+                                               split_runs, summarize,
+                                               unavailability_windows)
+from k8s_operator_libs_tpu.train.harness import (CheckpointingTrainer,
+                                                 ReclaimNotice)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+def _stub_trainer(tmp_path, clock, ledger, elastic, **kwargs):
+    def step_factory(mesh):
+        def step_fn(state, batch):
+            clock.advance(0.1)
+            return types.SimpleNamespace(step=state.step + 1), {"loss": 0.0}
+        return step_fn
+
+    kwargs.setdefault("step_factory", step_factory)
+    kwargs.setdefault(
+        "init_factory",
+        lambda mesh: (lambda rng: types.SimpleNamespace(step=0)))
+    trainer = CheckpointingTrainer(
+        None, str(tmp_path / "ckpt"),
+        step_fn=step_factory(None),
+        init_fn=lambda rng: types.SimpleNamespace(step=0),
+        checkpoint_interval=10_000, ledger=ledger, elastic=elastic,
+        mesh_factory=lambda devs: ("mesh", len(devs)), **kwargs)
+    saves = []
+    trainer.save = (lambda state, wait=False:
+                    saves.append((int(state.step), wait))
+                    or int(state.step))
+    trainer._saves = saves
+    return trainer
+
+
+def test_elastic_requires_factories_with_custom_step_fn(tmp_path):
+    with pytest.raises(ValueError, match="step_factory"):
+        CheckpointingTrainer(None, str(tmp_path / "c"), elastic=True,
+                             step_fn=lambda s, b: (s, {}),
+                             init_fn=lambda r: None,
+                             init_factory=lambda m: (lambda r: None))
+
+
+def test_reclaim_on_inelastic_trainer_exits_preempted(tmp_path):
+    """Satellite: a reclaim notice — not just an operator drain — must
+    end the run with run_ended(preempted=True) and a drain_save, so the
+    unavailability window opens at the save and closes when the resumed
+    run's first goodput step lands."""
+    clock = FakeClock(1000.0)
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    trainer = _stub_trainer(tmp_path, clock, led, elastic=False)
+    notices = iter([None, None, ReclaimNotice(surviving_devices=[])])
+    result = trainer.run(types.SimpleNamespace(step=0),
+                         iter(lambda: object(), None), num_steps=50,
+                         reclaim_signal=lambda: next(notices, None))
+    led.close()
+    assert result.preempted and result.steps_done == 2
+    assert result.reshards == 0
+    assert trainer._saves == [(2, True)]
+    records = read_ledger(led.path)
+    end = [r for r in records if r["kind"] == "run_end"]
+    assert end and end[0]["preempted"] is True
+    assert any(r.get("phase") == "drain_save" for r in records)
+
+    # the rescheduled job continues the ledger; ONE window opens at the
+    # reclaim save and closes at the resumed first goodput step
+    clock.advance(40.0)
+    led2 = GoodputLedger(led.path, clock=clock)
+    assert led2.resumed
+    led2.run_started(2)
+    clock.advance(1.0)
+    led2.steps(3, 1, 1.0, 64)
+    led2.close()
+    windows = unavailability_windows(read_ledger(led.path))
+    assert len(windows) == 1
+    start, end_t = windows[0]
+    assert end_t - start == pytest.approx(40.0 + 1.0 - 1.0, abs=0.3)
+
+
+def test_elastic_total_reclaim_still_exits(tmp_path):
+    clock = FakeClock()
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    trainer = _stub_trainer(tmp_path, clock, led, elastic=True)
+    result = trainer.run(
+        types.SimpleNamespace(step=0), iter(lambda: object(), None),
+        num_steps=10,
+        reclaim_signal=lambda: ReclaimNotice(surviving_devices=[]))
+    led.close()
+    assert result.preempted and result.steps_done == 0
+    assert result.reshards == 0
+
+
+def test_elastic_shrink_keeps_one_run_and_prices_degraded(tmp_path):
+    """Ledger continuity across an elastic shrink+resume: one run, no
+    unavailability window, and the degraded phase prices the shrink
+    window at duration x lost-capacity (8 -> 4 devices = 50%)."""
+    clock = FakeClock(5000.0)
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    trainer = _stub_trainer(tmp_path, clock, led, elastic=True)
+    restored = types.SimpleNamespace(step=4)
+    trainer.init_or_resume = lambda rng: restored
+    trainer._device_count = 8  # pretend the mesh spans the full 8 chips
+    notices = iter([None] * 4 + [ReclaimNotice(surviving_devices=list("abcd"))])
+    seen_steps = []
+    result = trainer.run(types.SimpleNamespace(step=0),
+                         iter(lambda: object(), None), num_steps=10,
+                         on_step=lambda s, m: seen_steps.append(s),
+                         reclaim_signal=lambda: next(notices, None))
+    led.close()
+    assert not result.preempted
+    assert result.reshards == 1 and result.device_count == 4
+    assert result.steps_done == 10
+    # the shrink drain-saved synchronously at the reclaim step
+    assert (4, True) in trainer._saves
+
+    records = read_ledger(led.path)
+    assert len(split_runs(records)) == 1, "a shrink is NOT a run boundary"
+    assert unavailability_windows(records) == []
+    degraded = [r for r in records if r.get("phase") == "degraded"]
+    assert len(degraded) == 1
+    d = degraded[0]
+    assert d["devices_before"] == 8 and d["devices_after"] == 4
+    assert d["seconds_lost"] == pytest.approx(d["duration_s"] * 0.5)
+    assert d["duration_s"] > 0
+    s = summarize(records)
+    # summarize charges the PRICED loss, not the raw duration (the steps
+    # already booked their wall time as goodput)
+    assert s["badput_s"]["degraded"] == pytest.approx(d["seconds_lost"])
+    assert s["runs"] == 1
+
+
+def test_elastic_reshard_e2e_matches_cold_start(tmp_path):
+    """The CPU-only reshard e2e on the real JAX trainer: 3 steps on the
+    8-device virtual mesh, reclaim 4 chips, resume on the re-derived
+    4-device mesh for 3 more steps — step continuity, no stall, and the
+    final state is numerically identical to a cold start that restores
+    the same checkpoint on 4 devices and consumes the same batches."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest pins the 8-device virtual mesh"
+    cfg = LlamaConfig.tiny()
+
+    def batch(i):
+        return jax.random.randint(jax.random.PRNGKey(1000 + i), (8, 17),
+                                  0, cfg.vocab_size, dtype=jnp.int32)
+
+    def batches():
+        i = 0
+        while True:
+            i += 1
+            yield batch(i)
+
+    ckpt = str(tmp_path / "ckpt")
+    led = GoodputLedger(os.path.join(ckpt, "goodput.jsonl"))
+    trainer = CheckpointingTrainer(
+        cfg, ckpt, mesh=make_mesh(devices=devices),
+        checkpoint_interval=100, ledger=led, metrics_sync_every=2,
+        elastic=True)
+    state = trainer.init_or_resume(jax.random.PRNGKey(0))
+
+    calls = {"n": 0}
+
+    def reclaim():
+        calls["n"] += 1
+        if calls["n"] == 4:  # after 3 completed steps
+            return ReclaimNotice(surviving_devices=devices[:4])
+        return None
+
+    losses = []
+    result = trainer.run(
+        state, batches(), num_steps=6, reclaim_signal=reclaim,
+        on_step=lambda s, m: losses.append((s, float(m["loss"]))))
+    trainer.close()
+    led.close()
+
+    assert not result.preempted, "elastic mode must not stall or exit"
+    assert result.reshards == 1 and result.device_count == 4
+    assert result.steps_done == 6
+    assert int(result.state.step) == 6
+    assert [s for s, _ in losses] == [1, 2, 3, 4, 5, 6], \
+        "step continuity across the shrink"
+    records = read_ledger(led.path)
+    assert len(split_runs(records)) == 1
+    degraded = [r for r in records if r.get("phase") == "degraded"]
+    assert degraded and degraded[0]["devices_before"] == 8
+    assert degraded[0]["devices_after"] == 4
+    assert any(r.get("phase") == "ckpt_restore" for r in records)
+
+    # cold start: restore the SAME checkpoint (step 3, the shrink save)
+    # on a fresh 4-device trainer and consume the same batches 4..6
+    trainer2 = CheckpointingTrainer(cfg, ckpt,
+                                    mesh=make_mesh(devices=devices[:4]),
+                                    checkpoint_interval=100)
+    state2 = trainer2.init_or_resume(jax.random.PRNGKey(9))
+    assert int(state2.step) == 3
+
+    def batches_from(start):
+        i = start
+        while True:
+            yield batch(i)
+            i += 1
+
+    cold_losses = []
+    result2 = trainer2.run(
+        state2, batches_from(4), num_steps=3,
+        on_step=lambda s, m: cold_losses.append((s, float(m["loss"]))))
+    trainer2.close()
+    assert result2.steps_done == 3
+
+    # identical results: the elastic post-shrink steps ARE the cold
+    # start's steps (same restored params, same mesh, same batches)
+    elastic_tail = dict(losses)[4], dict(losses)[5], dict(losses)[6]
+    cold_tail = dict(cold_losses)[4], dict(cold_losses)[5], \
+        dict(cold_losses)[6]
+    assert elastic_tail == pytest.approx(cold_tail, rel=1e-5)
+    final_a = jax.tree_util.tree_leaves(result.state.params)
+    final_b = jax.tree_util.tree_leaves(result2.state.params)
+    for a, b in zip(final_a, final_b):
+        assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6), \
+            "elastic resume diverged from the from-checkpoint cold start"

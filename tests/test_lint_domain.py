@@ -15,8 +15,8 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
-from lint import (jax_hygiene, layering, lock_discipline, obs_check,  # noqa: E402
-                  state_machine)
+from lint import (chaos_check, jax_hygiene, layering, lock_discipline,  # noqa: E402
+                  obs_check, state_machine)
 from lint.registry import REGISTRY  # noqa: E402
 
 
@@ -35,12 +35,12 @@ def codes(findings):
 def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
-            "obs-journey", "obs-attribution", "obs-slo",
+            "obs-journey", "obs-attribution", "obs-slo", "chaos-closure",
             "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
-            "LCK003", "STM001", "OBS001", "OBS002", "OBS003", "ARC001"} \
-        <= set(all_codes)
+            "LCK003", "STM001", "OBS001", "OBS002", "OBS003", "CHS001",
+            "ARC001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
@@ -662,6 +662,92 @@ def test_obs003_non_slo_help_entries_stay_exempt(tmp_path):
             '    "tpu_operator_some_new_histogram": "fine",\n'
             '    "tpu_operator_alert_firing":')})
     assert obs_check.run_slo(root) == []
+
+
+# ------------------------------------- CHS001 (chaos catalog, mutated)
+
+CHS_FILES = [chaos_check.FAULTS_PATH, chaos_check.SCENARIO_PATH,
+             chaos_check.INVARIANTS_PATH]
+
+
+def _chs_root(tmp_path, mutate=None):
+    root = tmp_path / "repo_chs"
+    for rel in CHS_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_chs001_real_repo_files_pass(tmp_path):
+    assert chaos_check.run_project(_chs_root(tmp_path)) == []
+
+
+def test_chs001_real_repo_passes():
+    assert chaos_check.run_project(REPO) == []
+
+
+def test_chs001_repo_without_chaos_package_is_silent(tmp_path):
+    assert chaos_check.run_project(tmp_path) == []
+
+
+def test_chs001_new_fault_without_parser_and_coverage_fails(tmp_path):
+    """Adding a fault type the parsers/coverage don't know must fail
+    naming the fault from BOTH directions."""
+    root = _chs_root(tmp_path, mutate={
+        chaos_check.FAULTS_PATH: lambda s: s.replace(
+            '    "spot-reclaim",',
+            '    "spot-reclaim",\n    "power-cut",')})
+    findings = chaos_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "CHS001" for (_, _, c, _) in findings)
+    assert "power-cut" in msgs
+    assert "no scenario parser" in msgs
+    assert "no FAULT_COVERAGE entry" in msgs
+
+
+def test_chs001_dropped_parser_fails_naming_fault(tmp_path):
+    root = _chs_root(tmp_path, mutate={
+        chaos_check.SCENARIO_PATH: lambda s: s.replace(
+            '    "watch-lag": _parse_watch_lag,\n', '')})
+    findings = chaos_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "watch-lag" in msgs and "no scenario parser" in msgs
+
+
+def test_chs001_stale_coverage_key_fails(tmp_path):
+    root = _chs_root(tmp_path, mutate={
+        chaos_check.INVARIANTS_PATH: lambda s: s.replace(
+            '    "spot-reclaim": ("attribution", "event-dedup"),',
+            '    "spot-reclaim": ("attribution", "event-dedup"),\n'
+            '    "meteor-strike": ("budget",),')})
+    findings = chaos_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "meteor-strike" in msgs and "no FAULT_TYPES member" in msgs
+
+
+def test_chs001_unknown_invariant_name_fails(tmp_path):
+    root = _chs_root(tmp_path, mutate={
+        chaos_check.INVARIANTS_PATH: lambda s: s.replace(
+            '"conflict-storm": ("budget", "journey"),',
+            '"conflict-storm": ("budget", "vibes"),')})
+    findings = chaos_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "vibes" in msgs and "unknown invariant" in msgs
+
+
+def test_chs001_orphan_invariant_fails(tmp_path):
+    """An invariant no fault stresses is a checker that rots silently."""
+    root = _chs_root(tmp_path, mutate={
+        chaos_check.INVARIANTS_PATH: lambda s: s.replace(
+            '    "attribution",\n)',
+            '    "attribution",\n    "entropy",\n)')})
+    findings = chaos_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "entropy" in msgs and "stressed by no fault" in msgs
 
 
 # ------------------------------------------------- ARC001 (fake packages)

@@ -432,6 +432,52 @@ def test_failed_node_stays_failed_when_pod_not_ready(cluster, keys, clock):
     assert node_state(cluster, keys, "node0") == UpgradeState.FAILED
 
 
+def test_failed_node_with_recovered_outdated_pod_restarts_it(cluster, keys,
+                                                             clock):
+    """Chaos-campaign regression: a FAILED node whose pod RECOVERED from
+    its crashloop (ready, restarts below threshold) but is still at the
+    OLD revision used to wedge forever — nothing restarted the pod. The
+    failed handler now restarts exactly that pod, so the node walks
+    in-sync → uncordon on the following passes."""
+    setup_fleet(cluster, 1)
+    cluster.bump_daemonset_revision("driver", NS, "rev-2")
+    # recovered: ready again, restart count under the failure threshold
+    cluster.set_pod_status(NS, "driver-node0", ready=True, restart_count=3)
+    cluster.client.patch_node_metadata(
+        "node0", labels={keys.state_label: UpgradeState.FAILED})
+    cluster.flush_cache()
+    mgr = make_manager(cluster, keys, clock)
+    state = mgr.build_state(NS, DRIVER_LABELS)
+    mgr.process_upgrade_failed_nodes(state)
+    # the outdated pod was deleted; the DS controller recreates at rev-2
+    assert not [p for p in cluster.client.direct().list_pods(namespace=NS)
+                if p.metadata.name == "driver-node0"]
+    assert node_state(cluster, keys, "node0") == UpgradeState.FAILED
+    cluster.reconcile_daemonsets()
+    state = mgr.build_state(NS, DRIVER_LABELS)
+    mgr.process_upgrade_failed_nodes(state)
+    assert node_state(cluster, keys, "node0") == UpgradeState.UNCORDON_REQUIRED
+
+
+def test_failed_node_with_still_failing_pod_keeps_manual_contract(
+        cluster, keys, clock):
+    """A pod still crashlooping past the threshold is NOT auto-deleted —
+    the reference's manual-intervention semantics stand (auto-restarting
+    a persistent crashloop would retry forever)."""
+    setup_fleet(cluster, 1)
+    cluster.bump_daemonset_revision("driver", NS, "rev-2")
+    cluster.set_pod_status(NS, "driver-node0", ready=False, restart_count=12)
+    cluster.client.patch_node_metadata(
+        "node0", labels={keys.state_label: UpgradeState.FAILED})
+    cluster.flush_cache()
+    mgr = make_manager(cluster, keys, clock)
+    state = mgr.build_state(NS, DRIVER_LABELS)
+    mgr.process_upgrade_failed_nodes(state)
+    assert [p for p in cluster.client.direct().list_pods(namespace=NS)
+            if p.metadata.name == "driver-node0"], "pod must NOT be deleted"
+    assert node_state(cluster, keys, "node0") == UpgradeState.FAILED
+
+
 # -------------------------------------------------------------- uncordon
 
 

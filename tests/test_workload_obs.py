@@ -271,3 +271,60 @@ def test_status_goodput_without_node_needs_no_cluster(tmp_path, capsys):
     assert rc == 0
     text = capsys.readouterr().out
     assert "unavailability window" in text
+
+
+def test_status_watch_survives_transient_endpoint_failures(capsys):
+    """Satellite: --watch must outlive a metrics-endpoint outage — the
+    dashboard keeps the last good frame under a "STALE since" banner and
+    recovers when the endpoint returns, instead of traceback-exiting
+    mid-incident. One-shot mode still exits 2 for scripts."""
+    import types
+
+    status = _load_status()
+    frames = iter([
+        {"kind": "slo", "data": {"slos": [
+            {"name": "ttft", "target": 0.99, "window": "1h",
+             "error_budget_remaining": 0.5, "burn": []}],
+            "history": {}}},
+        ConnectionError("connection refused"),
+        ConnectionError("still down"),
+        {"kind": "slo", "data": {"slos": [
+            {"name": "ttft", "target": 0.99, "window": "1h",
+             "error_budget_remaining": 0.4, "burn": []}],
+            "history": {}}},
+    ])
+
+    def fetch(url, path):
+        if path == "/alerts":
+            return {"kind": "alerts", "data": []}
+        frame = next(frames)
+        if isinstance(frame, Exception):
+            raise frame
+        return frame
+
+    args = types.SimpleNamespace(
+        slo=True, alerts=False, watch=True, watch_interval=0.0,
+        watch_count=4, as_json=False, operator_url="http://op:8080")
+    rc = status.run_slo_view(args, fetch=fetch, sleep=lambda s: None,
+                             now=lambda: 1_700_000_000.0)
+    assert rc == 0
+    out = capsys.readouterr().out
+    frames_out = out.split("\x1b[2J\x1b[H")[1:]
+    assert len(frames_out) == 4
+    # frames 2 and 3 are stale: banner + the LAST GOOD data still shown
+    for stale in frames_out[1:3]:
+        assert "STALE since" in stale and "connection refused" in stale \
+            or "still down" in stale
+        assert "ttft" in stale, "last good frame must remain visible"
+    # recovery drops the banner
+    assert "STALE" not in frames_out[3]
+    assert "STALE" not in frames_out[0]
+
+    # one-shot mode keeps the hard failure for scripts
+    args2 = types.SimpleNamespace(
+        slo=True, alerts=False, watch=False, watch_interval=0.0,
+        watch_count=0, as_json=False, operator_url="http://op:8080")
+    rc2 = status.run_slo_view(
+        args2, fetch=lambda u, p: (_ for _ in ()).throw(
+            ConnectionError("down")), sleep=lambda s: None)
+    assert rc2 == 2

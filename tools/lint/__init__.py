@@ -14,6 +14,7 @@ Passes:
 - :mod:`.lock_discipline` — LCK001–LCK003 threading lock invariants
 - :mod:`.state_machine`   — STM001 upgrade-state-machine exhaustiveness
 - :mod:`.obs_check`       — OBS001 journey threshold closure + choke point
+- :mod:`.chaos_check`     — CHS001 chaos fault-catalog closure
 - :mod:`.layering`        — ARC001 import layering + cycle rejection
 
 Usage::
@@ -37,7 +38,7 @@ from pathlib import Path
 from typing import List
 
 from .registry import REGISTRY, Check, FileContext, all_codes, register
-from . import core, jax_hygiene, lock_discipline, state_machine, obs_check, layering  # noqa: F401  (registration imports)
+from . import core, jax_hygiene, lock_discipline, state_machine, obs_check, chaos_check, layering  # noqa: F401  (registration imports)
 from .core import BUILTINS, Checker, Scope  # noqa: F401  (compat re-exports)
 
 __all__ = ["lint_file", "lint_project", "main", "REGISTRY", "Check",

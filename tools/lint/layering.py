@@ -53,6 +53,10 @@ LAYERS: Dict[str, Set[str]] = {
     "upgrade": {"core", "utils", "api", "obs"},
     "health": {"core", "utils", "api", "upgrade", "obs"},
     "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs"},
+    # chaos sits at the TOP of the operator spine: it drives the whole
+    # stack (operator, electors, health, SLO) under injected faults and
+    # asserts cross-layer invariants — nothing below may import it back
+    "chaos": {"core", "utils", "api", "upgrade", "health", "tpu", "obs"},
     "data": {"utils"},
     "ops": {"utils"},
     # obs sits below BOTH spines: the workload side (goodput ledger,
