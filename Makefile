@@ -12,8 +12,8 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-chaos health-sim chaos lint lint-domain cov-report \
-  cov-artifact bench bench-decode dryrun apply-crds-dry clean \
+  test-obs-slo test-chaos health-sim chaos lint lint-domain lint-smoke \
+  cov-report cov-artifact bench bench-decode dryrun apply-crds-dry clean \
   $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
@@ -61,8 +61,17 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
-lint-domain:  ## domain-aware passes: JAX001-004 jit hygiene, LCK001-003 lock discipline, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, ARC001 import layering (docs/static-analysis.md)
-	$(PYTHON) -m tools.lint --domain
+# LINT_FLAGS lets CI ask for inline annotations: make lint-domain
+# LINT_FLAGS="--format github". All passes run in parallel off ONE shared
+# ProjectIndex parse per file (tools/lint/index.py).
+LINT_FLAGS ?=
+
+lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, ARC001 import layering (docs/static-analysis.md)
+	$(PYTHON) -m tools.lint --domain $(LINT_FLAGS)
+
+LINT_BUDGET ?= 60
+lint-smoke:  ## parse-once engine runtime gate: the FULL suite (generic + domain, every cross-module pass) must finish inside LINT_BUDGET seconds — a regression to O(passes x files) re-parsing trips this long before it hurts CI
+	timeout $(LINT_BUDGET) $(PYTHON) -m tools.lint --format json > /dev/null
 
 COV_MIN ?= 80
 

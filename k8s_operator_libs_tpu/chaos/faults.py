@@ -32,6 +32,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List
 
+from ..wire import RECLAIM_DEADLINE_ANNOTATION, RECLAIM_TAINT_KEY
+
 # the closed fault-type enum — CHS001 keeps scenario parsers and the
 # invariant coverage map closed over this tuple in both directions
 FAULT_TYPES = (
@@ -50,10 +52,14 @@ FAULT_TYPES = (
 # injector playing it) taints the node and stamps the absolute deadline
 # (wall seconds) after which the chips disappear. The workload side
 # (train/harness.py elastic mode, the campaign's simulated job) watches
-# for the taint and must be checkpointed before the deadline.
-RECLAIM_TAINT_KEY = "tpu.dev/spot-reclaim"
+# for the taint and must be checkpointed before the deadline. The KEYS
+# live in the wire registry (k8s_operator_libs_tpu/wire.py, WIRE001);
+# re-exported here because they are part of this package's fault
+# contract surface.
 RECLAIM_TAINT_EFFECT = "NoSchedule"
-RECLAIM_DEADLINE_ANNOTATION = "tpu.dev/spot-reclaim-deadline"
+
+__all__ = ["FAULT_TYPES", "FaultEvent", "RECLAIM_DEADLINE_ANNOTATION",
+           "RECLAIM_TAINT_EFFECT", "RECLAIM_TAINT_KEY"]
 
 
 @dataclasses.dataclass

@@ -44,9 +44,9 @@ from __future__ import annotations
 import copy
 import logging
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils.clock import Clock, RealClock
 from .client import Client, NotFoundError, WatchError
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
 
@@ -84,12 +84,16 @@ class _Informer:
                  watch_fn: Callable[..., object],
                  watch_window_seconds: float,
                  cache_lag: float = 0.0,
-                 event_hook: Optional[Callable] = None):
+                 event_hook: Optional[Callable] = None,
+                 clock: Optional[Clock] = None):
         self.kind = kind
         self._list_fn = list_fn
         self._watch_fn = watch_fn
         self._window = watch_window_seconds
         self._cache_lag = cache_lag
+        # injected so the watch-lag chaos fault replays deterministically
+        # under a FakeClock (DET001: no bare sleeps in the library)
+        self._clock = clock or RealClock()
         self.event_hook = event_hook  # called AFTER an event is applied
         self._store: Dict[_Key, object] = {}
         self._rv: Optional[str] = None  # watch resume point; None → re-list
@@ -148,7 +152,7 @@ class _Informer:
                                         or self._rv)
                         continue
                     if self._cache_lag:
-                        time.sleep(self._cache_lag)
+                        self._clock.sleep(self._cache_lag)
                     self._apply(etype, obj)
                     # adopt event RVs as resume points ONLY when the
                     # baseline came from a LIST that reported one —
@@ -226,13 +230,15 @@ class CachedClient(Client):
     def __init__(self, live: Client,
                  namespaces: Optional[List[str]] = None,
                  watch_window_seconds: float = 30.0,
-                 cache_lag: float = 0.0):
+                 cache_lag: float = 0.0,
+                 clock: Optional[Clock] = None):
         """``namespaces`` scopes the Pod and DaemonSet informers: one
         informer pair per namespace, so a shared cluster's unrelated pods
         never enter the store (the reference consumer scopes its cache the
         same way via manager.Options.Namespace). None = cluster-wide."""
         self._live = live
         self._started = False
+        self._clock = clock or RealClock()
         self._namespaces = sorted(set(namespaces)) if namespaces else [None]
         # prefer the *_with_rv list forms: they return the collection
         # resourceVersion the watch resumes from (one LIST per informer
@@ -243,19 +249,20 @@ class CachedClient(Client):
                           live.list_daemonsets)
         self._informers: List[_Informer] = [
             _Informer("Node", list_nodes, live.watch_nodes,
-                      watch_window_seconds, cache_lag)]
+                      watch_window_seconds, cache_lag,
+                      clock=self._clock)]
         for ns in self._namespaces:
             self._informers.append(_Informer(
                 "Pod",
                 lambda ns=ns: list_pods(namespace=ns),
                 lambda ns=ns, **kw: live.watch_pods(namespace=ns, **kw),
-                watch_window_seconds, cache_lag))
+                watch_window_seconds, cache_lag, clock=self._clock))
             self._informers.append(_Informer(
                 "DaemonSet",
                 lambda ns=ns: list_ds(namespace=ns),
                 lambda ns=ns, **kw: live.watch_daemonsets(namespace=ns,
                                                           **kw),
-                watch_window_seconds, cache_lag))
+                watch_window_seconds, cache_lag, clock=self._clock))
 
     def set_event_hook(self, hook: Optional[Callable]) -> None:
         """``hook(kind, etype, obj)`` fires after each watch event lands in
@@ -271,9 +278,9 @@ class CachedClient(Client):
         (mgr.GetCache().WaitForCacheSync analog)."""
         for inf in self._informers:
             inf.start()
-        deadline = time.monotonic() + sync_timeout
+        deadline = self._clock.now() + sync_timeout
         for inf in self._informers:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self._clock.now()
             if not inf.wait_synced(max(remaining, 0.0)):
                 self.stop()
                 raise TimeoutError(

@@ -520,9 +520,12 @@ class _Handler(BaseHTTPRequestHandler):
                         write_line({"type": etype,
                                     "object": _TO_JSON[kind](obj)})
             replayed_past = max_seen
-            deadline = _time.monotonic() + timeout
+            # det: allow — a REAL HTTP long-poll deadline on a live
+            # socket thread; the chaos-replayed surface drives the
+            # client boundary, never this server loop
+            deadline = _time.monotonic() + timeout  # det: allow — real socket deadline
             while True:
-                remaining = deadline - _time.monotonic()
+                remaining = deadline - _time.monotonic()  # det: allow — real socket deadline
                 if remaining <= 0:
                     break
                 try:

@@ -70,9 +70,13 @@ class KubeConfig:
             self.token, self.token_expiry = _run_exec_plugin(self.exec_cfg)
 
     def token_expired(self) -> bool:
+        # Genuine wall time: the expiry races a real-world OAuth deadline
+        # issued by the credential plugin, not any simulated timeline — an
+        # injected FakeClock here would stop refresh against a live
+        # apiserver. 60 s slack covers the request's flight time.
         import time as _time
         return (self.token_expiry is not None
-                and _time.time() >= self.token_expiry - 60.0)  # 60 s slack
+                and _time.time() >= self.token_expiry - 60.0)  # det: allow — real OAuth token expiry
 
     @classmethod
     def from_kubeconfig(cls, path: Optional[str] = None,
@@ -514,7 +518,7 @@ class LiveClient(Client):
         LiveEventRecorder: a time_ns suffix never collides across recorder
         restarts (the --once Job case)."""
         import time as _time
-        uid = f"{_time.time_ns():x}"
+        uid = f"{_time.time_ns():x}"  # det: allow — cross-restart unique Event name
         name = (f"{event.object_name or 'obj'}."
                 f"{(event.reason or 'event').lower()}.{uid}")
         body = {
@@ -597,7 +601,7 @@ class LiveEventRecorder:
         # unique across drain threads AND process restarts (client-go's
         # recorder uses a timestamp suffix for the same reason): a reused
         # name would 409 against Events persisted from a prior --once run
-        uid = f"{_time.time_ns():x}.{next(self._seq)}"
+        uid = f"{_time.time_ns():x}.{next(self._seq)}"  # det: allow — cross-restart unique Event name
         body = {
             "apiVersion": "v1", "kind": "Event",
             "metadata": {"name": f"{name}.{reason.lower()}.{uid}",

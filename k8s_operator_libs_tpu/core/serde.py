@@ -20,6 +20,8 @@ import calendar
 import time
 from typing import Dict, List, Optional
 
+from ..utils.clock import Clock, RealClock
+
 from .objects import (ContainerStatus, ControllerRevision, DaemonSet,
                       DaemonSetStatus, Job, JobStatus, Lease, LeaseSpec, Node,
                       NodeCondition, NodeSpec, NodeStatus, ObjectMeta,
@@ -27,6 +29,20 @@ from .objects import (ContainerStatus, ControllerRevision, DaemonSet,
                       Service, ServicePort, ServiceSpec, Taint, Volume)
 
 RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
+
+# The creationTimestamp fallback clock (a real apiserver always sends the
+# field; synthetic payloads may not). Injectable so a FakeClock-driven
+# harness parses to deterministic metadata — chaos replay (DET001) must
+# never read ambient wall time through this module.
+_clock: Clock = RealClock()
+
+
+def set_default_clock(clock: Clock) -> Clock:
+    """Swap the module's fallback clock (tests / chaos harness); returns
+    the previous one so callers can restore it."""
+    global _clock
+    prev, _clock = _clock, clock
+    return prev
 
 
 def _ts_to_rfc3339(ts: Optional[float]) -> Optional[str]:
@@ -103,7 +119,7 @@ def meta_from_json(j: Dict) -> ObjectMeta:
                            controller=bool(o.get("controller", False)))
             for o in j.get("ownerReferences") or []],
         creation_timestamp=_rfc3339_to_ts(j.get("creationTimestamp"))
-        or time.time(),
+        or _clock.wall(),
         deletion_timestamp=_rfc3339_to_ts(j.get("deletionTimestamp")),
         generation=j.get("generation", 1),
     )

@@ -54,38 +54,39 @@ class HealthVerdict:
         return out
 
 
-DOMAIN = "tpu.dev"
+# The key constants themselves live in the wire-key registry
+# (k8s_operator_libs_tpu/wire.py) — WIRE001 keeps the repo closed over
+# it, so no `.dev/` key may be spelled (or constructed) here. Re-exported
+# for the health package's historical import surface; see wire.py for
+# each key's semantics:
+# - VERDICT_LABEL carries the current non-healthy verdict (removed while
+#   healthy, so an idle fleet generates zero label churn; cmd/status.py
+#   renders "-" for both "healthy" and "health subsystem never ran");
+# - the quarantine trio: label (verdict that caused it), NoSchedule taint
+#   (belt-and-braces next to the cordon), reason annotation, and the
+#   pre-quarantine-cordon marker (the initial-state idiom of
+#   upgrade/upgrade_state.py applied to the health subsystem);
+# - repair bookkeeping keys store wall time so the backoff survives
+#   operator restarts — utils/clock.py ``Clock.wall``, never a bare
+#   time.time();
+# - signal-source annotations a node agent maintains; all optional — a
+#   fleet without an agent simply has fewer probes firing.
+from ..wire import (DOMAIN, HBM_ECC_ERRORS_ANNOTATION,
+                    HEARTBEAT_ANNOTATION, ICI_LINK_ERRORS_ANNOTATION,
+                    PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
+                    QUARANTINE_REASON_ANNOTATION, QUARANTINE_TAINT_KEY,
+                    REPAIR_ANNOTATION, REPAIR_ATTEMPTS_ANNOTATION,
+                    REPAIR_LAST_ANNOTATION, VERDICT_LABEL)
 
-# Label carrying the current non-healthy verdict (removed while healthy, so
-# an idle fleet generates zero label churn; cmd/status.py renders "-" for
-# both "healthy" and "health subsystem never ran").
-VERDICT_LABEL = f"{DOMAIN}/health"
+QUARANTINE_TAINT_EFFECT = "NoSchedule"  # an effect, not a key: stays here
+REPAIR_PENDING = "pending"              # annotation value, likewise
 
-# Quarantine marker trio: label (verdict that caused it), NoSchedule taint
-# (belt-and-braces next to the cordon — tolerating workloads must still not
-# land on a sick slice), and a human-readable reason annotation.
-QUARANTINE_LABEL = f"{DOMAIN}/health-quarantine"
-QUARANTINE_TAINT_KEY = f"{DOMAIN}/health-quarantine"
-QUARANTINE_TAINT_EFFECT = "NoSchedule"
-QUARANTINE_REASON_ANNOTATION = f"{DOMAIN}/health.quarantine-reason"
-# Set when the node was ALREADY unschedulable at quarantine time (an admin's
-# maintenance cordon, or an in-flight upgrade): lifting quarantine must not
-# remove a cordon it did not create — the initial-state idiom of
-# upgrade/upgrade_state.py applied to the health subsystem.
-PRE_QUARANTINE_CORDON_ANNOTATION = f"{DOMAIN}/health.pre-quarantine-cordon"
-
-# Repair bookkeeping: the in-flight marker, the attempt counter feeding
-# exponential backoff, and the wall-clock stamp of the last injection
-# (wall time so the backoff survives operator restarts — utils/clock.py
-# ``Clock.wall``, never a bare time.time()).
-REPAIR_ANNOTATION = f"{DOMAIN}/health.repair"
-REPAIR_PENDING = "pending"
-REPAIR_ATTEMPTS_ANNOTATION = f"{DOMAIN}/health.repair-attempts"
-REPAIR_LAST_ANNOTATION = f"{DOMAIN}/health.repair-last"
-
-# Signal-source annotations a node agent (device-plugin sidecar, DaemonSet)
-# is expected to maintain; all optional — a fleet without an agent simply
-# has fewer probes firing.
-HEARTBEAT_ANNOTATION = f"{DOMAIN}/health.heartbeat"        # wall-clock seconds
-ICI_LINK_ERRORS_ANNOTATION = f"{DOMAIN}/health.ici-link-errors"  # cumulative
-HBM_ECC_ERRORS_ANNOTATION = f"{DOMAIN}/health.hbm-ecc-errors"    # cumulative
+__all__ = [
+    "DOMAIN", "HBM_ECC_ERRORS_ANNOTATION", "HEARTBEAT_ANNOTATION",
+    "HealthVerdict", "ICI_LINK_ERRORS_ANNOTATION",
+    "PRE_QUARANTINE_CORDON_ANNOTATION", "QUARANTINE_LABEL",
+    "QUARANTINE_REASON_ANNOTATION", "QUARANTINE_TAINT_EFFECT",
+    "QUARANTINE_TAINT_KEY", "REPAIR_ANNOTATION",
+    "REPAIR_ATTEMPTS_ANNOTATION", "REPAIR_LAST_ANNOTATION",
+    "REPAIR_PENDING", "VERDICT_LABEL",
+]

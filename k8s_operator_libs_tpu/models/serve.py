@@ -536,7 +536,7 @@ class ContinuousBatcher:
             self.params, self._k, self._v, jnp.asarray(self._table),
             jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
         self._k, self._v = k, v
-        toks = np.asarray(toks)              # [n, slots]
+        toks = np.asarray(toks)  # syn: readback — the step's ONE sync; [n, slots]
         if self._metrics is not None:
             # the np.asarray readback above synchronized the device call,
             # so this is honest decode time; / n = inter-token latency
@@ -588,8 +588,8 @@ class ContinuousBatcher:
             jnp.asarray(self._lengths), jnp.asarray(self._last_tok))
         self._k, self._v = k, v
         self._dk, self._dv = dk, dv
-        slab = np.asarray(slab)              # [slots, k+1]
-        acc = np.asarray(acc)                # [slots]
+        slab = np.asarray(slab)  # syn: readback — the round's sync; [slots, k+1]
+        acc = np.asarray(acc)    # syn: readback — rides the same sync; [slots]
         decode_s = max(0.0, self._clock.now() - t_dev)
         finished = []
         emitted = 0

@@ -27,12 +27,11 @@ from typing import Dict, List, Optional
 from ..core.client import Client, ConflictError
 from ..core.objects import ObjectMeta, Pod
 from ..utils.clock import Clock, RealClock
+from ..wire import WORKLOAD_LABEL
 from .device_plugin import TPU_RESOURCE, pod_requests_tpu
 from .topology import SliceInfo, chips_per_host, slice_info_for_node
 
 logger = logging.getLogger(__name__)
-
-WORKLOAD_LABEL = "tpu.dev/workload"
 
 
 @dataclasses.dataclass

@@ -38,9 +38,10 @@ import logging
 import os
 import shutil
 import threading
-import time
 import uuid
 from typing import Optional
+
+from ..utils.clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
 
@@ -55,7 +56,8 @@ def _finalized_steps(root: str):
     return sorted((n for n in names if n.isdigit()), key=int)
 
 
-def mirror_once(local_dir: str, durable_dir: str) -> int:
+def mirror_once(local_dir: str, durable_dir: str,
+                clock: Optional[Clock] = None) -> int:
     """Copy every finalized local step not yet present in ``durable_dir``
     (atomically, via a staging dir + rename). Returns the number of steps
     mirrored. Usable standalone (a cron-style Job) or via the background
@@ -70,7 +72,7 @@ def mirror_once(local_dir: str, durable_dir: str) -> int:
     never read (finalized steps are all-digit names) and is swept by the
     next pass once it goes stale."""
     os.makedirs(durable_dir, exist_ok=True)
-    _sweep_stale_staging(durable_dir)
+    _sweep_stale_staging(durable_dir, clock=clock)
     done = set(_finalized_steps(durable_dir))
     mirrored = 0
     for step in _finalized_steps(local_dir):
@@ -121,11 +123,14 @@ def _newest_mtime(root: str) -> float:
     return newest
 
 
-def _sweep_stale_staging(durable_dir: str) -> None:
+def _sweep_stale_staging(durable_dir: str,
+                         clock: Optional[Clock] = None) -> None:
     """Remove crashed attempts' staging dirs once NOTHING in them has been
     written for _STALE_STAGING_SECONDS (bounded disk debris; a live copy —
-    however slow — keeps touching files and is never swept)."""
-    now = time.time()
+    however slow — keeps touching files and is never swept). Staleness is
+    judged against the injected clock's wall time, comparable with the
+    on-disk mtimes it is measured from."""
+    now = (clock or RealClock()).wall()
     try:
         names = os.listdir(durable_dir)
     except FileNotFoundError:
@@ -151,10 +156,12 @@ class CheckpointUploader:
     production relies on the DaemonSet simply outliving the drain."""
 
     def __init__(self, local_dir: str, durable_dir: str,
-                 poll_seconds: float = 1.0):
+                 poll_seconds: float = 1.0,
+                 clock: Optional[Clock] = None):
         self.local_dir = local_dir
         self.durable_dir = durable_dir
         self.poll_seconds = poll_seconds
+        self._clock = clock or RealClock()
         self._stop = threading.Event()
         self._idle = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -168,7 +175,8 @@ class CheckpointUploader:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                mirror_once(self.local_dir, self.durable_dir)
+                mirror_once(self.local_dir, self.durable_dir,
+                            clock=self._clock)
                 # idle = every finalized local step is durable
                 if set(_finalized_steps(self.local_dir)) <= set(
                         _finalized_steps(self.durable_dir)):
@@ -182,13 +190,13 @@ class CheckpointUploader:
 
     def wait_idle(self, timeout: float = 60.0) -> bool:
         """Block until the mirror has caught up (or timeout)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock.now() + timeout
+        while self._clock.now() < deadline:
             if (self._idle.is_set()
                     and set(_finalized_steps(self.local_dir))
                     <= set(_finalized_steps(self.durable_dir))):
                 return True
-            time.sleep(min(0.05, self.poll_seconds))
+            self._clock.sleep(min(0.05, self.poll_seconds))
         return False
 
     def stop(self) -> None:

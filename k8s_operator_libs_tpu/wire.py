@@ -1,0 +1,72 @@
+"""The wire-key registry: every ``tpu.dev/*`` cluster key, declared once.
+
+The operator's durable contract with the cluster is a set of label,
+annotation, and taint KEYS. They are wire format in the strictest sense:
+written by one subsystem, read by another (often in another process,
+after a restart, or by an external agent like the cloud's reclaim
+notifier), so a typo'd or privately-redefined key silently splits the
+contract in two. This module is the single place such a key may be
+spelled; everything else references the constant by name. The WIRE001
+lint pass (``tools/lint/wire_check.py``) closes the repo over this file
+in both directions — a ``.dev/`` literal anywhere else fires, and a key
+declared here that nothing references fires.
+
+Two deliberate exclusions:
+
+- the upgrade pipeline's ``{domain}/{component}-driver-upgrade…``
+  *templates* stay in ``upgrade/consts.py``: they are instance-scoped
+  (one process can manage several components via the ``KeyFactory``),
+  never spelled as full literals, and guarded by their own passes
+  (STM001/OBS001);
+- taint *effects* and annotation *values* (``NoSchedule``, ``pending``)
+  are not keys and live with their subsystems.
+
+Keys must be plain string literals here — WIRE001 reads this file with
+``ast`` only, so a computed key would be invisible to the closure proof.
+"""
+
+from __future__ import annotations
+
+# The domain every key lives under. Kept for consumers that filter keys
+# by prefix (e.g. `status.py` grouping tpu.dev annotations); keys below
+# spell it out in full so each constant is a self-contained literal.
+DOMAIN = "tpu.dev"
+
+# --------------------------------------------------------------- health
+# Fleet-health verdict surface (docs/fleet-health.md). The verdict label
+# carries the current non-healthy verdict; the quarantine trio marks a
+# slice pulled from scheduling (label = causing verdict, NoSchedule
+# taint, human-readable reason).
+VERDICT_LABEL = "tpu.dev/health"
+QUARANTINE_LABEL = "tpu.dev/health-quarantine"
+QUARANTINE_TAINT_KEY = "tpu.dev/health-quarantine"
+QUARANTINE_REASON_ANNOTATION = "tpu.dev/health.quarantine-reason"
+# Set when the node was ALREADY unschedulable at quarantine time: lifting
+# quarantine must not remove a cordon it did not create.
+PRE_QUARANTINE_CORDON_ANNOTATION = "tpu.dev/health.pre-quarantine-cordon"
+
+# Repair bookkeeping: in-flight marker, attempt counter feeding the
+# exponential backoff, wall-clock stamp of the last injection.
+REPAIR_ANNOTATION = "tpu.dev/health.repair"
+REPAIR_ATTEMPTS_ANNOTATION = "tpu.dev/health.repair-attempts"
+REPAIR_LAST_ANNOTATION = "tpu.dev/health.repair-last"
+
+# Signal-source annotations a node agent (device-plugin sidecar,
+# DaemonSet) maintains; all optional.
+HEARTBEAT_ANNOTATION = "tpu.dev/health.heartbeat"          # wall seconds
+ICI_LINK_ERRORS_ANNOTATION = "tpu.dev/health.ici-link-errors"  # cumulative
+HBM_ECC_ERRORS_ANNOTATION = "tpu.dev/health.hbm-ecc-errors"    # cumulative
+
+# ---------------------------------------------------------------- chaos
+# Spot/preemption reclaim notice: the cloud (or the chaos injector
+# playing it) taints the node and stamps the absolute deadline (wall
+# seconds) after which the chips disappear; the elastic trainer watches
+# for the taint and must be checkpointed before the deadline.
+RECLAIM_TAINT_KEY = "tpu.dev/spot-reclaim"
+RECLAIM_DEADLINE_ANNOTATION = "tpu.dev/spot-reclaim-deadline"
+
+# ------------------------------------------------------------------ tpu
+# Slice scheduler placement label: every pod of a placed workload (and
+# the workload's slice claim) carries it; the upgrade library's workload
+# deletion filter and wait-for-completion selector match on it.
+WORKLOAD_LABEL = "tpu.dev/workload"

@@ -1,9 +1,13 @@
-"""tools/lint domain passes — JAX001–JAX004 jit-hygiene, LCK001–LCK003
-lock discipline, STM001 state-machine exhaustiveness, ARC001 import
-layering. Every code must fire on its module's offender fixture and stay
-silent on the clean idiom; the cross-file passes are additionally proven
-on mutated copies of the real repo files (delete a handler / add a fake
-state → the pass fails naming exactly what is missing)."""
+"""tools/lint domain passes — JAX001–JAX004 jit-hygiene, LCK001–LCK004
+lock discipline + cross-function lock order, DET001/DET002 determinism,
+STM001 state-machine exhaustiveness, OBS001–OBS003 observability
+closure, CHS001 chaos-catalog closure, WIRE001 wire-key closure, SYN001
+host-sync hygiene, ARC001 import layering. Every code must fire on its
+module's offender fixture and stay silent on the clean idiom; the
+cross-file passes are additionally proven on mutated copies of the real
+repo files (delete a handler / add a fake state → the pass fails naming
+exactly what is missing). The parse-count spy pins the ProjectIndex
+engine to ONE parse per file per full run."""
 
 import subprocess
 import sys
@@ -15,8 +19,9 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
-from lint import (chaos_check, jax_hygiene, layering, lock_discipline,  # noqa: E402
-                  obs_check, state_machine)
+from lint import (chaos_check, determinism, jax_hygiene, layering,  # noqa: E402
+                  lock_discipline, lock_order, obs_check, state_machine,
+                  sync_check, wire_check)
 from lint.registry import REGISTRY  # noqa: E402
 
 
@@ -34,12 +39,14 @@ def codes(findings):
 
 def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
-    assert {"generic", "jax-hygiene", "lock-discipline", "state-machine",
-            "obs-journey", "obs-attribution", "obs-slo", "chaos-closure",
-            "import-layering"} <= names
+    assert {"generic", "jax-hygiene", "lock-discipline", "lock-order",
+            "determinism", "state-machine", "obs-journey",
+            "obs-attribution", "obs-slo", "chaos-closure", "wire-closure",
+            "sync-hygiene", "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
-            "LCK003", "STM001", "OBS001", "OBS002", "OBS003", "CHS001",
+            "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
+            "OBS002", "OBS003", "CHS001", "WIRE001", "SYN001",
             "ARC001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
@@ -849,3 +856,462 @@ def test_generic_mode_skips_domain_codes(tmp_path):
     f.write_text(lock_discipline.OFFENDERS["LCK002"])
     assert lint.lint_file(f, domain=False) == []
     assert codes(lint.lint_file(f, domain=True)) == ["LCK002"]
+
+
+# --------------------------------------- DET001/DET002 (package-scoped)
+
+def run_lint_pkg(tmp_path, source, name="case.py"):
+    """The determinism pass fires only inside the library package — place
+    the fixture under a package-shaped path."""
+    d = tmp_path / "k8s_operator_libs_tpu" / "core"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(source)
+    return lint.lint_file(f)
+
+
+def test_det_fixture_pairs_shipped():
+    assert set(determinism.OFFENDERS) == set(determinism.CODES)
+    assert set(determinism.CLEAN) == set(determinism.CODES)
+
+
+@pytest.mark.parametrize("code", sorted(determinism.CODES))
+def test_det_offenders_fire(code, tmp_path):
+    found = run_lint_pkg(tmp_path, determinism.OFFENDERS[code],
+                         name=f"off_{code.lower()}.py")
+    assert code in codes(found), found
+
+
+@pytest.mark.parametrize("code", sorted(determinism.CODES))
+def test_det_clean_fixtures_stay_silent(code, tmp_path):
+    found = run_lint_pkg(tmp_path, determinism.CLEAN[code],
+                         name=f"clean_{code.lower()}.py")
+    assert found == [], found
+
+
+def test_det_out_of_package_paths_out_of_scope(tmp_path):
+    """tests/tools/cmd/bench live outside the replayed surface — the same
+    source at a non-package path stays silent."""
+    f = tmp_path / "case.py"
+    f.write_text(determinism.OFFENDERS["DET001"])
+    assert lint.lint_file(f) == []
+
+
+def test_det_clock_module_itself_exempt(tmp_path):
+    d = tmp_path / "k8s_operator_libs_tpu" / "utils"
+    d.mkdir(parents=True)
+    f = d / "clock.py"
+    f.write_text("import time\n\n\ndef wall():\n    return time.time()\n")
+    assert lint.lint_file(f) == []
+
+
+def test_det_alias_and_hatch(tmp_path):
+    src = (
+        "import time as _t\n"
+        "\n"
+        "\n"
+        "def a():\n"
+        "    return _t.monotonic()\n"
+        "\n"
+        "\n"
+        "def b():\n"
+        "    return _t.time()  # det: allow — compared against file mtimes\n"
+    )
+    found = run_lint_pkg(tmp_path, src)
+    assert codes(found) == ["DET001"] and "_t.monotonic" in found[0]
+
+
+def test_det_real_repo_offenders_fixed():
+    """The PR's satellite: serde/cachedclient/uploader route through an
+    injected Clock, liveclient carries the documented hatch — the pass
+    runs clean over the whole package."""
+    pkg = REPO / "k8s_operator_libs_tpu"
+    det = [line for f in sorted(pkg.rglob("*.py"))
+           if "__pycache__" not in f.parts
+           for line in lint.lint_file(f)
+           if " DET00" in line]
+    assert det == [], det
+
+
+# ------------------------------------------------ LCK004 (scratch roots)
+
+def _pkg_root(tmp_path, files):
+    root = tmp_path / "lck4"
+    for rel, src in files.items():
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+LCK4_ABBA = {
+    "k8s_operator_libs_tpu/alpha.py": '''
+import threading
+
+A_LOCK = threading.Lock()
+B_LOCK = threading.Lock()
+
+
+def forward(registry):
+    with A_LOCK:
+        _grab_b(registry)
+
+
+def _grab_b(registry):
+    with B_LOCK:
+        registry["b"] = True
+
+
+def backward(registry):
+    with B_LOCK:
+        with A_LOCK:
+            registry["a"] = True
+''',
+}
+
+LCK4_CONSISTENT = {
+    "k8s_operator_libs_tpu/alpha.py": '''
+import threading
+
+A_LOCK = threading.Lock()
+B_LOCK = threading.Lock()
+
+
+def forward(registry):
+    with A_LOCK:
+        _grab_b(registry)
+
+
+def _grab_b(registry):
+    with B_LOCK:
+        registry["b"] = True
+
+
+def backward(registry):
+    with A_LOCK:
+        with B_LOCK:
+            registry["a"] = True
+''',
+}
+
+LCK4_TRANSITIVE_SLEEP = {
+    "k8s_operator_libs_tpu/beta.py": '''
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+def tick(state):
+    with LOCK:
+        _settle(state)
+
+
+def _settle(state):
+    time.sleep(1.0)
+    state["settled"] = True
+''',
+}
+
+LCK4_SLEEP_OUTSIDE = {
+    "k8s_operator_libs_tpu/beta.py": '''
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+def tick(state):
+    with LOCK:
+        snapshot = dict(state)
+    _settle(snapshot)
+
+
+def _settle(state):
+    time.sleep(1.0)
+    state["settled"] = True
+''',
+}
+
+LCK4_CROSS_MODULE_RPC = {
+    "k8s_operator_libs_tpu/gamma.py": '''
+import threading
+
+from .delta import refresh
+
+LOCK = threading.Lock()
+
+
+def snapshot(client, cache):
+    with LOCK:
+        refresh(client, cache)
+''',
+    "k8s_operator_libs_tpu/delta.py": '''
+def refresh(client, cache):
+    cache["nodes"] = client.list_nodes()
+''',
+}
+
+
+def test_lck004_abba_cycle_fires(tmp_path):
+    findings = lock_order.run_project(_pkg_root(tmp_path, LCK4_ABBA))
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "LCK004" for (_, _, c, _) in findings)
+    assert "lock-order cycle" in msgs
+    assert "alpha.A_LOCK" in msgs and "alpha.B_LOCK" in msgs
+
+
+def test_lck004_consistent_order_silent(tmp_path):
+    assert lock_order.run_project(_pkg_root(tmp_path, LCK4_CONSISTENT)) == []
+
+
+def test_lck004_transitive_sleep_fires(tmp_path):
+    findings = lock_order.run_project(
+        _pkg_root(tmp_path, LCK4_TRANSITIVE_SLEEP))
+    assert len(findings) == 1
+    rel, _, code, msg = findings[0]
+    assert code == "LCK004" and rel.endswith("beta.py")
+    assert "time.sleep" in msg and "tick -> _settle" in msg
+
+
+def test_lck004_sleep_outside_lock_silent(tmp_path):
+    assert lock_order.run_project(
+        _pkg_root(tmp_path, LCK4_SLEEP_OUTSIDE)) == []
+
+
+def test_lck004_cross_module_client_rpc_fires(tmp_path):
+    """The call graph crosses modules: gamma holds its lock across
+    delta.refresh, which does a client RPC."""
+    findings = lock_order.run_project(
+        _pkg_root(tmp_path, LCK4_CROSS_MODULE_RPC))
+    assert len(findings) == 1
+    assert "client.list_nodes" in findings[0][3]
+
+
+def test_lck004_real_repo_passes():
+    assert lock_order.run_project(REPO) == []
+
+
+# ------------------------------------------------ WIRE001 (scratch roots)
+
+WIRE_BASE = {
+    "k8s_operator_libs_tpu/wire.py": (
+        'DOMAIN = "tpu.dev"\n'
+        'FOO_LABEL = "tpu.dev/foo"\n'
+        'BAR_KEY = "tpu.dev/bar"\n'),
+    "k8s_operator_libs_tpu/user.py": (
+        'from .wire import BAR_KEY, FOO_LABEL\n'
+        '\n'
+        'PAIR = (FOO_LABEL, BAR_KEY)\n'),
+}
+
+
+def _wire_root(tmp_path, extra=None, registry=None):
+    files = dict(WIRE_BASE)
+    if registry is not None:
+        files["k8s_operator_libs_tpu/wire.py"] = registry
+    files.update(extra or {})
+    root = tmp_path / "wire"
+    for rel, src in files.items():
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_wire001_closed_root_silent(tmp_path):
+    assert wire_check.run_project(_wire_root(tmp_path)) == []
+
+
+def test_wire001_real_repo_passes():
+    assert wire_check.run_project(REPO) == []
+
+
+def test_wire001_registered_key_as_literal_fires(tmp_path):
+    findings = wire_check.run_project(_wire_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/rogue.py": 'K = "tpu.dev/foo"\n'}))
+    assert len(findings) == 1
+    rel, _, code, msg = findings[0]
+    assert code == "WIRE001" and rel.endswith("rogue.py")
+    assert "spelled as a literal" in msg
+
+
+def test_wire001_stray_unregistered_literal_fires(tmp_path):
+    findings = wire_check.run_project(_wire_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/rogue.py": 'K = "tpu.dev/zap"\n'}))
+    assert len(findings) == 1
+    assert "stray wire-key literal" in findings[0][3]
+
+
+def test_wire001_domain_fstring_construction_fires(tmp_path):
+    findings = wire_check.run_project(_wire_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/rogue.py": (
+            'from .wire import DOMAIN\n'
+            '\n'
+            'K = f"{DOMAIN}/zap"\n')}))
+    assert len(findings) == 1
+    assert "constructed from DOMAIN" in findings[0][3]
+
+
+def test_wire001_docstring_mentions_stay_silent(tmp_path):
+    assert wire_check.run_project(_wire_root(tmp_path, extra={
+        "k8s_operator_libs_tpu/prose.py": (
+            '"""Writes the tpu.dev/foo label (see wire.py)."""\n'
+            '\n'
+            '\n'
+            'def f():\n'
+            '    """Reads tpu.dev/bar back."""\n'
+            '    return None\n')})) == []
+
+
+def test_wire001_dead_registry_key_fires(tmp_path):
+    findings = wire_check.run_project(_wire_root(
+        tmp_path,
+        registry=('DOMAIN = "tpu.dev"\n'
+                  'FOO_LABEL = "tpu.dev/foo"\n'
+                  'BAR_KEY = "tpu.dev/bar"\n'
+                  'GHOST = "tpu.dev/ghost"\n')))
+    assert len(findings) == 1
+    rel, _, _, msg = findings[0]
+    assert rel.endswith("wire.py")
+    assert "GHOST" in msg and "referenced nowhere" in msg
+
+
+def test_wire001_missing_registry_fires(tmp_path):
+    root = tmp_path / "empty"
+    (root / "k8s_operator_libs_tpu").mkdir(parents=True)
+    findings = wire_check.run_project(root)
+    assert len(findings) == 1 and "registry module is missing" \
+        in findings[0][3]
+
+
+# ------------------------------------------------- SYN001 (mutated copies)
+
+SYN_FILES = list(sync_check.HOT_FUNCTIONS)
+
+
+def _syn_root(tmp_path, mutate=None):
+    root = tmp_path / "syn"
+    for rel in SYN_FILES:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+SERVE = "k8s_operator_libs_tpu/models/serve.py"
+HARNESS = "k8s_operator_libs_tpu/train/harness.py"
+
+
+def test_syn001_real_repo_files_pass(tmp_path):
+    assert sync_check.run_project(_syn_root(tmp_path)) == []
+
+
+def test_syn001_real_repo_passes():
+    assert sync_check.run_project(REPO) == []
+
+
+def test_syn001_unhatched_readback_fires(tmp_path):
+    """Stripping the `# syn: readback` mark off the batcher's deliberate
+    sync exposes it as an unaudited device->host transfer."""
+    root = _syn_root(tmp_path, mutate={
+        SERVE: lambda s: s.replace(
+            "toks = np.asarray(toks)  # syn: readback — the step's ONE "
+            "sync; [n, slots]",
+            "toks = np.asarray(toks)")})
+    findings = sync_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "SYN001" for (_, _, c, _) in findings)
+    assert "'toks'" in msgs and "_step_inner" in msgs
+
+
+def test_syn001_smuggled_sync_in_train_loop_fires(tmp_path):
+    """The PR 4 regression: host-syncing the step metrics inside the
+    loop instead of at the _block_on boundary."""
+    root = _syn_root(tmp_path, mutate={
+        HARNESS: lambda s: s.replace(
+            "            state, metrics = self._step_fn(state, batch)",
+            "            state, metrics = self._step_fn(state, batch)\n"
+            '            probe = float(metrics["loss"])')})
+    findings = sync_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "float()" in msgs and "'metrics'" in msgs and "run" in msgs
+
+
+def test_syn001_item_call_fires(tmp_path):
+    root = _syn_root(tmp_path, mutate={
+        HARNESS: lambda s: s.replace(
+            "            state, metrics = self._step_fn(state, batch)",
+            "            state, metrics = self._step_fn(state, batch)\n"
+            '            loss = metrics["loss"].item()')})
+    findings = sync_check.run_project(root)
+    assert any(".item()" in m for (_, _, _, m) in findings)
+
+
+def test_syn001_block_until_ready_outside_boundary_fires(tmp_path):
+    root = _syn_root(tmp_path, mutate={
+        HARNESS: lambda s: s.replace(
+            "            state, metrics = self._step_fn(state, batch)",
+            "            state, metrics = self._step_fn(state, batch)\n"
+            '            metrics["loss"].block_until_ready()')})
+    findings = sync_check.run_project(root)
+    assert any("block_until_ready" in m for (_, _, _, m) in findings)
+
+
+def test_syn001_renamed_hot_path_fails_config_drift(tmp_path):
+    """Renaming a guarded hot function without updating HOT_FUNCTIONS is
+    config drift — the pass says so instead of silently guarding
+    nothing."""
+    root = _syn_root(tmp_path, mutate={
+        SERVE: lambda s: s.replace("def _step_inner", "def _tick_inner")})
+    findings = sync_check.run_project(root)
+    assert any("not found" in m and "_step_inner" in m
+               for (_, _, _, m) in findings)
+
+
+# ------------------------------------- engine: parse counts, baseline
+
+def test_full_domain_run_parses_each_file_exactly_once():
+    """The ProjectIndex contract: a full --domain run — every file pass
+    plus all seven cross-module passes — parses each file ONCE. This is
+    the regression gate against sliding back to O(passes × files)."""
+    findings, index = lint.run_suite(mode="domain")
+    assert findings == [], findings[:5]
+    counts = index.parse_counts
+    assert counts, "the run parsed nothing?"
+    multi = {rel: n for rel, n in counts.items() if n != 1}
+    assert multi == {}, f"files parsed more than once: {multi}"
+    # the cross-module passes ran off the same index (their guarded files
+    # are in the count), and the run covered the whole tree
+    assert "k8s_operator_libs_tpu/upgrade/consts.py" in counts
+    assert "k8s_operator_libs_tpu/models/serve.py" in counts
+    assert len(counts) > 100
+
+
+def test_baseline_entry_forms(tmp_path):
+    missing = lint.load_baseline(tmp_path / "absent.txt")
+    assert missing == set()
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("# comment\n\npkg/x.py:DET001\npkg/y.py:7:LCK004\n")
+    entries = lint.load_baseline(bl)
+    assert lint._baselined(("pkg/x.py", 3, "DET001", "m"), entries)
+    assert lint._baselined(("pkg/x.py", 99, "DET001", "m"), entries)
+    assert lint._baselined(("pkg/y.py", 7, "LCK004", "m"), entries)
+    assert not lint._baselined(("pkg/y.py", 8, "LCK004", "m"), entries)
+    assert not lint._baselined(("pkg/x.py", 3, "DET002", "m"), entries)
+
+
+def test_format_json_and_github_emitters(capsys):
+    findings = [("a/b.py", 3, "DET001", "bare time.time(), use Clock")]
+    lint.emit(findings, "json")
+    out = capsys.readouterr().out
+    import json
+    assert json.loads(out) == [{"path": "a/b.py", "line": 3,
+                                "code": "DET001",
+                                "message": "bare time.time(), use Clock"}]
+    lint.emit(findings, "github")
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=a/b.py,line=3,title=DET001::")

@@ -1,9 +1,10 @@
 """tools.lint — the repo's static-analysis suite, stdlib-only.
 
-A check-registry plugin architecture (see :mod:`.registry`): each check
-module registers its codes and a run hook, and importing this package
-assembles the suite — the Python analog of the reference repo's
-golangci-lint config enabling ~50 linters from one file.
+A check-registry plugin architecture (see :mod:`.registry`) over a shared
+:class:`~.index.ProjectIndex`: the driver parses every file exactly once,
+every pass — file-scope and cross-module alike — consumes the index, and
+passes run in parallel off it (the parse-count spy test in
+tests/test_lint_domain.py pins the one-parse-per-file contract).
 
 Passes:
 
@@ -12,9 +13,15 @@ Passes:
                             E712/F632/F631/F602/W605/W0101/A001/A002)
 - :mod:`.jax_hygiene`     — JAX001–JAX004 jit purity / host-sync
 - :mod:`.lock_discipline` — LCK001–LCK003 threading lock invariants
+- :mod:`.lock_order`      — LCK004 cross-function lock-order cycles and
+                            blocking calls reached while a lock is held
+- :mod:`.determinism`     — DET001/DET002 injected-clock and seeded-RNG
+                            discipline (chaos seed replay depends on it)
 - :mod:`.state_machine`   — STM001 upgrade-state-machine exhaustiveness
-- :mod:`.obs_check`       — OBS001 journey threshold closure + choke point
+- :mod:`.obs_check`       — OBS001–OBS003 journey/attribution/SLO closure
 - :mod:`.chaos_check`     — CHS001 chaos fault-catalog closure
+- :mod:`.wire_check`      — WIRE001 wire-key registry closure
+- :mod:`.sync_check`      — SYN001 host-sync hygiene on the hot paths
 - :mod:`.layering`        — ARC001 import layering + cycle rejection
 
 Usage::
@@ -22,32 +29,48 @@ Usage::
     python tools/lint.py [paths...]        # everything (generic + domain)
     python -m tools.lint --generic [...]   # make lint
     python -m tools.lint --domain  [...]   # make lint-domain
+    python -m tools.lint --format github   # CI inline annotations
+    python -m tools.lint --format json     # machine-readable findings
 
-Exit 1 on any finding. Suppress a single finding by appending
-``# lint: ignore`` (or ``# noqa``) to its line. Project-scope passes
-(STM/ARC) run against the repo root whenever domain checks are enabled
-and no explicit path arguments narrow the run. docs/static-analysis.md
+Exit 1 on any non-baselined finding. Suppress a single finding by
+appending ``# lint: ignore`` (or ``# noqa``) to its line; park whole
+known-debt classes in ``tools/lint/baseline.txt`` (``--no-baseline``
+shows everything, ``--write-baseline`` regenerates the file from the
+current findings). Project-scope passes (STM/OBS/CHS/WIRE/SYN/LCK004/
+ARC) run against the repo root whenever domain checks are enabled and no
+explicit path arguments narrow the run. docs/static-analysis.md
 documents every code and how to add a check.
 """
 
 from __future__ import annotations
 
 import ast
+import json as _json
+import os
 import sys
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
-from typing import List
+from typing import List, Optional, Tuple
 
 from .registry import REGISTRY, Check, FileContext, all_codes, register
-from . import core, jax_hygiene, lock_discipline, state_machine, obs_check, chaos_check, layering  # noqa: F401  (registration imports)
+from .index import ProjectIndex, as_index
+from . import (core, jax_hygiene, lock_discipline, lock_order, determinism,  # noqa: F401,E501  (registration imports)
+               state_machine, obs_check, chaos_check, wire_check, sync_check,
+               layering)
 from .core import BUILTINS, Checker, Scope  # noqa: F401  (compat re-exports)
 
-__all__ = ["lint_file", "lint_project", "main", "REGISTRY", "Check",
-           "register", "all_codes", "Checker", "Scope", "BUILTINS"]
+__all__ = ["lint_file", "lint_project", "run_suite", "main", "REGISTRY",
+           "Check", "register", "all_codes", "Checker", "Scope", "BUILTINS",
+           "ProjectIndex", "as_index"]
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
 DEFAULT_TARGETS = ["k8s_operator_libs_tpu", "cmd", "tools", "tests",
                    "bench.py", "__graft_entry__.py"]
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.txt"
+
+Finding = Tuple[str, int, str, str]            # (path, lineno, code, msg)
 
 
 def _suppressed(lines: List[str], lineno: int) -> bool:
@@ -57,9 +80,15 @@ def _suppressed(lines: List[str], lineno: int) -> bool:
     return False
 
 
+# ------------------------------------------------------------ compat layer
+
 def lint_file(path: Path, domain: bool = True,
               generic: bool = True) -> List[str]:
-    """Run the file-scope checks over one file → formatted findings."""
+    """Run the file-scope checks over ONE file → formatted findings.
+
+    The single-file compatibility surface (fixture replay, the historical
+    ``python tools/lint.py file.py`` shim); suite runs go through
+    :func:`run_suite` and the shared ProjectIndex instead."""
     path = Path(path)
     source = path.read_text()
     try:
@@ -83,14 +112,14 @@ def lint_file(path: Path, domain: bool = True,
 
 def lint_project(root: Path = REPO_ROOT) -> List[str]:
     """Run the project-scope (cross-file) passes → formatted findings."""
-    root = Path(root)
+    index = as_index(Path(root))
     out: List[str] = []
     for check in REGISTRY:
         if check.scope != "project":
             continue
-        for rel, lineno, code, msg in check.run(root):
+        for rel, lineno, code, msg in check.run(index):
             try:
-                lines = (root / rel).read_text().splitlines()
+                lines = index.lines(rel)
             except OSError:
                 lines = []
             if _suppressed(lines, lineno):
@@ -99,10 +128,14 @@ def lint_project(root: Path = REPO_ROOT) -> List[str]:
     return sorted(out)
 
 
-def _collect(targets: List[str]) -> List[Path]:
+# ------------------------------------------------------------ suite driver
+
+def _collect(targets: List[str], base: Optional[Path] = None) -> List[Path]:
     files: List[Path] = []
     for t in targets:
         p = Path(t)
+        if base is not None and not p.is_absolute():
+            p = base / p
         if p.is_dir():
             files.extend(sorted(p.rglob("*.py")))
         elif p.suffix == ".py":
@@ -110,10 +143,116 @@ def _collect(targets: List[str]) -> List[Path]:
     return [f for f in files if "__pycache__" not in f.parts]
 
 
+def load_baseline(path: Path) -> set:
+    """Baseline entries: ``path:CODE`` (every finding of CODE in that
+    file) or ``path:lineno:CODE`` (one pinned finding). ``#`` comments
+    and blank lines are skipped."""
+    entries = set()
+    if not path.is_file():
+        return entries
+    for raw in path.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        entries.add(line)
+    return entries
+
+
+def _baselined(finding: Finding, baseline: set) -> bool:
+    rel, lineno, code, _ = finding
+    return (f"{rel}:{code}" in baseline
+            or f"{rel}:{lineno}:{code}" in baseline)
+
+
+def run_suite(paths: Optional[List[str]] = None, mode: str = "all",
+              root: Path = REPO_ROOT, jobs: Optional[int] = None
+              ) -> Tuple[List[Finding], ProjectIndex]:
+    """The engine: one ProjectIndex, every enabled pass run off it in a
+    thread pool. Returns (sorted findings before baseline filtering, the
+    index — whose ``parse_counts`` the spy test reads)."""
+    root = Path(root)
+    explicit = bool(paths)
+    files = (_collect(list(paths)) if explicit
+             else _collect(DEFAULT_TARGETS, base=root))
+    index = ProjectIndex(root, files=files)
+    domain = mode != "generic"
+    generic = mode != "domain"
+    file_checks = [c for c in REGISTRY if c.scope == "file"
+                   and (c.domain and domain or not c.domain and generic)]
+    project_checks = [c for c in REGISTRY if c.scope == "project" and domain]
+
+    def run_file(path: Path) -> List[Finding]:
+        rel = index.rel(path)
+        try:
+            ctx = index.context(rel)
+        except SyntaxError as exc:
+            return [(rel, exc.lineno or 0, "E999",
+                     f"syntax error: {exc.msg}")]
+        out: List[Finding] = []
+        for check in file_checks:
+            out.extend((rel, lineno, code, msg)
+                       for lineno, code, msg in check.run(ctx))
+        return out
+
+    def run_project_check(check: Check) -> List[Finding]:
+        return list(check.run(index))
+
+    workers = jobs or min(8, (os.cpu_count() or 2))
+    findings: List[Finding] = []
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        futures = [ex.submit(run_file, f) for f in files]
+        if not explicit:
+            futures += [ex.submit(run_project_check, c)
+                        for c in project_checks]
+        for fut in futures:
+            findings.extend(fut.result())
+
+    kept: List[Finding] = []
+    for finding in findings:
+        rel, lineno = finding[0], finding[1]
+        try:
+            lines = index.lines(rel)
+        except (OSError, SyntaxError):
+            lines = []
+        if not _suppressed(lines, lineno):
+            kept.append(finding)
+    return sorted(set(kept)), index
+
+
+# ---------------------------------------------------------------- emitters
+
+def _gh_escape(s: str, prop: bool = False) -> str:
+    s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if prop:
+        s = s.replace(":", "%3A").replace(",", "%2C")
+    return s
+
+
+def emit(findings: List[Finding], fmt: str) -> None:
+    if fmt == "json":
+        print(_json.dumps([{"path": p, "line": ln, "code": c, "message": m}
+                           for p, ln, c, m in findings], indent=2))
+    elif fmt == "github":
+        for p, ln, c, m in findings:
+            print(f"::error file={_gh_escape(p, prop=True)},line={ln},"
+                  f"title={_gh_escape(c, prop=True)}::{_gh_escape(m)}")
+    else:
+        for p, ln, c, m in findings:
+            print(f"{p}:{ln}: {c} {m}")
+
+
+# -------------------------------------------------------------------- main
+
 def main(argv: List[str]) -> int:
     mode = "all"
+    fmt = "text"
+    jobs: Optional[int] = None
+    baseline_path = BASELINE_PATH
+    use_baseline = True
+    write_baseline = False
     paths: List[str] = []
-    for a in argv:
+    it = iter(argv)
+    for a in it:
         if a in ("--generic", "--generic-only"):
             mode = "generic"
         elif a in ("--domain", "--domain-only"):
@@ -122,18 +261,49 @@ def main(argv: List[str]) -> int:
             for code, desc in sorted(all_codes().items()):
                 print(f"{code}  {desc}")
             return 0
+        elif a == "--format":
+            fmt = next(it, "text")
+        elif a.startswith("--format="):
+            fmt = a.split("=", 1)[1]
+        elif a == "--jobs":
+            jobs = int(next(it, "0")) or None
+        elif a.startswith("--jobs="):
+            jobs = int(a.split("=", 1)[1]) or None
+        elif a == "--baseline":
+            baseline_path = Path(next(it, str(BASELINE_PATH)))
+        elif a.startswith("--baseline="):
+            baseline_path = Path(a.split("=", 1)[1])
+        elif a == "--no-baseline":
+            use_baseline = False
+        elif a == "--write-baseline":
+            write_baseline = True
         else:
             paths.append(a)
-    files = _collect(paths or DEFAULT_TARGETS)
-    problems: List[str] = []
-    for f in files:
-        problems.extend(lint_file(f, domain=(mode != "generic"),
-                                  generic=(mode != "domain")))
-    # project passes: repo mode only (no explicit path narrowing)
-    if mode != "generic" and not paths:
-        problems.extend(lint_project(REPO_ROOT))
-    for p in problems:
-        print(p)
-    print(f"lint[{mode}]: {len(files)} files, {len(problems)} findings",
+    if fmt not in ("text", "json", "github"):
+        print(f"unknown --format {fmt!r} (text|json|github)",
+              file=sys.stderr)
+        return 2
+
+    findings, index = run_suite(paths or None, mode=mode, jobs=jobs)
+
+    if write_baseline:
+        entries = sorted({f"{rel}:{code}" for rel, _, code, _ in findings})
+        baseline_path.write_text(
+            "# tools/lint baseline — known debt parked so new codes land\n"
+            "# strict. One entry per line: path:CODE (every finding of\n"
+            "# CODE in that file) or path:lineno:CODE. Shrink, don't grow.\n"
+            + "".join(e + "\n" for e in entries))
+        print(f"wrote {len(entries)} baseline entries to {baseline_path}",
+              file=sys.stderr)
+        return 0
+
+    baseline = load_baseline(baseline_path) if use_baseline else set()
+    visible = [f for f in findings if not _baselined(f, baseline)]
+    emit(visible, fmt)
+    parses = sum(index.parse_counts.values())
+    baselined = len(findings) - len(visible)
+    print(f"lint[{mode}]: {len(index.files())} files, {parses} parses, "
+          f"{len(visible)} findings"
+          + (f" ({baselined} baselined)" if baselined else ""),
           file=sys.stderr)
-    return 1 if problems else 0
+    return 1 if visible else 0

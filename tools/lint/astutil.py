@@ -19,6 +19,17 @@ def dotted(node: ast.AST) -> Optional[List[str]]:
     return None
 
 
+def is_lock_name(node: ast.AST) -> bool:
+    """The repo's lock naming convention, shared by the LCK passes and the
+    ProjectIndex: a receiver or with-context whose final dotted segment
+    contains ``lock`` or ``mutex`` (``self._lock``, ``state_lock``, …)."""
+    parts = dotted(node)
+    if not parts:
+        return False
+    tail = parts[-1].lower()
+    return "lock" in tail or "mutex" in tail
+
+
 def annotate_parents(tree: ast.AST) -> None:
     """Attach ``_lint_parent`` to every node (the AST has no uplinks)."""
     for node in ast.walk(tree):

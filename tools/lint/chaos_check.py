@@ -24,9 +24,9 @@ proven on mutated copies of the real files by tests/test_lint_domain.py.
 from __future__ import annotations
 
 import ast
-from pathlib import Path
 from typing import Dict, List, Tuple
 
+from .index import as_index
 from .registry import Check, register
 
 CODES = {
@@ -41,10 +41,6 @@ SCENARIO_PATH = "k8s_operator_libs_tpu/chaos/scenario.py"
 INVARIANTS_PATH = "k8s_operator_libs_tpu/chaos/invariants.py"
 
 Finding = Tuple[str, int, str, str]
-
-
-def _parse(root: Path, rel: str) -> ast.Module:
-    return ast.parse((root / rel).read_text(), filename=rel)
 
 
 def _assign_target(node: ast.AST):
@@ -114,23 +110,23 @@ def _coverage_entries(tree: ast.Module
     return [], 0
 
 
-def run_project(root: Path) -> List[Finding]:
-    root = Path(root)
-    if not (root / FAULTS_PATH).exists():
+def run_project(root) -> List[Finding]:
+    index = as_index(root)
+    if not index.exists(FAULTS_PATH):
         return []  # no chaos package in this checkout: nothing to close
     findings: List[Finding] = []
 
-    fault_types, ft_line = _string_tuple(_parse(root, FAULTS_PATH),
+    fault_types, ft_line = _string_tuple(index.tree(FAULTS_PATH),
                                          "FAULT_TYPES")
     if ft_line == 0 or not fault_types:
         return [(FAULTS_PATH, max(1, ft_line), "CHS001",
                  "FAULT_TYPES tuple not found or empty (parse drift?)")]
-    parsers, parsers_line = _dict_keys(_parse(root, SCENARIO_PATH),
+    parsers, parsers_line = _dict_keys(index.tree(SCENARIO_PATH),
                                        "FAULT_PARSERS")
     if parsers_line == 0:
         return [(SCENARIO_PATH, 1, "CHS001",
                  "FAULT_PARSERS table not found (parse drift?)")]
-    inv_tree = _parse(root, INVARIANTS_PATH)
+    inv_tree = index.tree(INVARIANTS_PATH)
     invariant_names, inv_line = _string_tuple(inv_tree, "INVARIANT_NAMES")
     if inv_line == 0 or not invariant_names:
         return [(INVARIANTS_PATH, max(1, inv_line), "CHS001",

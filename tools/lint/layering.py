@@ -31,6 +31,7 @@ import ast
 from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
+from .index import as_index
 from .registry import Check, register
 
 CODES = {
@@ -44,6 +45,10 @@ LAYERS: Dict[str, Set[str]] = {
     "utils": set(),
     "api": {"utils"},
     "consts": set(),
+    # wire is the leaf registry of `tpu.dev/*` label/annotation/taint keys
+    # (WIRE001 keeps the repo closed over it) — it imports nothing, and
+    # any subpackage that speaks the wire contract may import it
+    "wire": set(),
     "core": {"utils", "api"},
     # obs sits BELOW upgrade/health/tpu: they import its tracer/journey/
     # metrics hub, and obs must never import them back (its stuck-threshold
@@ -51,12 +56,14 @@ LAYERS: Dict[str, Set[str]] = {
     "obs": {"core", "utils"},
     "crdutil": {"core", "utils", "api"},
     "upgrade": {"core", "utils", "api", "obs"},
-    "health": {"core", "utils", "api", "upgrade", "obs"},
-    "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs"},
+    "health": {"core", "utils", "api", "upgrade", "obs", "wire"},
+    "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs",
+            "wire"},
     # chaos sits at the TOP of the operator spine: it drives the whole
     # stack (operator, electors, health, SLO) under injected faults and
     # asserts cross-layer invariants — nothing below may import it back
-    "chaos": {"core", "utils", "api", "upgrade", "health", "tpu", "obs"},
+    "chaos": {"core", "utils", "api", "upgrade", "health", "tpu", "obs",
+              "wire"},
     "data": {"utils"},
     "ops": {"utils"},
     # obs sits below BOTH spines: the workload side (goodput ledger,
@@ -139,26 +146,25 @@ def _walk_runtime(node: ast.AST):
         yield from _walk_runtime(child)
 
 
-def run_project(root: Path, package: str = PACKAGE,
+def run_project(root, package: str = PACKAGE,
                 layers: Optional[Dict[str, Set[str]]] = None
                 ) -> List[Finding]:
-    root = Path(root)
+    index = as_index(root)
+    root = index.root
     layers = LAYERS if layers is None else layers
-    pkg_root = root / package
-    files = sorted(p for p in pkg_root.rglob("*.py")
-                   if "__pycache__" not in p.parts)
-    mod_of = {p: _module_name(root, p, package) for p in files}
-    rel_of = {mod_of[p]: str(p.relative_to(root)) for p in files}
+    files = index.files_under(package)
+    mod_of = {rel: _module_name(root, root / rel, package) for rel in files}
+    rel_of = {mod_of[rel]: rel for rel in files}
     modules = set(mod_of.values())
     findings: List[Finding] = []
     graph: Dict[str, Set[str]] = {m: set() for m in modules}
     edge_line: Dict[Tuple[str, str], int] = {}
 
-    for path in files:
-        module = mod_of[path]
-        is_pkg = path.name == "__init__.py"
+    for rel in files:
+        module = mod_of[rel]
+        is_pkg = rel.endswith("__init__.py")
         src_sub = _subpackage(module)
-        tree = ast.parse(path.read_text(), filename=str(path))
+        tree = index.tree(rel)
         imports: List[Tuple[str, int]] = []
         for node in _walk_runtime(tree):
             if isinstance(node, ast.Import):

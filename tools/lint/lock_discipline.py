@@ -27,7 +27,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .astutil import annotate_parents, dotted, parents, walk_same_function
+from .astutil import (annotate_parents, dotted, is_lock_name, parents,
+                      walk_same_function)
 from .registry import Check, FileContext, register
 
 CODES = {
@@ -41,12 +42,7 @@ BLOCKING_EXACT = {("time", "sleep")}
 BLOCKING_TAILS = {"urlopen"}
 
 
-def _is_lock_name(node: ast.AST) -> bool:
-    parts = dotted(node)
-    if not parts:
-        return False
-    tail = parts[-1].lower()
-    return "lock" in tail or "mutex" in tail
+_is_lock_name = is_lock_name  # shared via astutil (the ProjectIndex uses it)
 
 
 def _lock_items(node) -> List[ast.AST]:

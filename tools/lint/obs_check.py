@@ -61,6 +61,7 @@ import ast
 from pathlib import Path
 from typing import Dict, List, Set, Tuple
 
+from .index import as_index
 from .registry import Check, register
 
 CODES = {
@@ -92,10 +93,6 @@ WRITE_METHODS = {"patch_node_metadata", "change_node_upgrade_annotation",
                  "change_nodes_state_and_annotations"}
 
 Finding = Tuple[str, int, str, str]
-
-
-def _parse(root: Path, rel: str) -> ast.Module:
-    return ast.parse((root / rel).read_text(), filename=rel)
 
 
 def _state_wire_values(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
@@ -190,15 +187,15 @@ def _choke_violations(root: Path, rel: str,
     return findings
 
 
-def run_project(root: Path) -> List[Finding]:
-    root = Path(root)
+def run_project(root) -> List[Finding]:
+    index = as_index(root)
     findings: List[Finding] = []
 
-    members = _state_wire_values(_parse(root, CONSTS_PATH))
+    members = _state_wire_values(index.tree(CONSTS_PATH))
     if not members:
         return [(CONSTS_PATH, 1, "OBS001",
                  "no UpgradeState string members found (parse drift?)")]
-    thresholds, table_line = _threshold_keys(_parse(root, JOURNEY_PATH))
+    thresholds, table_line = _threshold_keys(index.tree(JOURNEY_PATH))
     if table_line == 0:
         return [(JOURNEY_PATH, 1, "OBS001",
                  "DEFAULT_STUCK_THRESHOLDS table not found (parse drift?)")]
@@ -218,20 +215,14 @@ def run_project(root: Path) -> List[Finding]:
                  f"wire value (renamed or removed state?)"))
 
     for scan_root in SCAN_ROOTS:
-        base = root / scan_root
-        if not base.exists():
-            continue
-        for path in sorted(base.rglob("*.py")):
-            if "__pycache__" in path.parts:
-                continue
-            rel = str(path.relative_to(root))
+        for rel in index.files_under(scan_root):
             if rel == CHOKE_PATH:
                 continue
             try:
-                tree = ast.parse(path.read_text(), filename=rel)
+                tree = index.tree(rel)
             except SyntaxError:
                 continue  # the generic pass reports E999
-            findings.extend(_choke_violations(root, rel, tree))
+            findings.extend(_choke_violations(index.root, rel, tree))
     return findings
 
 
@@ -279,14 +270,14 @@ def _window_phase_table(tree: ast.Module
     return {}, 0
 
 
-def run_attribution(root: Path) -> List[Finding]:
-    root = Path(root)
+def run_attribution(root) -> List[Finding]:
+    index = as_index(root)
     findings: List[Finding] = []
-    members = _state_wire_values(_parse(root, CONSTS_PATH))
+    members = _state_wire_values(index.tree(CONSTS_PATH))
     if not members:
         return [(CONSTS_PATH, 1, "OBS002",
                  "no UpgradeState string members found (parse drift?)")]
-    table, table_line = _window_phase_table(_parse(root, ATTRIBUTION_PATH))
+    table, table_line = _window_phase_table(index.tree(ATTRIBUTION_PATH))
     if table_line == 0:
         return [(ATTRIBUTION_PATH, 1, "OBS002",
                  "WINDOW_PHASES table not found (parse drift?)")]
@@ -414,22 +405,22 @@ def _default_spec_metrics(tree: ast.Module
     return [], 0
 
 
-def run_slo(root: Path) -> List[Finding]:
-    root = Path(root)
+def run_slo(root) -> List[Finding]:
+    index = as_index(root)
     findings: List[Finding] = []
 
-    help_keys, help_line = _help_text_keys(_parse(root, METRICS_PATH))
+    help_keys, help_line = _help_text_keys(index.tree(METRICS_PATH))
     if help_line == 0:
         return [(METRICS_PATH, 1, "OBS003",
                  "HELP_TEXTS table not found (parse drift?)")]
-    specs, specs_line = _default_spec_metrics(_parse(root, SLO_PATH))
+    specs, specs_line = _default_spec_metrics(index.tree(SLO_PATH))
     if specs_line == 0:
         return [(SLO_PATH, 1, "OBS003",
                  "DEFAULT_SLO_SPECS table not found (parse drift?)")]
     slo_fams, slo_fams_line = _string_tuple(
-        _parse(root, SLO_PATH), "SLO_GAUGE_FAMILIES")
+        index.tree(SLO_PATH), "SLO_GAUGE_FAMILIES")
     alert_fams, alert_fams_line = _string_tuple(
-        _parse(root, ALERTS_PATH), "ALERT_GAUGE_FAMILIES")
+        index.tree(ALERTS_PATH), "ALERT_GAUGE_FAMILIES")
     if slo_fams_line == 0:
         return [(SLO_PATH, 1, "OBS003",
                  "SLO_GAUGE_FAMILIES table not found (parse drift?)")]

@@ -52,10 +52,10 @@ or dict-valued members are not states.
 from __future__ import annotations
 
 import ast
-from pathlib import Path
 from typing import Dict, List, Set, Tuple
 
 from .astutil import dotted
+from .index import as_index
 from .registry import Check, register
 
 CODES = {
@@ -74,10 +74,6 @@ HEALTH_METRICS_PATH = "k8s_operator_libs_tpu/health/metrics.py"
 HEALTH_DOC_PATH = "docs/fleet-health.md"
 
 Finding = Tuple[str, int, str, str]
-
-
-def _parse(root: Path, rel: str) -> ast.Module:
-    return ast.parse((root / rel).read_text(), filename=rel)
 
 
 def _enum_members(tree: ast.Module, enum: str = "UpgradeState"
@@ -193,20 +189,21 @@ def _health_handler_coverage(tree: ast.Module
     return mapped, dangling
 
 
-def _health_findings(root: Path) -> List[Finding]:
+def _health_findings(index) -> List[Finding]:
+    root = index.root
     findings: List[Finding] = []
-    members, all_names = _enum_members(_parse(root, HEALTH_CONSTS_PATH),
+    members, all_names = _enum_members(index.tree(HEALTH_CONSTS_PATH),
                                        enum="HealthVerdict")
     if not members:
         return [(HEALTH_CONSTS_PATH, 1, "STM001",
                  "no HealthVerdict string members found (parse drift?)")]
     mapped, dangling = _health_handler_coverage(
-        _parse(root, HEALTH_REMEDIATION_PATH))
+        index.tree(HEALTH_REMEDIATION_PATH))
     for name, lineno in dangling:
         findings.append((HEALTH_REMEDIATION_PATH, lineno, "STM001",
                          f"handlers() maps a verdict to {name}() but no "
                          "such process_* handler is defined"))
-    metrics_refs = _member_refs(_parse(root, HEALTH_METRICS_PATH),
+    metrics_refs = _member_refs(index.tree(HEALTH_METRICS_PATH),
                                 enum="HealthVerdict")
     metrics_iterates_all = "ALL" in metrics_refs
     doc_file = root / HEALTH_DOC_PATH
@@ -235,26 +232,27 @@ def _health_findings(root: Path) -> List[Finding]:
     return findings
 
 
-def run_project(root: Path) -> List[Finding]:
-    root = Path(root)
+def run_project(root) -> List[Finding]:
+    index = as_index(root)
+    root = index.root
     findings: List[Finding] = []
-    consts = _parse(root, CONSTS_PATH)
+    consts = index.tree(CONSTS_PATH)
     members, all_names = _enum_members(consts)
     if not members:
         return [(CONSTS_PATH, 1, "STM001",
                  "no UpgradeState string members found (parse drift?)")]
 
-    handled, _, missing_defs = _handler_coverage(_parse(root, STATE_PATH))
+    handled, _, missing_defs = _handler_coverage(index.tree(STATE_PATH))
     for name, lineno in missing_defs:
         findings.append((STATE_PATH, lineno, "STM001",
                          f"call to {name}() but no such process_* handler "
                          "is defined"))
 
-    metrics_tree = _parse(root, METRICS_PATH)
+    metrics_tree = index.tree(METRICS_PATH)
     metrics_refs = _member_refs(metrics_tree)
     metrics_iterates_all = "ALL" in metrics_refs
     diagram_refs, diagram_literals = _diagram_coverage(
-        _parse(root, DIAGRAM_PATH))
+        index.tree(DIAGRAM_PATH))
 
     for name, (value, lineno) in sorted(members.items()):
         if name not in handled:
@@ -279,7 +277,7 @@ def run_project(root: Path) -> List[Finding]:
     # health-verdict facet — skipped for fixture roots that only carry the
     # upgrade machine's files (the real repo always has health/consts.py)
     if (root / HEALTH_CONSTS_PATH).exists():
-        findings.extend(_health_findings(root))
+        findings.extend(_health_findings(index))
     return findings
 
 

@@ -1,0 +1,262 @@
+"""SYN001: host-sync hygiene — the decode/train hot loops stay async.
+
+PR 4 removed the per-step host syncs from the trainer (telemetry blocks
+only at the ``_block_on`` boundary); PR 6's roofline wins depend on the
+batcher issuing exactly ONE device→host readback per step. Both are
+one-line regressions away: an innocent ``float(metrics["loss"])`` or
+``np.asarray(...)`` in the loop re-serializes host and device and the
+headline quietly decays. This pass pins the boundary statically, over
+the shared :class:`~.index.ProjectIndex`:
+
+- **hot paths** (:data:`HOT_FUNCTIONS`): the trainer step loop
+  (``CheckpointingTrainer.run``) and the batcher decode paths
+  (``ContinuousBatcher._step_inner`` / ``_step_spec_round``).
+- **device values**: names bound from a device dispatch — a
+  double-call (``self._build_decode(n)(...)``, the compiled-fn idiom)
+  or a ``*step_fn(...)`` call. Tracking is lexical with line-ordering:
+  rebinding a name *through* a readback ends its device life.
+- **what fires inside a hot path**:
+  - ``float()/int()/bool()/np.asarray()/np.array()/jax.device_get()``
+    applied to a live device value — a synchronous transfer per step;
+  - any ``.item()`` call — the classic scalar sync;
+- **what fires anywhere in a hot file**: a ``.block_until_ready``
+  reference outside the ``_block_on`` boundary function — all blocking
+  funnels through the one audited choke point.
+
+Escape hatch: each hot path is allowed its *deliberate* readback — the
+one sync that defines the step boundary — marked ``# syn: readback`` on
+the line (see models/serve.py). The mark both silences the finding and
+ends the value's device life, so downstream host math stays silent.
+Mutated-copy fixtures in tests/test_lint_domain.py prove the real files
+pass and a smuggled sync fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import dotted
+from .index import as_index
+from .registry import Check, register
+
+CODES = {
+    "SYN001": "device->host sync on a hot path outside the _block_on/"
+              "readback boundary (re-serializes the device stream)",
+}
+
+# (file, class-qualified function) pairs forming the guarded hot paths
+HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
+    "k8s_operator_libs_tpu/train/harness.py": (
+        "CheckpointingTrainer.run",),
+    "k8s_operator_libs_tpu/models/serve.py": (
+        "ContinuousBatcher._step_inner",
+        "ContinuousBatcher._step_spec_round"),
+}
+
+# the audited blocking choke point (may reference .block_until_ready)
+BOUNDARY_FUNCTIONS = {"_block_on"}
+
+HATCH = "# syn: readback"
+
+HOST_CASTS = {"float", "int", "bool"}
+DEVICE_DISPATCH_TAILS = {"_step_fn", "step_fn"}
+
+Finding = Tuple[str, int, str, str]
+
+
+def _is_device_dispatch(value: ast.AST) -> bool:
+    """``self._build_decode(n)(...)`` (calling a compiled callable) or a
+    ``*step_fn(...)`` call — the expressions whose results live on
+    device."""
+    if not isinstance(value, ast.Call):
+        return False
+    if isinstance(value.func, ast.Call):
+        return True
+    parts = dotted(value.func)
+    return bool(parts) and parts[-1] in DEVICE_DISPATCH_TAILS
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(_target_names(el))
+        return out
+    return []
+
+
+def _is_sync_call(node: ast.Call) -> Optional[str]:
+    """The sync-inducing call shapes → a short name, else None."""
+    parts = dotted(node.func)
+    if parts is None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item":
+            return ".item()"
+        return None
+    if parts[-1] == "item":
+        return ".item()"
+    if len(parts) == 1 and parts[0] in HOST_CASTS:
+        return f"{parts[0]}()"
+    if len(parts) == 2 and parts[0] in ("np", "numpy") \
+            and parts[1] in ("asarray", "array"):
+        return ".".join(parts) + "()"
+    if parts[-1] == "device_get":
+        return ".".join(parts) + "()"
+    return None
+
+
+class _HotScan:
+    """One hot function: find device-value lifetimes, then syncs on
+    them. Lexical line ordering stands in for control flow — the hot
+    loops are straight-line code by design."""
+
+    def __init__(self, rel: str, fn: ast.AST, lines: List[str]):
+        self.rel = rel
+        self.fn = fn
+        self.lines = lines
+        # name -> list of (birth lineno, death lineno or None)
+        self.device: Dict[str, List[Tuple[int, Optional[int]]]] = {}
+        self.findings: List[Finding] = []
+
+    def _hatched(self, lineno: int) -> bool:
+        return 0 < lineno <= len(self.lines) \
+            and HATCH in self.lines[lineno - 1]
+
+    def _walk_fn(self):
+        """Same-function statement walk (nested defs excluded — a nested
+        def is deferred/jitted work with its own rules)."""
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                yield child
+                yield from rec(child)
+        yield from rec(self.fn)
+
+    def collect_lifetimes(self) -> None:
+        for node in self._walk_fn():
+            if not isinstance(node, ast.Assign):
+                continue
+            names: List[str] = []
+            for t in node.targets:
+                names.extend(_target_names(t))
+            if _is_device_dispatch(node.value):
+                for n in names:
+                    self.device.setdefault(n, []).append(
+                        (node.lineno, None))
+            elif isinstance(node.value, ast.Call) \
+                    and _is_sync_call(node.value):
+                # `x = np.asarray(x)`-style readback: ends x's device life
+                for n in names:
+                    spans = self.device.get(n, [])
+                    for i, (birth, death) in enumerate(spans):
+                        if death is None and birth < node.lineno:
+                            spans[i] = (birth, node.lineno)
+
+    def _is_device_at(self, name: str, lineno: int) -> bool:
+        for birth, death in self.device.get(name, []):
+            if birth < lineno and (death is None or lineno <= death):
+                return True
+        return False
+
+    def check(self) -> List[Finding]:
+        self.collect_lifetimes()
+        qual = getattr(self.fn, "name", "?")
+        for node in self._walk_fn():
+            if not isinstance(node, ast.Call):
+                continue
+            what = _is_sync_call(node)
+            if what is None or self._hatched(node.lineno):
+                continue
+            if what == ".item()":
+                self.findings.append(
+                    (self.rel, node.lineno, "SYN001",
+                     f".item() in hot path {qual}() forces a scalar "
+                     f"device->host sync every step — route through the "
+                     f"_block_on boundary or mark the deliberate "
+                     f"readback"))
+                continue
+            arg_names: Set[str] = set()
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        arg_names.add(sub.id)
+            live = sorted(n for n in arg_names
+                          if self._is_device_at(n, node.lineno))
+            if live:
+                self.findings.append(
+                    (self.rel, node.lineno, "SYN001",
+                     f"{what} on device value {live[0]!r} in hot path "
+                     f"{qual}() is an extra device->host sync per step — "
+                     f"fold it into the existing `{HATCH}` boundary or "
+                     f"_block_on"))
+        return self.findings
+
+
+def _function_node(tree: ast.Module, qualname: str) -> Optional[ast.AST]:
+    parts = qualname.split(".")
+    body = tree.body
+    node: Optional[ast.AST] = None
+    for part in parts:
+        node = next((n for n in body
+                     if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and n.name == part), None)
+        if node is None:
+            return None
+        body = node.body
+    return node
+
+
+def _block_until_ready_refs(rel: str, tree: ast.Module) -> List[Finding]:
+    """`.block_until_ready` references outside the boundary functions, in
+    a hot file — all blocking goes through _block_on."""
+    boundary_spans = [
+        (n.lineno, n.end_lineno or n.lineno)
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in BOUNDARY_FUNCTIONS]
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "block_until_ready" \
+                and not any(a <= node.lineno <= b
+                            for a, b in boundary_spans):
+            findings.append(
+                (rel, node.lineno, "SYN001",
+                 ".block_until_ready outside the _block_on boundary — "
+                 "blocking funnels through the one audited choke point"))
+    return findings
+
+
+def run_project(root) -> List[Finding]:
+    index = as_index(root)
+    findings: List[Finding] = []
+    for rel, quals in HOT_FUNCTIONS.items():
+        if not index.exists(rel):
+            continue  # fixture roots carry a subset of the hot files
+        try:
+            tree = index.tree(rel)
+        except SyntaxError:
+            continue
+        findings.extend(_block_until_ready_refs(rel, tree))
+        for qual in quals:
+            fn = _function_node(tree, qual)
+            if fn is None:
+                findings.append(
+                    (rel, 1, "SYN001",
+                     f"hot-path function {qual} not found in {rel} — "
+                     f"update tools/lint/sync_check.py HOT_FUNCTIONS "
+                     f"when renaming hot paths"))
+                continue
+            findings.extend(
+                _HotScan(rel, fn, index.lines(rel)).check())
+    return findings
+
+
+register(Check(name="sync-hygiene", codes=CODES, scope="project",
+               run=run_project, domain=True))
