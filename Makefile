@@ -12,9 +12,9 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-chaos health-sim chaos lint lint-domain lint-smoke \
-  cov-report cov-artifact bench bench-decode dryrun apply-crds-dry clean \
-  $(DOCKER_TARGETS) .build-image
+  test-obs-slo test-chaos test-router health-sim chaos lint lint-domain \
+  lint-smoke cov-report cov-artifact bench bench-decode dryrun \
+  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -45,6 +45,9 @@ test-obs-slo:  ## SLO engine: tsdb, error budgets, burn-rate alerting, dashboard
 test-chaos:  ## chaos harness + elastic training suites (docs/chaos.md)
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_elastic.py -q
 
+test-router:  ## serving router tier: affinity/backpressure/handoff units, autoscaler hysteresis + TTFT-burn scale-up, N=3 rolling-upgrade zero-loss e2e (docs/router.md)
+	$(PYTHON) -m pytest tests/test_router.py tests/test_serve_upgrade_e2e.py -q
+
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py
 
@@ -59,6 +62,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 	  k8s_operator_libs_tpu.tpu, k8s_operator_libs_tpu.crdutil, \
 	  k8s_operator_libs_tpu.health, k8s_operator_libs_tpu.chaos, \
 	  k8s_operator_libs_tpu.models, k8s_operator_libs_tpu.ops, \
+	  k8s_operator_libs_tpu.serving, \
 	  k8s_operator_libs_tpu.parallel, k8s_operator_libs_tpu.train; print('imports ok')"
 
 # LINT_FLAGS lets CI ask for inline annotations: make lint-domain

@@ -1,0 +1,463 @@
+#!/usr/bin/env python3
+"""tpu-router binary: the serving fleet's front door (docs/router.md).
+
+Fronts N ``cmd/serve.py`` replicas (each one ContinuousBatcher on one
+slice) with the library router tier (``k8s_operator_libs_tpu/serving``):
+the replica registry scrapes per-replica health/backpressure from their
+``/metrics`` endpoints, node state (cordon, quarantine, reclaim, upgrade
+journey label) is refreshed through the cluster client when credentials
+are given, and a background tick thread runs the drain watch — a replica
+whose node enters ``cordon-required`` stops receiving admissions BEFORE
+the operator cordons it, gets the ``tpu.dev/serving.drain-intent``
+annotation stamped, and is told to ``/drain`` so its queued clients
+reroute through this router to a peer.
+
+HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
+``{"kind", "data"}`` envelope the other cmd binaries use):
+
+- ``POST /generate``  {"tokens": [...], "max_new": N, "session"?: id}
+  → proxied to the best replica (session + shared-prefix affinity, then
+  weighted least-outstanding-work with queue-depth backpressure); a 503
+  or connection error from a draining/dead replica retries the SAME
+  request on the next-best peer (exactly-once holds: a 503 means "not
+  served here").
+- ``POST /register``  {"id", "url", "node", "weight"?} → add a replica
+  at runtime (the ``--replica`` flag seeds the registry at boot).
+- ``GET  /replicas``  → the registry view ``cmd/status.py --replicas``
+  renders.
+- ``GET  /metrics``   → ``tpu_router_*`` families (docs/observability.md).
+- ``GET  /healthz``   → 200 while at least one replica admits, else 503.
+
+The queue-depth half of the autoscaler runs in-process (scale decisions
+journal as Events when a cluster client is available and always surface
+in the ``tpu_router_scale_*`` gauges); the SLO-burn half needs the
+operator's tsdb and is wired where the SLO engine lives — see
+docs/router.md "Autoscaling".
+"""
+
+import argparse
+import json
+import logging
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+logger = logging.getLogger("tpu-router")
+
+
+class HTTPRuntime:
+    """Runtime adapter over a peer ``cmd/serve.py`` process. Only the
+    surface the pool/front actually use is implemented: metrics scrape,
+    drain, liveness. Request traffic is proxied per-request by
+    :class:`RouterFront` (the replica's /generate blocks until done, so
+    there is no submit/poll split across HTTP)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._alive = True
+        self._draining = False
+
+    def metrics_text(self) -> str:
+        with urllib.request.urlopen(self.url + "/metrics",
+                                    timeout=self.timeout) as resp:
+            return resp.read().decode()
+
+    def drain(self) -> None:
+        self._draining = True
+        req = urllib.request.Request(self.url + "/drain", data=b"{}",
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except Exception:
+            logger.warning("drain POST to %s failed (replica gone?)",
+                           self.url, exc_info=True)
+
+    def handoff(self):
+        # queued clients of the draining replica receive the 503
+        # resubmit-to-peer signal directly and re-enter through this
+        # router; there is nothing to adopt across HTTP
+        return []
+
+    @property
+    def idle(self) -> bool:
+        return True
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def fail(self) -> None:
+        self._alive = False
+
+
+class RouterFront:
+    """Per-request proxy over the shared :class:`ReplicaPool` with the
+    library router's placement policy (session + prefix affinity,
+    weighted least-outstanding-work, backpressure) and drain watch. The
+    duck-typed ``_queue`` / ``_outstanding_on`` / ``drain_replica``
+    surface lets the library Autoscaler drive scale decisions against
+    this front unchanged."""
+
+    def __init__(self, pool, metrics=None, clock=None, queue_high=8.0,
+                 proxy_timeout=300.0):
+        from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
+        from k8s_operator_libs_tpu.utils.clock import RealClock
+        self.pool = pool
+        self._metrics = metrics
+        self._clock = clock or RealClock()
+        self.queue_high = queue_high
+        self.proxy_timeout = proxy_timeout
+        self._prefix_tokens = PREFIX_KEY_TOKENS
+        self.lock = threading.Lock()
+        self._session = {}
+        self._prefix = {}
+        self._outstanding = {}
+        self._queue = []            # proxy mode holds no router queue
+        self._routed = 0
+        self._completed = 0
+        self._rerouted = 0
+        self.drains = []
+
+    # --------------------------------------------------------- placement
+
+    def _pick(self, session, prefix_key, exclude):
+        with self.lock:
+            candidates = [
+                r for r in self.pool.admitting()
+                if r.id not in exclude
+                and (r.stats.stale or r.stats.queue_depth < self.queue_high)]
+            if not candidates:
+                return None
+            by_id = {r.id: r for r in candidates}
+            if session is not None and self._session.get(session) in by_id:
+                return by_id[self._session[session]]
+            if self._prefix.get(prefix_key) in by_id:
+                return by_id[self._prefix[prefix_key]]
+            return min(candidates, key=lambda r: (
+                (self._outstanding.get(r.id, 0) + r.stats.queue_depth)
+                / r.weight))
+
+    def generate(self, tokens, max_new, session=None):
+        """→ (http status, body dict). Retries distinct peers until one
+        serves the request; a replica that refuses (503 = draining) or
+        drops the connection is excluded and the next-best peer tried."""
+        prefix_key = tuple(tokens[:self._prefix_tokens])
+        tried = set()
+        while True:
+            replica = self._pick(session, prefix_key, tried)
+            if replica is None:
+                return 503, {"error": "no admitting replica; retry later"}
+            tried.add(replica.id)
+            with self.lock:
+                self._outstanding[replica.id] = \
+                    self._outstanding.get(replica.id, 0) + 1
+                if session is not None:
+                    self._session[session] = replica.id
+                self._prefix[prefix_key] = replica.id
+            try:
+                body = json.dumps({"tokens": tokens,
+                                   "max_new": max_new}).encode()
+                req = urllib.request.Request(
+                    replica.url.rstrip("/") + "/generate", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(
+                        req, timeout=self.proxy_timeout) as resp:
+                    out = json.loads(resp.read())
+                with self.lock:
+                    self._routed += 1
+                    self._completed += 1
+                return 200, out
+            except urllib.error.HTTPError as exc:
+                payload = _safe_json(exc)
+                if exc.code in (503,):
+                    # draining/failed: not served there — reroute
+                    with self.lock:
+                        self._rerouted += 1
+                    replica.stats.draining = True
+                    continue
+                return exc.code, payload
+            except Exception as exc:
+                # connection refused / reset: the replica is gone; mark
+                # it failed and reroute (it never served the request)
+                logger.warning("replica %s unreachable: %s", replica.id,
+                               exc)
+                replica.runtime.fail()
+                replica.failed = True
+                with self.lock:
+                    self._rerouted += 1
+                continue
+            finally:
+                with self.lock:
+                    self._outstanding[replica.id] = max(
+                        0, self._outstanding.get(replica.id, 1) - 1)
+
+    def _outstanding_on(self, replica):
+        with self.lock:
+            return self._outstanding.get(replica.id, 0)
+
+    # ------------------------------------------------------- drain watch
+
+    def drain_replica(self, replica, reason):
+        from k8s_operator_libs_tpu.wire import DRAIN_INTENT_ANNOTATION
+        if replica.draining:
+            return
+        replica.draining = True
+        replica.drain_reason = reason
+        self.drains.append((replica.id, replica.node_name, reason))
+        if self.pool.client is not None:
+            try:
+                self.pool.client.patch_node_metadata(
+                    replica.node_name, annotations={
+                        DRAIN_INTENT_ANNOTATION:
+                            f"{reason}@{self._clock.wall():.3f}"})
+            except Exception:
+                logger.warning("could not stamp drain intent on %s",
+                               replica.node_name, exc_info=True)
+        try:
+            replica.runtime.drain()
+        except Exception:
+            replica.failed = True
+        logger.info("draining replica %s on %s (%s)", replica.id,
+                    replica.node_name, reason)
+
+    def tick(self):
+        from k8s_operator_libs_tpu.serving.router import DRAIN_STATES
+        self.pool.refresh_nodes()
+        self.pool.scrape()
+        for replica in self.pool.live():
+            if replica.draining:
+                continue
+            state = self.pool.node_states.get(replica.node_name)
+            reason = None
+            if state is not None and state.known:
+                if state.quarantined:
+                    reason = "quarantined"
+                elif state.reclaim_tainted:
+                    reason = "reclaim"
+                elif state.state_label in DRAIN_STATES:
+                    reason = f"upgrade:{state.state_label}"
+                elif not state.schedulable:
+                    reason = "cordoned"
+            if reason is None and replica.stats.draining:
+                reason = "replica-initiated"
+            if reason is not None:
+                self.drain_replica(replica, reason)
+        self._update_gauges()
+
+    def _update_gauges(self):
+        if self._metrics is None:
+            return
+        with self.lock:
+            live = self.pool.live()
+            self._metrics.set_gauge("replicas", len(self.pool.replicas))
+            self._metrics.set_gauge("replicas_admitting",
+                                    len(self.pool.admitting()))
+            self._metrics.set_gauge("replicas_draining",
+                                    sum(1 for r in live if r.draining))
+            self._metrics.set_gauge(
+                "replicas_failed",
+                sum(1 for r in self.pool.replicas.values() if r.failed))
+            self._metrics.set_gauge("queue_depth", len(self._queue))
+            self._metrics.set_gauge(
+                "outstanding_requests",
+                sum(self._outstanding.values()))
+            self._metrics.set_gauge("requests_routed", self._routed)
+            self._metrics.set_gauge("requests_completed", self._completed)
+            self._metrics.set_gauge("requests_rerouted", self._rerouted)
+
+
+def _safe_json(exc):
+    try:
+        return json.loads(exc.read())
+    except Exception:
+        return {"error": f"replica error {exc.code}"}
+
+
+def make_handler(front, pool, hub, autoscaler=None):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                n = len(pool.admitting())
+                code = 200 if n else 503
+                self._json(code, {"status": "ok" if n else "no-replicas",
+                                  "admitting": n})
+            elif self.path == "/replicas":
+                data = {
+                    "replicas": [r.describe()
+                                 for r in pool.replicas.values()],
+                    "summary": {
+                        "total": len(pool.replicas),
+                        "admitting": len(pool.admitting()),
+                        "draining": sum(1 for r in pool.live()
+                                        if r.draining),
+                        "failed": sum(1 for r in pool.replicas.values()
+                                      if r.failed),
+                    },
+                    "autoscaler": (None if autoscaler is None else {
+                        "scale_ups": autoscaler.scale_ups,
+                        "scale_downs": autoscaler.scale_downs,
+                        "last_decision": autoscaler.last_decision,
+                    }),
+                }
+                self._json(200, {"kind": "replicas", "data": data})
+            elif self.path == "/metrics":
+                body = hub.render(prefix="tpu_router").encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n)) if n else {}
+            except ValueError as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            if self.path == "/register":
+                from k8s_operator_libs_tpu.serving.pool import Replica
+                try:
+                    replica = Replica(
+                        str(req["id"]), str(req["node"]),
+                        HTTPRuntime(str(req["url"])),
+                        url=str(req["url"]),
+                        weight=float(req.get("weight", 1.0)))
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._json(400, {"error": f"bad register: {exc}"})
+                    return
+                pool.register(replica)
+                self._json(200, {"kind": "registered",
+                                 "data": replica.describe()})
+                return
+            if self.path != "/generate":
+                self._json(404, {"error": "not found"})
+                return
+            try:
+                tokens = [int(t) for t in req["tokens"]]
+                max_new = int(req.get("max_new", 32))
+                session = req.get("session")
+            except (KeyError, TypeError, ValueError) as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            code, body = front.generate(tokens, max_new, session=session)
+            self._json(code, body)
+
+    return Handler
+
+
+def build_client(args):
+    if not (args.kubeconfig or args.in_cluster):
+        return None
+    from k8s_operator_libs_tpu.core.liveclient import (KubeConfig,
+                                                       KubeHTTP,
+                                                       LiveClient)
+    kc = (KubeConfig.in_cluster() if args.in_cluster else
+          KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
+    return LiveClient(KubeHTTP(kc))
+
+
+def parse_replica_flag(value):
+    """``id=url@node[:weight]`` → (id, url, node, weight). The URL may
+    contain '@' only in its authority (it won't); the LAST '@' splits."""
+    rid, sep, rest = value.partition("=")
+    if not sep or "@" not in rest:
+        raise argparse.ArgumentTypeError(
+            f"--replica wants id=url@node[:weight], got {value!r}")
+    url, _, nodeweight = rest.rpartition("@")
+    node, _, weight = nodeweight.partition(":")
+    return rid, url, node, float(weight) if weight else 1.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int, default=8300)
+    ap.add_argument("--component", default="libtpu",
+                    help="managed component whose upgrade-state label "
+                         "the drain watch reads")
+    ap.add_argument("--replica", action="append", default=[],
+                    type=parse_replica_flag, metavar="ID=URL@NODE[:W]",
+                    help="seed replica (repeatable); more can join via "
+                         "POST /register")
+    ap.add_argument("--kubeconfig", default=None)
+    ap.add_argument("--context", default=None)
+    ap.add_argument("--in-cluster", action="store_true")
+    ap.add_argument("--tick", type=float, default=2.0,
+                    help="drain-watch/scrape interval (seconds)")
+    ap.add_argument("--queue-high", type=float, default=8.0,
+                    help="scraped queue depth above which a replica is "
+                         "backpressured out of placement")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=8)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+
+    from k8s_operator_libs_tpu.core.client import ClientEventRecorder
+    from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+    from k8s_operator_libs_tpu.serving.autoscaler import (Autoscaler,
+                                                          AutoscalerConfig)
+    from k8s_operator_libs_tpu.serving.pool import Replica, ReplicaPool
+
+    client = build_client(args)
+    hub = MetricsHub()
+    pool = ReplicaPool(client=client, component=args.component,
+                       metrics=hub)
+    front = RouterFront(pool, metrics=hub, queue_high=args.queue_high)
+    for rid, url, node, weight in args.replica:
+        pool.register(Replica(rid, node, HTTPRuntime(url), url=url,
+                              weight=weight))
+    recorder = ClientEventRecorder(client) if client is not None else None
+    autoscaler = Autoscaler(
+        pool, front, recorder=recorder, metrics=hub,
+        config=AutoscalerConfig(min_replicas=args.min_replicas,
+                                max_replicas=args.max_replicas,
+                                queue_high=args.queue_high))
+
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            try:
+                front.tick()
+                autoscaler.tick()
+            except Exception:
+                logger.exception("router tick failed; retrying")
+            stop.wait(args.tick)
+
+    t = threading.Thread(target=ticker, daemon=True)
+    t.start()
+    httpd = ThreadingHTTPServer(("0.0.0.0", args.port),
+                                make_handler(front, pool, hub,
+                                             autoscaler))
+    logger.info("tpu-router on :%d (%d replicas seeded, tick %.1fs)",
+                args.port, len(pool.replicas), args.tick)
+    try:
+        httpd.serve_forever()
+    finally:
+        stop.set()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

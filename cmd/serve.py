@@ -275,15 +275,22 @@ def make_handler(rt: ServingRuntime):
     return Handler
 
 
-def drain_then_shutdown(rt, httpd, grace, poll=0.05, settle=0.5):
+def drain_then_shutdown(rt, httpd, grace, poll=0.05, settle=0.5,
+                        clock=None):
     """The SIGTERM drain, bounded: finish in-flight requests and hand the
     queue off, but never outlive the pod's termination grace period — a
     dead client that never collects its result must not spin shutdown
     forever (kubelet would SIGKILL mid-socket-write instead of us exiting
     cleanly). On deadline, log the undelivered request ids (their clients
     resubmit to a peer; the results are lost with this process either
-    way) and proceed to httpd.shutdown()."""
-    import time
+    way) and proceed to httpd.shutdown().
+
+    ``clock`` (a utils/clock.py Clock, default real) injects time for the
+    poll/settle waits and the grace deadline, so the router tier's chaos
+    scenarios drive replica shutdown deterministically on a FakeClock —
+    a multi-second grace models in microseconds of wall time."""
+    from k8s_operator_libs_tpu.utils.clock import RealClock
+    clock = clock or RealClock()
     logger.info("SIGTERM: draining (finish in-flight, hand off queue)")
     handoff = rt.drain()
     if handoff:
@@ -292,9 +299,9 @@ def drain_then_shutdown(rt, httpd, grace, poll=0.05, settle=0.5):
     # the last decode: wait for every completed result to be picked up by
     # its handler, plus a beat for the final socket writes — but only up
     # to the grace deadline (minus the settle beat we still want to take)
-    deadline = time.monotonic() + max(0.0, grace - settle)
+    deadline = clock.now() + max(0.0, grace - settle)
     while not (rt.idle() and rt.delivered()):
-        if time.monotonic() >= deadline:
+        if clock.now() >= deadline:
             lost = rt.undelivered()
             logger.warning(
                 "drain deadline (%.1fs grace) hit with %d undelivered "
@@ -302,9 +309,9 @@ def drain_then_shutdown(rt, httpd, grace, poll=0.05, settle=0.5):
                 "resubmit to a peer", grace, len(lost),
                 ",".join(map(str, lost)) or "<none>")
             break
-        time.sleep(poll)
+        clock.sleep(poll)
     else:
-        time.sleep(settle)
+        clock.sleep(settle)
     httpd.shutdown()
 
 

@@ -36,13 +36,22 @@ operator's in-process tsdb:
 
     python cmd/status.py --slo --operator-url http://operator:8080 --watch
 
+``--replicas`` renders the SERVING ROUTER's replica registry fetched
+from a running ``cmd/router.py``'s ``/replicas`` endpoint
+(``--router-url``): one row per replica — node, admission state, drain
+reason, scraped queue depth and slot usage — plus the autoscaler's last
+decision (docs/router.md):
+
+    python cmd/status.py --replicas --router-url http://router:8300
+
 ``--json`` always emits one ``{"kind": <view>, "data": ...}`` envelope
-(kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``).
+(kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``, ``replicas``).
 
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
 gates and scripts can wait on it. ``--timeline``, ``--goodput``,
-``--slo``, and ``--alerts`` always exit 0.
+``--slo``, ``--alerts``, and ``--replicas`` always exit 0 (except 2 when
+the endpoint is unreachable).
 """
 
 import argparse
@@ -417,6 +426,61 @@ def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
         sleep(args.watch_interval)
 
 
+def render_replicas(data) -> str:
+    """One row per serving replica from the router's /replicas view."""
+    replicas = data.get("replicas") or []
+    if not replicas:
+        return "no replicas registered with the router"
+    headers = ("REPLICA", "NODE", "STATE", "QUEUE", "SLOTS", "WEIGHT")
+    table = []
+    for r in replicas:
+        if r.get("failed"):
+            state = "failed"
+        elif r.get("drained"):
+            state = "drained"
+        elif r.get("draining"):
+            state = f"draining({r.get('drain_reason') or '?'})"
+        else:
+            state = "admitting"
+        queue = "?" if r.get("stale") else f"{r.get('queue_depth', 0):g}"
+        slots = (f"{r.get('slots_busy', 0):g}/"
+                 f"{r.get('slots_total', 0):g}")
+        table.append((r["id"], r["node"], state, queue, slots,
+                      f"{r.get('weight', 1.0):g}"))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    summary = data.get("summary") or {}
+    lines.append(f"{summary.get('total', len(replicas))} replicas: "
+                 f"{summary.get('admitting', '?')} admitting, "
+                 f"{summary.get('draining', '?')} draining, "
+                 f"{summary.get('failed', '?')} failed")
+    scaler = data.get("autoscaler")
+    if scaler:
+        last = scaler.get("last_decision") or {}
+        lines.append(f"autoscaler: {scaler.get('scale_ups', 0)} up / "
+                     f"{scaler.get('scale_downs', 0)} down"
+                     + (f", last: {last.get('action')} "
+                        f"({last.get('reason')})" if last else ""))
+    return "\n".join(lines)
+
+
+def run_replicas_view(args, fetch=fetch_view) -> int:
+    try:
+        env = fetch(args.router_url, "/replicas")
+    except Exception as exc:
+        print(f"error: cannot read {args.router_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_replicas(env.get("data") or {}))
+    return 0
+
+
 def render_timeline(component: str, node_name: str, rows, stuck) -> str:
     lines = [f"component: {component}  node: {node_name}"]
     if not rows:
@@ -484,8 +548,19 @@ def main(argv=None, client=None, now=None) -> int:
                    metavar="SECONDS")
     p.add_argument("--watch-count", type=int, default=0, metavar="N",
                    help="stop after N refreshes (0 = forever)")
+    p.add_argument("--replicas", action="store_true",
+                   help="render the serving router's replica registry "
+                        "from a running cmd/router.py")
+    p.add_argument("--router-url", default="http://127.0.0.1:8300",
+                   metavar="URL",
+                   help="router endpoint for --replicas "
+                        "(default %(default)s)")
     args = p.parse_args(argv)
 
+    if args.replicas:
+        # the replica registry is the router's HTTP view, never the
+        # cluster's (the router owns the authoritative in-memory state)
+        return run_replicas_view(args)
     if args.slo or args.alerts or args.watch:
         # SLO views read the operator's HTTP endpoints, never the cluster
         return run_slo_view(args)

@@ -28,8 +28,11 @@ import os
 import tempfile
 from typing import Callable, Dict, List, Optional
 
+import random
+
 from ..api.v1alpha1 import (DrainSpec, DriverUpgradePolicySpec,
                             scaled_int_or_percent)
+from ..core.client import ServerError
 from ..core.fakecluster import FakeCluster
 from ..core.leaderelection import LeaderElector
 from ..health.classifier import ClassifierConfig
@@ -38,12 +41,16 @@ from ..health.remediation import RemediationPolicy
 from ..obs.goodput import GoodputLedger
 from ..obs.metrics import MetricsHub
 from ..obs.slo import SLOOptions
+from ..serving.pool import DRAIN_STATES, Replica, ReplicaPool
+from ..serving.router import RequestRouter
+from ..serving.sim import SimReplicaRuntime, sim_tokens
 from ..tpu.operator import ManagedComponent, TPUOperator
 from ..tpu.topology import (GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL,
                             GKE_TOPOLOGY_LABEL)
 from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import FakeClock
+from ..wire import QUARANTINE_LABEL
 from .faults import RECLAIM_TAINT_KEY
 from .injector import ChaosInjector
 from .invariants import (CampaignView, Invariant, Violation,
@@ -71,6 +78,9 @@ class CampaignResult:
     violations: List[Violation]
     trace: List[str]
     failovers: int = 0
+    # serving-tier summary: submitted/completed/rerouted request counts,
+    # drain handoffs, and how many replica generations were spawned
+    router_stats: Optional[Dict[str, int]] = None
 
     @property
     def failed(self) -> bool:
@@ -194,6 +204,131 @@ class SimJob:
             self.ledger = None
 
 
+class ServingTier:
+    """The campaign's router-tier workload: one deterministic
+    :class:`~..serving.sim.SimReplicaRuntime` replica per slice (pinned
+    to the slice's first host), fronted by a real
+    :class:`~..serving.router.RequestRouter` whose cluster reads go
+    through the CHAOS-INJECTED client (flakes, latency, conflicts hit
+    the router exactly like the operator). Each tick it:
+
+    - kills / respawns replicas from the injector's active
+      ``replica-kill`` windows (a respawn is a NEW generation on the
+      same node, never a resurrected runtime);
+    - runs the POD-SIDE drain watch against the DIRECT client (the
+      pod's own kubelet-level knowledge: a cordon/quarantine/reclaim on
+      its node drains the replica even while the router's apiserver view
+      is flaking — the backstop that keeps admission legality strict);
+    - submits seeded requests while the scenario is active, ticks the
+      router, steps every live runtime.
+
+    Its router is handed to the invariant pass via
+    :attr:`CampaignView.router` — the two router invariants check it
+    every tick, and :meth:`verify_results` pins token-determinism at
+    the end.
+    """
+
+    MAX_REQUESTS = 400
+
+    def __init__(self, cluster: FakeCluster, clock, injector: ChaosInjector,
+                 fleet, seed: int):
+        self.cluster = cluster
+        self.injector = injector
+        self.rng = random.Random((seed << 8) ^ 0x5EED)
+        self.metrics = MetricsHub()
+        self.pool = ReplicaPool(client=injector.client("router"),
+                                component=COMPONENT, metrics=self.metrics,
+                                clock=clock)
+        self.pool.scrape_gate = self._scrape_gate
+        self.router = RequestRouter(self.pool, metrics=self.metrics,
+                                    clock=clock)
+        self.slice_nodes = [fleet.slice_hosts(s)[0]
+                            for s in range(fleet.slices)]
+        self.current: Dict[str, str] = {}
+        self._gen = 0
+        self.submitted = 0
+        for node in self.slice_nodes:
+            self._spawn(node)
+
+    def _scrape_gate(self, replica) -> None:
+        if replica.node_name in self.injector.metrics_flake_nodes():
+            raise ServerError("injected metrics-endpoint flake on "
+                              + replica.node_name)
+
+    def _spawn(self, node: str) -> None:
+        self._gen += 1
+        replica = Replica(f"replica-{node}-g{self._gen}", node,
+                          SimReplicaRuntime(max_slots=4))
+        self.pool.register(replica)
+        self.current[node] = replica.id
+
+    def _node_clean(self, node: str) -> bool:
+        """The pod-side view: direct (uninjected) read, like the kubelet
+        that would be delivering the SIGTERM."""
+        try:
+            obj = self.cluster.client.direct().get_node(node)
+        except Exception:
+            return False
+        return (not obj.spec.unschedulable and obj.is_ready()
+                and QUARANTINE_LABEL not in obj.metadata.labels
+                and not any(t.key == RECLAIM_TAINT_KEY
+                            for t in obj.spec.taints)
+                and obj.metadata.labels.get(
+                    self.pool.keys.state_label, "")
+                not in DRAIN_STATES)
+
+    def tick(self, active: bool) -> None:
+        killed = self.injector.killed_replica_nodes()
+        for node in self.slice_nodes:
+            replica = self.pool.replicas.get(self.current.get(node, ""))
+            if node in killed and replica is not None \
+                    and replica.runtime.alive():
+                replica.runtime.fail()
+            if node not in killed and (
+                    replica is None or replica.failed
+                    or replica.drained) and self._node_clean(node):
+                if replica is not None:
+                    self.pool.deregister(replica.id)
+                self._spawn(node)
+        # pod-side drain backstop BEFORE the router ticks
+        for replica in list(self.pool.replicas.values()):
+            if replica.failed or replica.draining:
+                continue
+            if not self._node_clean(replica.node_name):
+                self.router.drain_replica(replica, "pod-term")
+        if active and self.submitted < self.MAX_REQUESTS \
+                and self.pool.admitting():
+            for _ in range(self.rng.randint(1, 2)):
+                prompt = [self.rng.randrange(32000)
+                          for _ in range(self.rng.randint(2, 6))]
+                self.router.submit(prompt, self.rng.randint(2, 8),
+                                   session=f"s{self.rng.randrange(8)}")
+                self.submitted += 1
+        self.router.tick()
+        for replica in self.pool.replicas.values():
+            if not replica.failed:
+                replica.runtime.step()
+
+    def healthy(self) -> bool:
+        """Convergence gate: every slice hosts a live, admitting replica
+        again and no accepted request is still outstanding."""
+        if self.router.outstanding:
+            return False
+        admitting = {r.node_name for r in self.pool.admitting()}
+        return all(node in admitting for node in self.slice_nodes)
+
+    def verify_results(self) -> List[str]:
+        """Token determinism across replicas/handoffs: every completed
+        request's tokens equal the sim model's deterministic decode."""
+        out = []
+        for rid, req in self.router.requests.items():
+            if req.state == "completed" and req.tokens != sim_tokens(
+                    req.prompt, req.max_new):
+                out.append(f"request {rid} tokens diverged after "
+                           f"{req.handoffs} handoff(s)")
+        return out
+
+
 def run_scenario(scenario: Scenario, seed: int,
                  workdir: Optional[str] = None,
                  invariants: Optional[List[Invariant]] = None,
@@ -226,6 +361,7 @@ def run_scenario(scenario: Scenario, seed: int,
         workdir = tmp.name
     job = SimJob(os.path.join(workdir, "goodput.jsonl"),
                  scenario.fleet.slice_hosts(0)[0], clock)
+    tier = ServingTier(cluster, clock, injector, scenario.fleet, seed)
     checks = invariants if invariants is not None else default_invariants()
     budget = scaled_int_or_percent(scenario.max_unavailable,
                                    len(fleet_nodes), round_up=True)
@@ -260,6 +396,10 @@ def run_scenario(scenario: Scenario, seed: int,
                     op.reconcile()
             cluster.reconcile_daemonsets()
             job.tick(cluster)
+            # the router tier stops taking traffic once every fault
+            # window closed AND the rollout fired — outstanding work then
+            # drains, which the convergence gate requires
+            tier.tick(active=not (bumped and injector.quiet()))
             for hook in hooks or []:
                 hook(cluster=cluster, clock=clock, keys=keys, tick=tick)
             nodes = {n.metadata.name: n
@@ -273,7 +413,8 @@ def run_scenario(scenario: Scenario, seed: int,
                                          if op.alert_manager else [])
                               for identity, _, op in candidates},
                 ledger_path=job.path, workload_node=job.node_name,
-                tick_seconds=scenario.tick_seconds)
+                tick_seconds=scenario.tick_seconds,
+                router=tier.router)
             for inv in checks:
                 violations.extend(inv.check(view))
             if violations and stop_on_violation:
@@ -281,12 +422,18 @@ def run_scenario(scenario: Scenario, seed: int,
             # convergence may not be declared while the rollout trigger
             # or any fault window is still ahead — a healthy t=0 fleet is
             # not a survived scenario
-            if bumped and injector.quiet() and _converged(
-                    cluster, keys, nodes,
-                    bumped=scenario.upgrade_at is not None, job=job):
+            if bumped and injector.quiet() and tier.healthy() \
+                    and _converged(
+                        cluster, keys, nodes,
+                        bumped=scenario.upgrade_at is not None, job=job):
                 converged = True
                 break
             clock.advance(scenario.tick_seconds)
+        # end-of-run determinism sweep: any completed request whose
+        # tokens differ from the sim decode was corrupted by a handoff
+        for msg in tier.verify_results():
+            violations.append(Violation("router-exactly-once", tick,
+                                        clock.now() - 10_000.0, msg))
     finally:
         job.close()
         if tmp is not None:
@@ -295,7 +442,16 @@ def run_scenario(scenario: Scenario, seed: int,
         scenario=scenario.name, seed=seed, converged=converged,
         ticks=tick + 1, modelled_s=clock.now() - 10_000.0,
         violations=violations, trace=list(injector.trace),
-        failovers=failovers)
+        failovers=failovers,
+        router_stats={
+            "submitted": tier.submitted,
+            "completed": sum(
+                1 for r in tier.router.requests.values()
+                if r.state == "completed"),
+            "rerouted": tier.router._rerouted,
+            "drains": len(tier.router.drains),
+            "generations": tier._gen,
+        })
 
 
 def _converged(cluster: FakeCluster, keys: KeyFactory,

@@ -24,7 +24,16 @@ The catalog (docs/chaos.md has the full fault semantics):
                        next N eviction attempts (a PDB storm)
 ``spot-reclaim``       target nodes get a reclaim taint + deadline
                        annotation (the spot/preemption notice contract
-                       the elastic trainer consumes)
+                       the elastic trainer consumes; a reclaimed SERVING
+                       slice additionally drains through the router)
+``replica-kill``       serving replica processes on target nodes crash
+                       (in-flight requests lost at the replica; the
+                       router must re-place them without loss or
+                       double-serve)
+``metrics-flake``      the serving replicas' /metrics endpoints on
+                       target nodes stop answering (the router routes on
+                       stale backpressure signals; admission legality
+                       must hold anyway)
 """
 
 from __future__ import annotations
@@ -46,6 +55,8 @@ FAULT_TYPES = (
     "leader-loss",
     "eviction-storm",
     "spot-reclaim",
+    "replica-kill",
+    "metrics-flake",
 )
 
 # Spot/preemption reclaim notice wire contract: the cloud (or the chaos

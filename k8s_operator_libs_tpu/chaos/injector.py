@@ -154,6 +154,23 @@ class ChaosInjector:
             out.update(ev.targets)
         return out
 
+    def killed_replica_nodes(self) -> Set[str]:
+        """Nodes whose serving replica process is dead right now (active
+        replica-kill windows) — the campaign's serving tier kills the
+        matching runtimes and may respawn once the window heals."""
+        out: Set[str] = set()
+        for ev in self._active("replica-kill"):
+            out.update(ev.targets)
+        return out
+
+    def metrics_flake_nodes(self) -> Set[str]:
+        """Nodes whose replica /metrics endpoint is down right now — the
+        pool's scrape gate raises for replicas on them."""
+        out: Set[str] = set()
+        for ev in self._active("metrics-flake"):
+            out.update(ev.targets)
+        return out
+
     def quiet(self) -> bool:
         """True once every scheduled fault window has closed and every
         heal has run — the campaign requires this before convergence."""
@@ -236,7 +253,10 @@ class ChaosInjector:
                     pass
         elif ev.type == "watch-lag":
             self.cluster.cache_lag = float(ev.params.get("lag_s", 5.0))
-        # latency/flake/conflict windows act purely through before_op
+        # latency/flake/conflict windows act purely through before_op;
+        # replica-kill / metrics-flake act through the serving tier's
+        # killed_replica_nodes() / metrics_flake_nodes() polls (no
+        # cluster object models a replica process)
 
     def _heal(self, idx: int, ev: FaultEvent) -> None:
         self._log(f"HEAL   {ev.describe()}")

@@ -34,6 +34,14 @@ The catalog (:data:`INVARIANT_NAMES`):
                       observes splits into phases that SUM to the
                       window; journey-derived window segments partition
                       their window exactly.
+``router-exactly-once``  every request submitted to the serving router
+                      is always in exactly one of queued / assigned /
+                      completed and is DELIVERED at most once — across
+                      drain handoffs, replica kills, and reroutes.
+``router-admission``  the router never places a request on a replica
+                      whose node is cordoned, quarantined, or
+                      reclaim-tainted (checked against cluster truth at
+                      the tick the placement was made).
 
 :data:`FAULT_COVERAGE` maps every fault type to the invariants it
 stresses — CHS001 keeps it closed over ``FAULT_TYPES`` in both
@@ -58,6 +66,8 @@ INVARIANT_NAMES = (
     "event-dedup",
     "alert-transitions",
     "attribution",
+    "router-exactly-once",
+    "router-admission",
 )
 
 # fault type -> invariants that fault is designed to stress; CHS001
@@ -65,7 +75,8 @@ INVARIANT_NAMES = (
 # (and that no invariant is orphaned — unstressed checkers rot)
 FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
     "apiserver-latency": ("budget", "journey", "single-leader"),
-    "apiserver-flake": ("budget", "journey", "event-dedup"),
+    "apiserver-flake": ("budget", "journey", "event-dedup",
+                        "router-admission"),
     "conflict-storm": ("budget", "journey"),
     "watch-lag": ("budget", "journey"),
     "driver-crashloop": ("budget", "journey", "event-dedup",
@@ -73,7 +84,10 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
     "node-notready": ("budget", "alert-transitions"),
     "leader-loss": ("single-leader", "journey", "event-dedup"),
     "eviction-storm": ("budget", "journey", "attribution"),
-    "spot-reclaim": ("attribution", "event-dedup"),
+    "spot-reclaim": ("attribution", "event-dedup",
+                     "router-exactly-once", "router-admission"),
+    "replica-kill": ("router-exactly-once",),
+    "metrics-flake": ("router-admission", "router-exactly-once"),
 }
 
 # Legal pipeline edges (upgrade_state.py processing order + the failure
@@ -141,6 +155,10 @@ class CampaignView:
     ledger_path: Optional[str] = None         # simulated workload ledger
     workload_node: Optional[str] = None
     tick_seconds: float = 15.0
+    # the serving RequestRouter under test (None when the scenario runs
+    # no serving tier); the router invariants read its bookkeeping —
+    # requests, completed_counts, assignments_this_tick
+    router: Optional[object] = None
 
 
 class Invariant:
@@ -356,6 +374,73 @@ class AttributionInvariant(Invariant):
         return out
 
 
+class RouterExactlyOnceInvariant(Invariant):
+    """No request the router accepted is ever lost or double-served:
+    every rid is in exactly one of queued/assigned/completed, an
+    assigned rid's replica is alive, and the delivery count per rid
+    never exceeds one — across drain handoffs, kills, and reroutes."""
+
+    name = "router-exactly-once"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        router = view.router
+        if router is None:
+            return []
+        out: List[Violation] = []
+        for rid, count in router.completed_counts.items():
+            if count > 1:
+                out.append(self._v(
+                    view, f"request {rid} delivered {count} times "
+                    f"(double-serve across handoff)"))
+        live = {r.id for r in router.pool.replicas.values()
+                if not r.failed}
+        for rid, req in router.requests.items():
+            if req.state not in ("queued", "assigned", "completed"):
+                out.append(self._v(
+                    view, f"request {rid} in unknown state "
+                    f"{req.state!r} (lost)"))
+            elif req.state == "assigned" and req.replica_id not in live:
+                out.append(self._v(
+                    view, f"request {rid} assigned to dead replica "
+                    f"{req.replica_id} and never re-placed (lost)"))
+        return out
+
+
+class RouterAdmissionInvariant(Invariant):
+    """Admission legality against CLUSTER TRUTH: every placement the
+    router made this tick targets a node that is schedulable,
+    unquarantined, and not reclaim-tainted at check time (the campaign
+    reconciles the operator and runs the pod-side drain watch BEFORE the
+    router ticks, so a stale router view is no excuse)."""
+
+    name = "router-admission"
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        router = view.router
+        if router is None:
+            return []
+        from ..wire import QUARANTINE_LABEL, RECLAIM_TAINT_KEY
+        out: List[Violation] = []
+        for rid, replica_id, node_name in router.assignments_this_tick:
+            node = view.nodes.get(node_name)
+            if node is None:
+                continue
+            if node.spec.unschedulable:
+                out.append(self._v(
+                    view, f"request {rid} admitted to CORDONED node "
+                    f"{node_name} (replica {replica_id})"))
+            elif QUARANTINE_LABEL in node.metadata.labels:
+                out.append(self._v(
+                    view, f"request {rid} admitted to QUARANTINED node "
+                    f"{node_name} (replica {replica_id})"))
+            elif any(t.key == RECLAIM_TAINT_KEY
+                     for t in node.spec.taints):
+                out.append(self._v(
+                    view, f"request {rid} admitted to reclaim-tainted "
+                    f"node {node_name} (replica {replica_id})"))
+        return out
+
+
 def default_invariants() -> List[Invariant]:
     alerts = AlertTransitionInvariant()
     return [
@@ -365,4 +450,6 @@ def default_invariants() -> List[Invariant]:
         alerts,
         EventDedupInvariant(alerts),
         AttributionInvariant(),
+        RouterExactlyOnceInvariant(),
+        RouterAdmissionInvariant(),
     ]

@@ -178,6 +178,24 @@ def _parse_spot_reclaim(entry, fleet) -> FaultEvent:
                       params={"deadline_s": deadline}, **w)
 
 
+def _parse_replica_kill(entry, fleet) -> FaultEvent:
+    # the window is the OUTAGE: the replica process on the target nodes
+    # is dead until the window closes (then the campaign's serving tier
+    # may respawn a fresh generation there)
+    w = _window(entry, 120.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("replica-kill: duration must be positive "
+                            "(a zero-length kill window kills nothing)")
+    return FaultEvent("replica-kill", targets=_targets(entry, fleet), **w)
+
+
+def _parse_metrics_flake(entry, fleet) -> FaultEvent:
+    w = _window(entry, 90.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("metrics-flake: duration must be positive")
+    return FaultEvent("metrics-flake", targets=_targets(entry, fleet), **w)
+
+
 # fault type -> parser; CHS001 proves this dict's literal keys equal
 # FAULT_TYPES exactly (an unparseable fault type can never register)
 FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
@@ -190,6 +208,8 @@ FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
     "leader-loss": _parse_leader_loss,
     "eviction-storm": _parse_eviction_storm,
     "spot-reclaim": _parse_spot_reclaim,
+    "replica-kill": _parse_replica_kill,
+    "metrics-flake": _parse_metrics_flake,
 }
 
 
@@ -259,6 +279,14 @@ def random_scenario(seed: int) -> Scenario:
         elif ftype == "watch-lag":
             entry.update(duration=120.0,
                          lagSeconds=rng.choice([3.0, 8.0]))
+        elif ftype == "replica-kill":
+            entry.update(duration=rng.choice([60.0, 120.0]),
+                         slices=[rng.randrange(fleet["slices"])])
+        elif ftype == "metrics-flake":
+            entry.update(duration=rng.choice([60.0, 120.0]),
+                         slices=sorted(rng.sample(
+                             range(fleet["slices"]),
+                             k=rng.randint(1, fleet["slices"]))))
         # leader-loss needs no params: the injector partitions whoever
         # holds the lease when the fault lands
         faults.append(entry)

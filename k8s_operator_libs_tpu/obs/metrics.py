@@ -209,6 +209,46 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_workload_build_info":
         "Constant 1; labels carry the workload binary's version and "
         "model",
+    # router-tier families (serving/pool.py, serving/router.py,
+    # serving/autoscaler.py, exposed by cmd/router.py under the
+    # tpu_router prefix — a third disjoint namespace next to
+    # tpu_operator_* and tpu_workload_*; OBS003 closes these over the
+    # serving/metrics.py emitted-family tables both ways)
+    "tpu_router_replicas":
+        "Serving replicas currently registered with the router tier",
+    "tpu_router_replicas_admitting":
+        "Registered replicas accepting new requests (alive, not "
+        "draining, node schedulable/unquarantined)",
+    "tpu_router_replicas_draining":
+        "Replicas finishing in-flight work with admission stopped "
+        "(upgrade, quarantine, reclaim, or scale-down)",
+    "tpu_router_replicas_failed":
+        "Replicas whose runtime crashed or became unreachable",
+    "tpu_router_queue_depth":
+        "Requests held at the router waiting for a replica with "
+        "headroom",
+    "tpu_router_outstanding_requests":
+        "Accepted requests not yet completed (router queue + in flight "
+        "on replicas)",
+    "tpu_router_requests_routed":
+        "Requests placed on a replica at least once since router start",
+    "tpu_router_requests_completed":
+        "Requests delivered exactly once since router start",
+    "tpu_router_requests_rerouted":
+        "Request re-placements after a drain handoff or replica "
+        "failure (each re-placement counts once)",
+    "tpu_router_scale_target":
+        "The autoscaler's current desired replica count",
+    "tpu_router_scale_ups":
+        "Autoscaler scale-up decisions since router start",
+    "tpu_router_scale_downs":
+        "Autoscaler scale-down decisions since router start",
+    "tpu_router_handoff_requests":
+        "Queued-but-never-admitted requests migrated to peers per drain "
+        "handoff",
+    "tpu_router_replica_queue_depth":
+        "Scraped per-replica admission queue depth, sampled once per "
+        "router scrape cycle",
 }
 
 # ratio-valued histograms (occupancy, utilization) need sub-1.0 buckets —
@@ -219,6 +259,12 @@ RATIO_BUCKETS: Tuple[float, ...] = (
 # token-count histogram (generated tokens per request)
 TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# queue/handoff depth histograms (router tier: requests per handoff
+# batch, scraped per-replica queue depths) — small-count ladder starting
+# at 0 so an always-empty queue is distinguishable from a 1-deep one
+QUEUE_DEPTH_BUCKETS: Tuple[float, ...] = (
+    0, 1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def help_for(metric: str, default: Optional[str] = None) -> str:

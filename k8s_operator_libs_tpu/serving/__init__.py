@@ -1,0 +1,54 @@
+"""k8s_operator_libs_tpu.serving — the million-user front door.
+
+The router tier above ``models/serve.py``'s per-slice
+:class:`~..models.serve.ContinuousBatcher` replicas (docs/router.md):
+
+- :mod:`.pool`       — the replica registry: each replica is one serving
+                       runtime on one slice, registered in the cluster via
+                       the ``wire.py`` replica labels/annotations, with
+                       health/backpressure signals scraped from the
+                       replica ``/metrics`` endpoints and node state
+                       (cordon, quarantine, reclaim, upgrade journey)
+                       refreshed through the client boundary;
+- :mod:`.router`     — request routing with session + shared-prefix
+                       affinity and least-outstanding-work placement,
+                       plus the drain-aware handoff: a replica whose node
+                       enters the upgrade pipeline stops admitting BEFORE
+                       the cordon lands, in-flight requests finish there,
+                       the untouched queue migrates to peers, and no
+                       request is ever lost or double-served;
+- :mod:`.autoscaler` — reconcile-tick autoscaling from the SLO engine's
+                       burn-rate signals (``obs/slo.py`` serving-ttft-p99)
+                       and queue depth: scale up (placing new slices via
+                       ``tpu/scheduler.py``) before the error budget is
+                       gone, scale down on sustained idle, every decision
+                       journaled as an Event and a gauge;
+- :mod:`.sim`        — a deterministic, JAX-free replica runtime so the
+                       chaos campaign (``chaos/``) can drive the router
+                       tier thousands of modelled seconds per wall
+                       second;
+- :mod:`.metrics`    — the closed ``tpu_router_*`` metric-family tables
+                       the OBS003 lint pass keeps in sync with
+                       ``obs/metrics.py::HELP_TEXTS``.
+
+Layering: ``serving`` sits ABOVE ``models`` and ``obs`` (it consumes the
+batcher and the SLO engine) and BELOW ``chaos`` (the campaign drives it
+under injected faults); ARC001 enforces the DAG. Everything is
+clock-injected and free of unseeded randomness (DET001/DET002), so the
+chaos campaign replays router scenarios bit-for-bit from one seed.
+"""
+
+from .autoscaler import Autoscaler, AutoscalerConfig
+from .metrics import (ROUTER_GAUGE_FAMILIES, ROUTER_HISTOGRAM_FAMILIES,
+                      ROUTER_PREFIX)
+from .pool import (BatcherRuntime, NodeState, Replica, ReplicaPool,
+                   parse_gauges)
+from .router import DRAIN_STATES, RequestRouter, RouterRequest
+from .sim import SimReplicaRuntime, sim_tokens
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "BatcherRuntime", "DRAIN_STATES",
+    "NodeState", "Replica", "ReplicaPool", "RequestRouter",
+    "ROUTER_GAUGE_FAMILIES", "ROUTER_HISTOGRAM_FAMILIES", "ROUTER_PREFIX",
+    "RouterRequest", "SimReplicaRuntime", "parse_gauges", "sim_tokens",
+]

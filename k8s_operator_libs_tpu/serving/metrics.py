@@ -1,0 +1,42 @@
+"""The closed ``tpu_router_*`` metric-family tables.
+
+Every family the router tier emits is declared here as a plain string
+literal, exactly like ``obs/slo.py::SLO_GAUGE_FAMILIES``: the OBS003
+lint pass (``tools/lint/obs_check.py``) closes these tuples over the
+shared HELP registry (``obs/metrics.py::HELP_TEXTS``) in both
+directions — an emitted family with no HELP entry fires, and a
+``tpu_router_*`` HELP entry matching no family here is a renamed or
+removed gauge seen from the catalog side.
+
+The router's :class:`~..obs.metrics.MetricsHub` renders under
+:data:`ROUTER_PREFIX`, so a combined operator + workload + router scrape
+never collides (``tpu_operator_*`` / ``tpu_workload_*`` /
+``tpu_router_*`` are disjoint namespaces).
+"""
+
+from __future__ import annotations
+
+ROUTER_PREFIX = "tpu_router"
+
+# gauge families the pool/router/autoscaler emit through the hub (full
+# exposed names; literal — OBS003 closes this over HELP_TEXTS both ways)
+ROUTER_GAUGE_FAMILIES = (
+    "tpu_router_replicas",
+    "tpu_router_replicas_admitting",
+    "tpu_router_replicas_draining",
+    "tpu_router_replicas_failed",
+    "tpu_router_queue_depth",
+    "tpu_router_outstanding_requests",
+    "tpu_router_requests_routed",
+    "tpu_router_requests_completed",
+    "tpu_router_requests_rerouted",
+    "tpu_router_scale_target",
+    "tpu_router_scale_ups",
+    "tpu_router_scale_downs",
+)
+
+# histogram families (bucket ladders from obs/metrics.py)
+ROUTER_HISTOGRAM_FAMILIES = (
+    "tpu_router_handoff_requests",
+    "tpu_router_replica_queue_depth",
+)

@@ -1,0 +1,412 @@
+"""The request router: affinity placement + drain-aware handoff.
+
+One :class:`RequestRouter` fronts a :class:`~.pool.ReplicaPool`. The
+contract it maintains — checked every tick by the chaos campaign's
+router invariants (``chaos/invariants.py``) and the N-replica rolling
+upgrade e2e (``tests/test_serve_upgrade_e2e.py``):
+
+- **exactly once**: every submitted request is always in exactly one of
+  queued / assigned / completed, and is delivered exactly once — across
+  drain handoffs, replica crashes, and rolling upgrades;
+- **admission legality**: a new request is never placed on a replica
+  whose node is cordoned, quarantined, or reclaim-tainted;
+- **drain before cordon**: the moment a replica's node enters
+  ``cordon-required`` (admitted to the upgrade pipeline, cordon
+  imminent but NOT yet applied) the router stops admitting there,
+  stamps the :data:`~..wire.DRAIN_INTENT_ANNOTATION`, lets in-flight
+  requests finish on the draining replica, and migrates the untouched
+  queue to peers. The operator's wait-for-jobs gate then holds the
+  driver restart until the drained server's pod completes — the same
+  zero-loss mechanism the single-replica e2e proved, now fleet-wide.
+
+Placement: session affinity (a ``session`` id pins to its last replica
+while that replica admits), shared-prefix affinity (requests opening
+with the same prompt head prefer the replica whose prefix cache is
+already warm — vLLM-style, reduced to a head-token key), then weighted
+least-outstanding-work with backpressure (a replica whose scraped queue
+depth exceeds ``queue_high`` is skipped while any peer has headroom).
+
+Everything is clock-injected; the only state is host dicts — the router
+adds no device work and can tick thousands of times per wall second
+under the chaos campaign's FakeClock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.clock import Clock, RealClock
+from ..wire import DRAIN_INTENT_ANNOTATION
+from .pool import DRAIN_STATES, Replica, ReplicaPool
+
+logger = logging.getLogger(__name__)
+
+QUEUED = "queued"
+ASSIGNED = "assigned"
+COMPLETED = "completed"
+
+# how many head tokens key the shared-prefix affinity map
+PREFIX_KEY_TOKENS = 16
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """One request's lifecycle under the router."""
+
+    rid: int
+    prompt: Tuple[int, ...]
+    max_new: int
+    session: Optional[str] = None
+    state: str = QUEUED
+    replica_id: Optional[str] = None
+    local_rid: Optional[int] = None
+    tokens: Optional[list] = None
+    submitted_t: float = 0.0
+    completed_t: Optional[float] = None
+    handoffs: int = 0          # times re-placed (drain or crash)
+
+    @property
+    def prefix_key(self) -> Tuple[int, ...]:
+        return self.prompt[:PREFIX_KEY_TOKENS]
+
+
+class RequestRouter:
+    def __init__(self, pool: ReplicaPool, metrics=None,
+                 clock: Optional[Clock] = None, queue_high: float = 8.0):
+        self.pool = pool
+        self._metrics = metrics
+        self._clock = clock or RealClock()
+        self.queue_high = float(queue_high)
+        self.requests: Dict[int, RouterRequest] = {}
+        self._next_rid = 0
+        self._queue: List[int] = []                 # FIFO of queued rids
+        self._local2global: Dict[Tuple[str, int], int] = {}
+        self._session_map: Dict[str, str] = {}      # session -> replica id
+        self._prefix_map: Dict[Tuple[int, ...], str] = {}
+        # per-tick admission log the invariants check: (rid, replica id,
+        # node name) for every placement made in the LAST tick()
+        self.assignments_this_tick: List[Tuple[int, str, str]] = []
+        # rid -> delivery count; anything above 1 is a double-serve
+        self.completed_counts: Dict[int, int] = {}
+        # (replica id, node, reason, node-was-schedulable) per drain
+        self.drains: List[Tuple[str, str, str, bool]] = []
+        self._routed = 0
+        self._rerouted = 0
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt, max_new: int,
+               session: Optional[str] = None) -> int:
+        """Accept a request; it places immediately when a replica has
+        headroom, otherwise queues until :meth:`tick` finds one."""
+        rid = self._next_rid
+        self._next_rid += 1
+        req = RouterRequest(rid=rid,
+                            prompt=tuple(int(t) for t in prompt),
+                            max_new=int(max_new), session=session,
+                            submitted_t=self._clock.now())
+        self.requests[rid] = req
+        self._queue.append(rid)
+        self._place_queued()
+        return rid
+
+    def result(self, rid: int):
+        """Completed tokens for ``rid`` (None while in flight)."""
+        req = self.requests[rid]
+        return req.tokens if req.state == COMPLETED else None
+
+    @property
+    def outstanding(self) -> int:
+        return sum(1 for r in self.requests.values()
+                   if r.state != COMPLETED)
+
+    # ------------------------------------------------------------- tick
+
+    def tick(self) -> None:
+        """One reconcile tick: refresh cluster views, watch for drains,
+        collect completions, re-place handed-off work, update gauges."""
+        self.assignments_this_tick = []
+        self.pool.refresh_nodes()
+        self.pool.scrape()
+        self._watch_drains()
+        self._collect_failures()
+        self._collect_completions()
+        self._place_queued()
+        self._mark_drained()
+        self._update_gauges()
+
+    # ------------------------------------------------------------ drains
+
+    def _drain_reason(self, replica: Replica) -> Optional[str]:
+        state = self.pool.node_states.get(replica.node_name)
+        if state is None or not state.known:
+            return None
+        if state.quarantined:
+            return "quarantined"
+        if state.reclaim_tainted:
+            return "reclaim"
+        if state.state_label in DRAIN_STATES:
+            return f"upgrade:{state.state_label}"
+        if not state.schedulable:
+            return "cordoned"
+        return None
+
+    def _watch_drains(self) -> None:
+        for replica in self.pool.live():
+            if replica.draining:
+                continue
+            reason = self._drain_reason(replica)
+            if reason is None and replica.stats.draining:
+                # the replica began draining on its own (pod-side SIGTERM
+                # watcher, or an operator outside this router) — follow it
+                reason = "replica-initiated"
+            if reason is not None:
+                self.drain_replica(replica, reason)
+
+    def drain_replica(self, replica: Replica, reason: str) -> None:
+        """Stop admitting to ``replica``, persist the intent, and migrate
+        its untouched queue to peers. In-flight requests keep running on
+        the draining replica until they finish (collected by later
+        ticks); only never-admitted requests move."""
+        if replica.draining:
+            return
+        state = self.pool.node_states.get(replica.node_name)
+        schedulable_at_drain = state.schedulable if (
+            state is not None and state.known) else True
+        replica.draining = True
+        replica.drain_reason = reason
+        self.drains.append((replica.id, replica.node_name, reason,
+                            schedulable_at_drain))
+        if self.pool.client is not None:
+            try:
+                self.pool.client.patch_node_metadata(
+                    replica.node_name, annotations={
+                        DRAIN_INTENT_ANNOTATION:
+                            f"{reason}@{self._clock.wall():.3f}"})
+            except Exception:
+                logger.warning("could not stamp drain intent on %s",
+                               replica.node_name, exc_info=True)
+        try:
+            replica.runtime.drain()
+            handoff = replica.runtime.handoff()
+        except Exception:
+            logger.exception("drain of replica %s failed; treating its "
+                             "runtime as crashed", replica.id)
+            replica.failed = True
+            handoff = []
+        migrated = 0
+        for local_rid, _prompt, _max_new in handoff:
+            rid = self._local2global.pop((replica.id, local_rid), None)
+            if rid is None:
+                continue
+            self._requeue(rid)
+            migrated += 1
+        if self._metrics is not None:
+            self._metrics.observe("handoff_requests", migrated,
+                                  buckets=_depth_buckets())
+        logger.info("draining replica %s on %s (%s): %d queued requests "
+                    "migrated to peers", replica.id, replica.node_name,
+                    reason, migrated)
+
+    def _mark_drained(self) -> None:
+        for replica in self.pool.live():
+            if replica.draining and not replica.drained:
+                try:
+                    if replica.runtime.idle:
+                        replica.drained = True
+                except Exception:
+                    replica.failed = True
+
+    # ---------------------------------------------------------- failures
+
+    def _collect_failures(self) -> None:
+        """A crashed replica loses its in-flight work — those requests
+        were never delivered, so they re-place on peers (a re-decode, not
+        a double-serve: greedy decoding is deterministic and the dead
+        runtime can never deliver its copy)."""
+        for replica in self.pool.replicas.values():
+            alive = True
+            try:
+                alive = replica.runtime.alive()
+            except Exception:
+                alive = False
+            if alive and not replica.stats.failed:
+                continue
+            if not replica.failed:
+                replica.failed = True
+                logger.warning("replica %s on %s failed; re-placing its "
+                               "in-flight requests", replica.id,
+                               replica.node_name)
+            for rid, req in self.requests.items():
+                if req.state == ASSIGNED and req.replica_id == replica.id:
+                    self._local2global.pop((replica.id, req.local_rid),
+                                           None)
+                    self._requeue(rid)
+
+    def _requeue(self, rid: int) -> None:
+        req = self.requests[rid]
+        req.state = QUEUED
+        req.replica_id = None
+        req.local_rid = None
+        req.handoffs += 1
+        self._rerouted += 1
+        self._queue.append(rid)
+
+    # ------------------------------------------------------- completions
+
+    def _collect_completions(self) -> None:
+        for replica in self.pool.replicas.values():
+            if replica.failed:
+                continue
+            try:
+                done = replica.runtime.poll()
+            except Exception:
+                replica.failed = True
+                continue
+            for local_rid, tokens in done.items():
+                rid = self._local2global.pop((replica.id, local_rid),
+                                             None)
+                if rid is None:
+                    continue
+                req = self.requests[rid]
+                self.completed_counts[rid] = \
+                    self.completed_counts.get(rid, 0) + 1
+                if req.state == COMPLETED:
+                    # double-serve: keep the first result, leave the
+                    # count > 1 for the invariant to flag
+                    continue
+                req.state = COMPLETED
+                req.tokens = [int(t) for t in tokens]
+                req.completed_t = self._clock.now()
+
+    # --------------------------------------------------------- placement
+
+    def _candidates(self) -> List[Replica]:
+        admitting = self.pool.admitting()
+        roomy = [r for r in admitting
+                 if r.stats.stale or r.stats.queue_depth < self.queue_high]
+        return roomy or []
+
+    def _outstanding_on(self, replica: Replica) -> int:
+        return sum(1 for r in self.requests.values()
+                   if r.state == ASSIGNED and r.replica_id == replica.id)
+
+    def _pick(self, req: RouterRequest) -> Optional[Replica]:
+        candidates = self._candidates()
+        if not candidates:
+            return None
+        by_id = {r.id: r for r in candidates}
+        if req.session is not None:
+            sticky = self._session_map.get(req.session)
+            if sticky in by_id:
+                return by_id[sticky]
+        warm = self._prefix_map.get(req.prefix_key)
+        if warm in by_id:
+            return by_id[warm]
+        # weighted least outstanding work; ties break on registration
+        # order (the candidates list preserves pool insertion order)
+        return min(candidates,
+                   key=lambda r: ((self._outstanding_on(r)
+                                   + r.stats.queue_depth) / r.weight))
+
+    def _place_queued(self) -> None:
+        remaining: List[int] = []
+        for rid in self._queue:
+            req = self.requests[rid]
+            if req.state != QUEUED:
+                continue        # completed/assigned through another path
+            target = self._pick(req)
+            if target is None:
+                remaining.append(rid)
+                continue
+            try:
+                local = target.runtime.submit(list(req.prompt),
+                                              req.max_new)
+            except Exception:
+                logger.warning("submit to replica %s refused; requeueing",
+                               target.id, exc_info=True)
+                target.stats.draining = True   # stop picking it this tick
+                remaining.append(rid)
+                continue
+            req.state = ASSIGNED
+            req.replica_id = target.id
+            req.local_rid = local
+            self._local2global[(target.id, local)] = rid
+            self.assignments_this_tick.append(
+                (rid, target.id, target.node_name))
+            if req.session is not None:
+                self._session_map[req.session] = target.id
+            self._prefix_map[req.prefix_key] = target.id
+            if req.handoffs == 0:
+                self._routed += 1
+        self._queue = remaining
+
+    # ------------------------------------------------------------ gauges
+
+    def _update_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        live = self.pool.live()
+        self._metrics.set_gauge("replicas", len(self.pool.replicas))
+        self._metrics.set_gauge("replicas_admitting",
+                                len(self.pool.admitting()))
+        self._metrics.set_gauge("replicas_draining",
+                                sum(1 for r in live if r.draining))
+        self._metrics.set_gauge(
+            "replicas_failed",
+            sum(1 for r in self.pool.replicas.values() if r.failed))
+        self._metrics.set_gauge("queue_depth", len(self._queue))
+        self._metrics.set_gauge("outstanding_requests", self.outstanding)
+        self._metrics.set_gauge("requests_routed", self._routed)
+        self._metrics.set_gauge(
+            "requests_completed",
+            sum(1 for r in self.requests.values()
+                if r.state == COMPLETED))
+        self._metrics.set_gauge("requests_rerouted", self._rerouted)
+
+    # --------------------------------------------------------- invariants
+
+    def check_invariants(self, nodes=None) -> List[str]:
+        """The two standing router invariants, as violation strings
+        (empty = clean). ``nodes`` (optional ``{name: Node}``) lets the
+        caller check this tick's admissions against cluster truth; the
+        chaos campaign wires the same checks through
+        ``chaos/invariants.py`` instead."""
+        out: List[str] = []
+        for rid, count in self.completed_counts.items():
+            if count > 1:
+                out.append(f"request {rid} delivered {count} times "
+                           f"(double-serve)")
+        for rid, req in self.requests.items():
+            if req.state not in (QUEUED, ASSIGNED, COMPLETED):
+                out.append(f"request {rid} in unknown state {req.state!r}"
+                           f" (lost)")
+            if req.state == ASSIGNED:
+                replica = self.pool.replicas.get(req.replica_id)
+                if replica is None or replica.failed:
+                    out.append(f"request {rid} assigned to dead replica "
+                               f"{req.replica_id} (lost)")
+        if nodes is not None:
+            from ..wire import QUARANTINE_LABEL, RECLAIM_TAINT_KEY
+            for rid, replica_id, node_name in self.assignments_this_tick:
+                node = nodes.get(node_name)
+                if node is None:
+                    continue
+                if node.spec.unschedulable:
+                    out.append(f"request {rid} admitted to CORDONED node "
+                               f"{node_name} (replica {replica_id})")
+                elif QUARANTINE_LABEL in node.metadata.labels:
+                    out.append(f"request {rid} admitted to QUARANTINED "
+                               f"node {node_name}")
+                elif any(t.key == RECLAIM_TAINT_KEY
+                         for t in node.spec.taints):
+                    out.append(f"request {rid} admitted to reclaim-"
+                               f"tainted node {node_name}")
+        return out
+
+
+def _depth_buckets():
+    from ..obs.metrics import QUEUE_DEPTH_BUCKETS
+    return QUEUE_DEPTH_BUCKETS

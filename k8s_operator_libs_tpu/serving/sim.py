@@ -1,0 +1,130 @@
+"""A deterministic, JAX-free replica runtime for campaigns and units.
+
+The chaos campaign ticks the whole world thousands of modelled seconds
+per wall second; compiling a real batcher there would dominate the run
+and add nothing — the router's correctness properties (exactly-once,
+admission legality, drain handoff) are about BOOKKEEPING, not tokens.
+:class:`SimReplicaRuntime` implements the same adapter surface as
+:class:`~.pool.BatcherRuntime` (same drain/handoff semantics as
+``models/serve.py``, same ``tpu_workload_serve_*`` gauge names in its
+``/metrics`` text) with a pure-host model: a request with ``max_new``
+tokens completes after ``ceil(max_new / tokens_per_step)`` steps and its
+output is :func:`sim_tokens` — a deterministic function of the prompt,
+so "token-identical no matter which replica served it" stays checkable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+
+def sim_tokens(prompt, max_new: int) -> List[int]:
+    """The sim model's full decode: prompt + a deterministic tail (any
+    two replicas given the same request produce the same tokens)."""
+    prompt = [int(t) for t in prompt]
+    basis = sum(prompt) % 997
+    return prompt + [(basis + 31 * i) % 32000 for i in range(max_new)]
+
+
+class _SimRequest:
+    def __init__(self, rid: int, prompt, max_new: int):
+        self.rid = rid
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.steps_left = 0
+
+
+class SimReplicaRuntime:
+    def __init__(self, max_slots: int = 4, tokens_per_step: int = 4):
+        self.max_slots = max_slots
+        self.tokens_per_step = max(1, tokens_per_step)
+        self._queue: List[_SimRequest] = []
+        self._running: Dict[int, _SimRequest] = {}
+        self._done: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        self._draining = False
+        self._failed = False
+        self.steps = 0
+
+    # ----------------------------------------------------------- surface
+
+    def submit(self, prompt, max_new: int) -> int:
+        if self._draining:
+            raise RuntimeError("server is draining; submit to a peer")
+        if self._failed:
+            raise RuntimeError("server failed; submit to a peer")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_SimRequest(rid, prompt, max_new))
+        return rid
+
+    def poll(self) -> Dict[int, List[int]]:
+        if self._failed:
+            return {}
+        out, self._done = self._done, {}
+        return out
+
+    def drain(self) -> None:
+        self._draining = True
+
+    def handoff(self) -> List[Tuple[int, List[int], int]]:
+        if not self._draining:
+            raise RuntimeError("handoff() before drain() would drop a "
+                               "live queue")
+        out = [(r.rid, list(r.prompt), r.max_new) for r in self._queue]
+        self._queue.clear()
+        return out
+
+    @property
+    def idle(self) -> bool:
+        if self._draining:
+            return not self._running
+        return not self._queue and not self._running
+
+    def alive(self) -> bool:
+        return not self._failed
+
+    def fail(self) -> None:
+        """The replica process dies: in-flight work is lost, results are
+        never delivered, submits are refused."""
+        self._failed = True
+        self._running.clear()
+        self._done.clear()
+
+    def step(self, n: int = 1) -> None:
+        if self._failed:
+            return
+        for _ in range(max(1, n)):
+            self.steps += 1
+            while (self._queue and len(self._running) < self.max_slots
+                   and not self._draining):
+                req = self._queue.pop(0)
+                req.steps_left = max(
+                    1, math.ceil(req.max_new / self.tokens_per_step))
+                self._running[req.rid] = req
+            finished = []
+            for rid, req in self._running.items():
+                req.steps_left -= 1
+                if req.steps_left <= 0:
+                    finished.append(rid)
+            for rid in finished:
+                req = self._running.pop(rid)
+                self._done[rid] = sim_tokens(req.prompt, req.max_new)
+
+    # ----------------------------------------------------------- metrics
+
+    def metrics_text(self) -> str:
+        """Minimal exposition carrying exactly the backpressure gauges
+        :meth:`~.pool.ReplicaPool.scrape` consumes, under the same names
+        a real ``cmd/serve.py`` /metrics scrape returns."""
+        gauges = {
+            "tpu_workload_serve_queue_depth": len(self._queue),
+            "tpu_workload_serve_slots_busy": len(self._running),
+            "tpu_workload_serve_slots_total": self.max_slots,
+            "tpu_workload_serve_draining": 1 if self._draining else 0,
+            "tpu_workload_serve_failed": 1 if self._failed else 0,
+            "tpu_workload_serve_up": 0 if self._failed else 1,
+        }
+        return "\n".join(f"{name} {value}"
+                         for name, value in sorted(gauges.items())) + "\n"

@@ -70,3 +70,17 @@ RECLAIM_DEADLINE_ANNOTATION = "tpu.dev/spot-reclaim-deadline"
 # the workload's slice claim) carries it; the upgrade library's workload
 # deletion filter and wait-for-completion selector match on it.
 WORKLOAD_LABEL = "tpu.dev/workload"
+
+# -------------------------------------------------------------- serving
+# Router-tier replica registry (docs/router.md). The replica id label
+# marks a node as hosting a serving replica; the weight label biases the
+# router's least-outstanding-work placement; the endpoint annotation
+# carries the replica's HTTP base URL so external agents (status views,
+# a restarted router) can rebuild the registry from the cluster.
+REPLICA_ID_LABEL = "tpu.dev/serving-replica"
+REPLICA_WEIGHT_LABEL = "tpu.dev/serving-replica-weight"
+REPLICA_ENDPOINT_ANNOTATION = "tpu.dev/serving.endpoint"
+# Stamped by the router the moment it decides to drain a replica —
+# BEFORE the operator cordons the node — so the handoff decision is
+# durable, observable, and attributable (value: "<reason>@<wall secs>").
+DRAIN_INTENT_ANNOTATION = "tpu.dev/serving.drain-intent"

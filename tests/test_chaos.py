@@ -286,3 +286,128 @@ def test_shrink_failure_minimizes_fault_schedule():
     minimal = shrink_failure(sc, seed=2)
     assert len(minimal.faults) == 1
     assert run_scenario(minimal, seed=2).failed
+
+
+# --------------------------------------------------- router-tier faults
+
+
+def test_parse_router_faults_validate():
+    sc = parse_scenario({
+        "name": "rk", "fleet": {"slices": 2, "hosts_per_slice": 2},
+        "faults": [
+            {"type": "replica-kill", "at": 10, "duration": 60,
+             "slices": [0]},
+            {"type": "metrics-flake", "at": 20, "duration": 30,
+             "slices": [0, 1]},
+        ]})
+    assert sc.faults[0].targets == ["pool-0-h0", "pool-0-h1"]
+    assert sc.faults[1].targets == ["pool-0-h0", "pool-0-h1",
+                                    "pool-1-h0", "pool-1-h1"]
+    with pytest.raises(ScenarioError, match="duration"):
+        parse_scenario({"faults": [{"type": "replica-kill", "at": 0,
+                                    "duration": 0}]})
+    with pytest.raises(ScenarioError, match="duration"):
+        parse_scenario({"faults": [{"type": "metrics-flake", "at": 0,
+                                    "duration": 0}]})
+
+
+ROUTER_CHAOS = {
+    "name": "router-faults-e2e",
+    "max_ticks": 400,
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 0},
+    "upgrade_at": 30.0,
+    "faults": [
+        {"type": "replica-kill", "at": 60.0, "duration": 90.0,
+         "slices": [0]},
+        {"type": "metrics-flake", "at": 75.0, "duration": 60.0,
+         "slices": [0, 1]},
+        {"type": "spot-reclaim", "at": 200.0, "duration": 120.0,
+         "deadlineSeconds": 60.0, "slices": [1]},
+    ],
+}
+
+
+def test_campaign_router_faults_converge_exactly_once(tmp_path):
+    """Router-tier acceptance e2e: a replica process kill, a fleet-wide
+    metrics-endpoint flake, and a reclaim of a serving slice — all while
+    a rolling upgrade walks the fleet. The router invariants hold every
+    tick (no request lost or double-served, admission never lands on a
+    cordoned/quarantined/reclaimed slice), the killed replica's node
+    hosts a fresh generation, and the fleet converges."""
+    res = run_scenario(parse_scenario(ROUTER_CHAOS), seed=13,
+                       workdir=str(tmp_path))
+    assert res.violations == [], "\n".join(map(str, res.violations))
+    assert res.converged, res.report()
+    stats = res.router_stats
+    assert stats["submitted"] > 0
+    assert stats["completed"] == stats["submitted"], \
+        "requests were lost across the faults"
+    # the kill forced a respawn (a new generation beyond the initial 2)
+    # and at least one drain rode the reclaim/upgrade
+    assert stats["generations"] > 2
+    assert stats["drains"] >= 1
+
+
+def test_campaign_replica_kill_same_seed_same_router_stats(tmp_path):
+    sc = parse_scenario(ROUTER_CHAOS)
+    r1 = run_scenario(sc, seed=3)
+    r2 = run_scenario(sc, seed=3)
+    assert r1.router_stats == r2.router_stats
+    assert r1.trace == r2.trace
+
+
+def _campaign_view_for(router, nodes):
+    from k8s_operator_libs_tpu.chaos.invariants import CampaignView
+    return CampaignView(tick=1, t=15.0, nodes=nodes, keys=KEYS,
+                        budget=10, fault_notready=set(), leaders=["op-a"],
+                        recorder_events=[], alert_status={},
+                        router=router)
+
+
+def test_router_exactly_once_invariant_catches_double_serve():
+    from k8s_operator_libs_tpu.chaos.invariants import (
+        RouterExactlyOnceInvariant)
+    from k8s_operator_libs_tpu.serving import (Replica, ReplicaPool,
+                                               RequestRouter,
+                                               SimReplicaRuntime)
+    pool = ReplicaPool(component="libtpu", clock=FakeClock())
+    pool.register(Replica("a", "node-a", SimReplicaRuntime()))
+    router = RequestRouter(pool, clock=FakeClock())
+    rid = router.submit([1, 2], 2)
+    inv = RouterExactlyOnceInvariant()
+    assert inv.check(_campaign_view_for(router, {})) == []
+    # a rogue duplicate delivery must be flagged the tick it appears
+    router.completed_counts[rid] = 2
+    out = inv.check(_campaign_view_for(router, {}))
+    assert len(out) == 1 and "delivered 2 times" in out[0].detail
+    # and a request stranded on a dead replica is a loss
+    router.completed_counts[rid] = 1
+    pool.replicas["a"].failed = True
+    out = inv.check(_campaign_view_for(router, {}))
+    assert any("dead replica" in v.detail for v in out)
+
+
+def test_router_admission_invariant_catches_cordoned_placement():
+    from k8s_operator_libs_tpu.chaos.invariants import (
+        RouterAdmissionInvariant)
+    from k8s_operator_libs_tpu.serving import (Replica, ReplicaPool,
+                                               RequestRouter,
+                                               SimReplicaRuntime)
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("node-a")
+    pool = ReplicaPool(component="libtpu", clock=clock,
+                       client=cluster.client)
+    pool.register(Replica("a", "node-a", SimReplicaRuntime()))
+    router = RequestRouter(pool, clock=clock)
+    router.submit([1], 2)
+    nodes = {n.metadata.name: n
+             for n in cluster.client.direct().list_nodes()}
+    inv = RouterAdmissionInvariant()
+    assert inv.check(_campaign_view_for(router, nodes)) == []
+    # rogue: the node was cordoned, yet an assignment targeted it
+    cluster.client.direct().patch_node_unschedulable("node-a", True)
+    nodes = {n.metadata.name: n
+             for n in cluster.client.direct().list_nodes()}
+    out = inv.check(_campaign_view_for(router, nodes))
+    assert len(out) == 1 and "CORDONED" in out[0].detail

@@ -594,12 +594,14 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 # ------------------------------------- OBS003 (SLO catalog, mutated)
 
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
-              obs_check.METRICS_PATH]
+              obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH]
 
 
-def _obs3_root(tmp_path, mutate=None):
+def _obs3_root(tmp_path, mutate=None, skip=()):
     root = tmp_path / "repo3"
     for rel in OBS3_FILES:
+        if rel in skip:
+            continue
         src = (REPO / rel).read_text()
         if mutate and rel in mutate:
             src = mutate[rel](src)
@@ -660,14 +662,63 @@ def test_obs003_stale_help_entry_fails(tmp_path):
 
 
 def test_obs003_non_slo_help_entries_stay_exempt(tmp_path):
-    """Only the slo/alert prefixes are closed over the emitted tables —
-    the rest of the catalog (phase histograms, workload families) is
-    owned by other layers and must not fire here."""
+    """Only the slo/alert/router prefixes are closed over the emitted
+    tables — the rest of the catalog (phase histograms, workload
+    families) is owned by other layers and must not fire here."""
     root = _obs3_root(tmp_path, mutate={
         obs_check.METRICS_PATH: lambda s: s.replace(
             '    "tpu_operator_alert_firing":',
             '    "tpu_operator_some_new_histogram": "fine",\n'
             '    "tpu_operator_alert_firing":')})
+    assert obs_check.run_slo(root) == []
+
+
+def test_obs003_router_family_without_help_fails(tmp_path):
+    """A new router family in serving/metrics.py with no HELP_TEXTS
+    entry would render with the underscores-to-spaces fallback."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.ROUTER_METRICS_PATH: lambda s: s.replace(
+            '    "tpu_router_replicas",',
+            '    "tpu_router_replicas",\n'
+            '    "tpu_router_phantom_gauge",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "tpu_router_phantom_gauge" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_stale_router_help_entry_fails(tmp_path):
+    """A tpu_router_* HELP entry nothing emits is a renamed or removed
+    router metric seen from the catalog side."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_router_replicas":',
+            '    "tpu_router_ghost": "phantom router gauge",\n'
+            '    "tpu_router_replicas":')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_router_ghost" in msgs
+    assert "no emitted" in msgs and "ROUTER_GAUGE_FAMILIES" in msgs
+
+
+def test_obs003_router_table_gutted_fails(tmp_path):
+    """Renaming an emitted-family table away is parse drift, not a
+    silent pass."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.ROUTER_METRICS_PATH: lambda s: s.replace(
+            "ROUTER_HISTOGRAM_FAMILIES = (",
+            "ROUTER_HISTOGRAM_TABLES = (")})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "ROUTER_HISTOGRAM_FAMILIES" in msgs
+
+
+def test_obs003_no_serving_package_skips_router_closure(tmp_path):
+    """A checkout without the serving package (the fixture scratch roots
+    of older passes, a stripped deployment) must not fire on its
+    tpu_router_* HELP entries — the closure needs both sides present."""
+    root = _obs3_root(tmp_path, skip={obs_check.ROUTER_METRICS_PATH})
     assert obs_check.run_slo(root) == []
 
 
@@ -728,8 +779,8 @@ def test_chs001_dropped_parser_fails_naming_fault(tmp_path):
 def test_chs001_stale_coverage_key_fails(tmp_path):
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "spot-reclaim": ("attribution", "event-dedup"),',
-            '    "spot-reclaim": ("attribution", "event-dedup"),\n'
+            '    "replica-kill": ("router-exactly-once",),',
+            '    "replica-kill": ("router-exactly-once",),\n'
             '    "meteor-strike": ("budget",),')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
@@ -750,8 +801,8 @@ def test_chs001_orphan_invariant_fails(tmp_path):
     """An invariant no fault stresses is a checker that rots silently."""
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "attribution",\n)',
-            '    "attribution",\n    "entropy",\n)')})
+            '    "router-admission",\n)',
+            '    "router-admission",\n    "entropy",\n)')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "entropy" in msgs and "stressed by no fault" in msgs

@@ -1,0 +1,523 @@
+"""Router-tier unit coverage (docs/router.md): replica registry +
+scrape, affinity and least-outstanding-work placement, backpressure,
+drain-aware handoff with exactly-once delivery, replica-failure
+rerouting, autoscaler hysteresis and the synthetic TTFT-burn scale-up,
+and the cmd/router.py HTTP front. Everything here runs on the
+deterministic JAX-free SimReplicaRuntime; the real-batcher integration
+lives in tests/test_serve_upgrade_e2e.py."""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.obs.slo import (DEFAULT_SLO_SPECS, SLOEngine,
+                                           SLOSpec)
+from k8s_operator_libs_tpu.obs.tsdb import TimeSeriesStore
+from k8s_operator_libs_tpu.serving import (Autoscaler, AutoscalerConfig,
+                                           Replica, ReplicaPool,
+                                           RequestRouter,
+                                           SimReplicaRuntime,
+                                           parse_gauges, sim_tokens)
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+from k8s_operator_libs_tpu.wire import (DRAIN_INTENT_ANNOTATION,
+                                        REPLICA_ID_LABEL)
+
+
+def _pool(clock=None, client=None, **kw):
+    return ReplicaPool(client=client, component="libtpu",
+                       clock=clock or FakeClock(), **kw)
+
+
+def _replica(rid, node, **kw):
+    return Replica(rid, node, SimReplicaRuntime(max_slots=2), **kw)
+
+
+def _run_until_done(router, pool, max_steps=100):
+    for _ in range(max_steps):
+        router.tick()
+        for r in pool.replicas.values():
+            if not r.failed:
+                r.runtime.step()
+        if router.outstanding == 0:
+            router.tick()   # collect the last completions
+            return
+    raise AssertionError(f"requests never drained "
+                         f"({router.outstanding} outstanding)")
+
+
+# ---------------------------------------------------------------- scrape
+
+
+def test_parse_gauges_reads_exposition_text():
+    text = ("# HELP tpu_workload_serve_queue_depth queued\n"
+            "# TYPE tpu_workload_serve_queue_depth gauge\n"
+            "tpu_workload_serve_queue_depth 7\n"
+            'tpu_workload_serve_slots_busy{replica="a"} 2\n'
+            "tpu_workload_serve_ttft_seconds_bucket{le=\"0.1\"} 3\n"
+            "garbage line without value\n")
+    gauges = parse_gauges(text)
+    assert gauges["tpu_workload_serve_queue_depth"] == 7.0
+    assert gauges["tpu_workload_serve_slots_busy"] == 2.0
+    assert gauges["tpu_workload_serve_ttft_seconds_bucket"] == 3.0
+
+
+def test_scrape_parses_replica_metrics_and_flags_stale():
+    pool = _pool()
+    a = pool.register(_replica("a", "node-a"))
+    a.runtime.submit([1, 2], 16)   # 16 tokens = 4 sim steps in flight
+    a.runtime.submit([3], 16)
+    a.runtime.submit([4], 16)      # 2 slots -> 1 queued after a step
+    a.runtime.step()
+    pool.scrape()
+    assert not a.stats.stale
+    assert a.stats.slots_total == 2 and a.stats.slots_busy == 2
+    assert a.stats.queue_depth == 1
+
+    # a failing scrape keeps the last good numbers but marks them stale
+    pool.scrape_gate = lambda r: (_ for _ in ()).throw(
+        RuntimeError("endpoint down"))
+    pool.scrape()
+    assert a.stats.stale and a.stats.scrape_errors == 1
+    assert a.stats.queue_depth == 1    # last good value retained
+
+
+# ------------------------------------------------------------- placement
+
+
+def test_least_outstanding_work_spreads_and_respects_weight():
+    pool = _pool()
+    pool.register(_replica("a", "node-a", weight=1.0))
+    pool.register(_replica("b", "node-b", weight=3.0))
+    router = RequestRouter(pool, clock=FakeClock())
+    for i in range(8):
+        router.submit([i, i + 1], 2)
+    by_replica = {}
+    for req in router.requests.values():
+        by_replica[req.replica_id] = by_replica.get(req.replica_id, 0) + 1
+    # weight 3 soaks up ~3x the work of weight 1
+    assert by_replica["b"] > by_replica["a"] >= 1
+    _run_until_done(router, pool)
+    assert all(router.result(rid) == sim_tokens(
+        router.requests[rid].prompt, router.requests[rid].max_new)
+        for rid in router.requests)
+
+
+def test_session_affinity_sticks_while_replica_admits():
+    pool = _pool()
+    pool.register(_replica("a", "node-a"))
+    pool.register(_replica("b", "node-b"))
+    router = RequestRouter(pool, clock=FakeClock())
+    first = router.submit([1, 2, 3], 2, session="alice")
+    home = router.requests[first].replica_id
+    # pile work on the OTHER replica's side via plain requests, then the
+    # session must still come home
+    for i in range(5):
+        router.submit([10 + i], 2)
+    again = router.submit([9, 9], 2, session="alice")
+    assert router.requests[again].replica_id == home
+
+
+def test_shared_prefix_affinity_prefers_warm_replica():
+    pool = _pool()
+    pool.register(_replica("a", "node-a"))
+    pool.register(_replica("b", "node-b"))
+    router = RequestRouter(pool, clock=FakeClock())
+    prefix = list(range(100, 116))      # >= PREFIX_KEY_TOKENS head
+    first = router.submit(prefix + [1], 2)
+    warm = router.requests[first].replica_id
+    # load the warm replica MORE than its peer; prefix still wins
+    for i in range(3):
+        router.submit([i], 2)
+    again = router.submit(prefix + [2], 2)
+    assert router.requests[again].replica_id == warm
+
+
+def test_backpressure_skips_deep_queues():
+    pool = _pool()
+    a = pool.register(_replica("a", "node-a"))
+    pool.register(_replica("b", "node-b"))
+    router = RequestRouter(pool, clock=FakeClock(), queue_high=2.0)
+    # manufacture a deep scraped queue on a
+    for i in range(6):
+        a.runtime.submit([i], 2)
+    pool.scrape()
+    assert a.stats.queue_depth >= 2.0
+    rid = router.submit([1, 2], 2)
+    assert router.requests[rid].replica_id == "b"
+
+
+# ----------------------------------------------------- drain and failure
+
+
+def test_drain_handoff_exactly_once_and_intent_stamped(cluster, clock):
+    cluster.add_node("node-a")
+    cluster.add_node("node-b")
+    pool = _pool(clock=clock, client=cluster.client)
+    a = pool.register(_replica("a", "node-a"))
+    router = RequestRouter(pool, clock=clock)
+    rids = [router.submit([i, i + 1, i + 2], 3) for i in range(5)]
+    a.runtime.step()          # 2 in flight, 3 queued on the replica
+    pool.register(_replica("b", "node-b"))
+    router.drain_replica(a, "test-drain")
+    assert a.draining and not a.drained
+    # the intent annotation is durable on the node
+    node = cluster.client.direct().get_node("node-a")
+    assert node.metadata.annotations[
+        DRAIN_INTENT_ANNOTATION].startswith("test-drain@")
+    # registration mirrored too
+    assert node.metadata.labels[REPLICA_ID_LABEL] == "a"
+    _run_until_done(router, pool)
+    served_by = {rid: router.requests[rid].replica_id for rid in rids}
+    assert set(served_by.values()) == {"a", "b"}
+    assert sum(1 for v in served_by.values() if v == "b") == 3
+    assert all(count == 1 for count in router.completed_counts.values())
+    assert router.check_invariants() == []
+    assert a.drained
+    for rid in rids:
+        req = router.requests[rid]
+        assert router.result(rid) == sim_tokens(req.prompt, req.max_new)
+
+
+def test_replica_failure_reroutes_in_flight_requests():
+    pool = _pool()
+    a = pool.register(_replica("a", "node-a"))
+    router = RequestRouter(pool, clock=FakeClock())
+    rids = [router.submit([i], 4) for i in range(3)]
+    a.runtime.step()
+    a.runtime.fail()          # process dies: in-flight work lost
+    pool.register(_replica("b", "node-b"))
+    _run_until_done(router, pool)
+    assert all(router.requests[rid].replica_id == "b" for rid in rids)
+    assert all(router.requests[rid].handoffs >= 1 for rid in rids)
+    assert all(count == 1 for count in router.completed_counts.values())
+    assert router.check_invariants() == []
+
+
+def test_admission_never_targets_cordoned_or_pipeline_node(cluster, clock):
+    cluster.add_node("node-a")
+    cluster.add_node("node-b")
+    pool = _pool(clock=clock, client=cluster.client)
+    pool.register(_replica("a", "node-a"))
+    pool.register(_replica("b", "node-b"))
+    router = RequestRouter(pool, clock=clock)
+    # the operator admits node-a into the pipeline: cordon IMMINENT but
+    # not yet applied — the router must already refuse admission there
+    cluster.client.direct().patch_node_metadata(
+        "node-a", labels={pool.keys.state_label:
+                          UpgradeState.CORDON_REQUIRED})
+    router.tick()
+    a = pool.replicas["a"]
+    assert a.draining and a.drain_reason == "upgrade:cordon-required"
+    for i in range(4):
+        router.submit([i, i], 2)
+    nodes = {n.metadata.name: n
+             for n in cluster.client.direct().list_nodes()}
+    assert router.check_invariants(nodes) == []
+    assert all(req.replica_id == "b"
+               for req in router.requests.values())
+    # now actually cordon: placement stays away and invariants hold
+    cluster.client.direct().patch_node_unschedulable("node-a", True)
+    router.tick()
+    router.submit([7, 7], 2)
+    nodes = {n.metadata.name: n
+             for n in cluster.client.direct().list_nodes()}
+    assert router.check_invariants(nodes) == []
+
+
+# ------------------------------------------------------------ autoscaler
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj, event_type, reason, message):
+        self.events.append((obj.kind, event_type, reason, message))
+
+
+def test_autoscaler_scale_up_fires_from_synthetic_ttft_burn():
+    """The acceptance scenario: a synthetic serving-ttft-p99 fast-window
+    burn (>14.4x over 1h AND 5m) must fire a scale-up on a clock-injected
+    stack — replica factory invoked, Event journaled, gauges moved."""
+    clock = FakeClock(1_000_000.0)
+    tsdb = TimeSeriesStore(clock=clock)
+    hub = MetricsHub()
+    spec = SLOSpec.from_dict(next(
+        dict(s) for s in DEFAULT_SLO_SPECS
+        if s["name"] == "serving-ttft-p99"))
+    engine = SLOEngine(tsdb, [spec], clock=clock)
+    # an hour of healthy TTFTs, then ten minutes of >threshold misery
+    for _ in range(60):
+        for _ in range(2):
+            hub.observe("serve_ttft_seconds", 0.2)
+        tsdb.scrape(hub, prefix="tpu_workload")
+        clock.advance(60.0)
+    for _ in range(10):
+        for _ in range(5):
+            hub.observe("serve_ttft_seconds", 6.0)   # > 2.5 s threshold
+        tsdb.scrape(hub, prefix="tpu_workload")
+        clock.advance(60.0)
+    status = engine.evaluate()["serving-ttft-p99"]
+    fast = status["burn"][0]
+    assert fast["triggered"] and fast["long_rate"] > 14.4 \
+        and fast["short_rate"] > 14.4
+
+    pool = _pool(clock=clock)
+    pool.register(_replica("a", "node-a"))
+    router = RequestRouter(pool, clock=clock)
+    recorder = _Recorder()
+    created = []
+
+    def factory(placement):
+        created.append(placement)
+        return _replica(f"auto-{len(created)}", f"node-auto-{len(created)}")
+
+    hub2 = MetricsHub()
+    scaler = Autoscaler(pool, router, slo_engine=engine,
+                        replica_factory=factory, recorder=recorder,
+                        metrics=hub2, clock=clock,
+                        config=AutoscalerConfig(max_replicas=4))
+    decision = scaler.tick()
+    assert decision is not None and decision["action"] == "scale-up"
+    assert "serving-ttft-p99" in decision["reason"]
+    assert len(pool.replicas) == 2 and "auto-1" in pool.replicas
+    assert [(e[0], e[2]) for e in recorder.events] == \
+        [("ServingRouter", "RouterScaleUp")]
+    # cooldown: an immediately-following tick must NOT scale again
+    assert scaler.tick() is None
+    assert len(pool.replicas) == 2
+    text = hub2.render(prefix="tpu_router")
+    assert "tpu_router_scale_ups 1" in text
+
+
+def test_autoscaler_queue_depth_scale_up_and_idle_scale_down():
+    clock = FakeClock(5_000.0)
+    pool = _pool(clock=clock)
+    a = pool.register(_replica("a", "node-a"))
+    router = RequestRouter(pool, clock=clock)
+    recorder = _Recorder()
+    scaler = Autoscaler(
+        pool, router,
+        replica_factory=lambda placement: _replica("b", "node-b"),
+        recorder=recorder, clock=clock,
+        config=AutoscalerConfig(min_replicas=1, max_replicas=2,
+                                queue_high=2.0, idle_occupancy=0.1,
+                                idle_seconds=120.0,
+                                cooldown_seconds=60.0))
+    # deep queue on the only replica -> scale up
+    for i in range(8):
+        a.runtime.submit([i], 2)
+    pool.scrape()
+    decision = scaler.tick()
+    assert decision["action"] == "scale-up"
+    assert "queue depth" in decision["reason"]
+    assert len(pool.replicas) == 2
+
+    # drain the queue, then sustained idle -> exactly one scale-down
+    for _ in range(20):
+        a.runtime.step()
+    a.runtime.poll()
+    pool.scrape()
+    clock.advance(61.0)       # past cooldown
+    assert scaler.tick() is None           # idle timer starts
+    clock.advance(119.0)
+    assert scaler.tick() is None           # not sustained long enough
+    clock.advance(2.0)
+    decision = scaler.tick()
+    assert decision["action"] == "scale-down"
+    victim_id = decision["replica"]
+    assert pool.replicas[victim_id].draining
+    # hysteresis: no second scale-down inside the cooldown (and never
+    # below min_replicas once the victim is released)
+    assert scaler.tick() is None
+    router.tick()             # drain completes (sim runtime is idle)
+    scaler.tick()             # release pass deregisters the victim
+    assert victim_id not in pool.replicas
+    assert len(pool.replicas) == 1
+    clock.advance(600.0)
+    assert scaler.tick() is None   # min_replicas floor holds
+    reasons = [e[2] for e in recorder.events]
+    assert reasons == ["RouterScaleUp", "RouterScaleDown"]
+
+
+def test_autoscaler_without_factory_journals_decision_only():
+    clock = FakeClock()
+    pool = _pool(clock=clock)
+    a = pool.register(_replica("a", "node-a"))
+    router = RequestRouter(pool, clock=clock)
+    scaler = Autoscaler(pool, router, clock=clock,
+                        config=AutoscalerConfig(queue_high=1.0))
+    for i in range(4):
+        a.runtime.submit([i], 2)
+    pool.scrape()
+    decision = scaler.tick()
+    assert decision["action"] == "scale-up" and decision["replica"] is None
+    assert len(pool.replicas) == 1     # dry-run: nothing spawned
+
+
+# --------------------------------------------------------- cmd/router.py
+
+
+def _load_cmd(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "cmd",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"tpu_{name}_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeServeHandler(BaseHTTPRequestHandler):
+    """A minimal cmd/serve.py stand-in: /generate echoes the sim decode,
+    /metrics exposes the serve_* gauges, /drain flips to 503s."""
+
+    draining = False
+    served = None            # list shared per server
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            text = (f"tpu_workload_serve_queue_depth 0\n"
+                    f"tpu_workload_serve_slots_busy 0\n"
+                    f"tpu_workload_serve_slots_total 2\n"
+                    f"tpu_workload_serve_draining "
+                    f"{1 if type(self).draining else 0}\n"
+                    f"tpu_workload_serve_failed 0\n")
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {"error": "nope"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n)) if n else {}
+        if self.path == "/drain":
+            type(self).draining = True
+            self._json(200, {"handoff": []})
+            return
+        if type(self).draining:
+            self._json(503, {"error": "draining; submit to a peer"})
+            return
+        toks = sim_tokens(req["tokens"], req["max_new"])
+        type(self).served.append(req["tokens"])
+        self._json(200, {"tokens": toks})
+
+
+def _fake_replica_server():
+    handler = type("H", (_FakeServeHandler,), {"draining": False,
+                                               "served": []})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, handler, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+@pytest.fixture
+def router_front():
+    mod = _load_cmd("router")
+    servers = [_fake_replica_server() for _ in range(2)]
+    pool = ReplicaPool(component="libtpu")
+    for i, (_, _, url) in enumerate(servers):
+        rid, node = f"r{i}", f"node-{i}"
+        pool.register(Replica(rid, node, mod.HTTPRuntime(url), url=url))
+    hub = MetricsHub()
+    front = mod.RouterFront(pool, metrics=hub, queue_high=8.0,
+                            proxy_timeout=10.0)
+    yield mod, pool, front, hub, servers
+    for httpd, _, _ in servers:
+        httpd.shutdown()
+
+
+def test_router_front_proxies_and_reroutes_on_drain(router_front):
+    mod, pool, front, hub, servers = router_front
+    front.tick()
+    code, body = front.generate([1, 2, 3], 4)
+    assert code == 200 and body["tokens"] == sim_tokens([1, 2, 3], 4)
+    # drain replica 0 at the source: the front's proxy must reroute the
+    # next request that lands there to the peer (503 = not served here)
+    servers[0][1].draining = True
+    for i in range(4):
+        code, body = front.generate([5 + i], 3)
+        assert code == 200
+        assert body["tokens"] == sim_tokens([5 + i], 3)
+    assert servers[0][1].served == [[1, 2, 3]] or \
+        len(servers[1][1].served) >= 1
+    # after a scrape tick the drained replica leaves the admitting set
+    front.tick()
+    assert [r.id for r in pool.admitting()] == ["r1"]
+    text = hub.render(prefix="tpu_router")
+    assert "tpu_router_replicas 2" in text
+    assert "tpu_router_replicas_admitting 1" in text
+
+
+def test_router_cli_endpoints_and_status_replicas(router_front, capsys):
+    mod, pool, front, hub, servers = router_front
+    front.tick()
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), mod.make_handler(front, pool, hub))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/replicas", timeout=10) as r:
+            env = json.loads(r.read())
+        assert env["kind"] == "replicas"
+        assert env["data"]["summary"]["total"] == 2
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        # register a third replica over HTTP
+        req = urllib.request.Request(
+            base + "/register",
+            data=json.dumps({"id": "r2", "node": "node-2",
+                             "url": servers[1][2]}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert "r2" in pool.replicas
+        # /generate proxies end to end through the front
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": [4, 4], "max_new": 3}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["tokens"] == sim_tokens([4, 4], 3)
+        # status --replicas renders the same registry
+        status = _load_cmd("status")
+        rc = status.main(["--replicas", "--router-url", base])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "r0" in out and "r1" in out and "r2" in out
+        assert "admitting" in out
+        rc = status.main(["--replicas", "--router-url", base, "--json"])
+        assert rc == 0
+        env = json.loads(capsys.readouterr().out)
+        assert env["kind"] == "replicas"
+        assert len(env["data"]["replicas"]) == 3
+    finally:
+        httpd.shutdown()
+
+
+def test_status_replicas_unreachable_exits_2(capsys):
+    status = _load_cmd("status")
+    rc = status.main(["--replicas", "--router-url",
+                      "http://127.0.0.1:1"])
+    assert rc == 2
+    assert "cannot read" in capsys.readouterr().err

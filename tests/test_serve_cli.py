@@ -235,3 +235,39 @@ def test_drain_clean_exit_no_deadline_warning(server, caplog):
     assert httpd.shut_down
     assert "drain deadline" not in " ".join(
         r.getMessage() for r in caplog.records)
+
+
+def test_drain_deadline_is_clock_injected(server, caplog):
+    """drain_then_shutdown takes an injected clock: a dead-client drain
+    with a MULTI-MINUTE grace period resolves in microseconds of wall
+    time on a FakeClock, with the deadline measured in MODELLED seconds
+    — what lets the router chaos scenarios drive replica shutdown
+    deterministically."""
+    import logging
+    import time as _time
+    from k8s_operator_libs_tpu.utils.clock import FakeClock
+    mod, rt, base = server
+
+    sub = rt.submit([1, 2, 3], 2)
+    assert sub is not None
+    rid, _ev = sub               # dead client: never pops its result
+    for _ in range(600):
+        if rt.idle():
+            break
+        _time.sleep(0.05)
+    assert rt.idle() and not rt.delivered()
+
+    clock = FakeClock(7_000.0)
+    httpd = _FakeHTTPD()
+    t0 = _time.monotonic()
+    with caplog.at_level(logging.WARNING, logger="tpu-serve"):
+        mod.drain_then_shutdown(rt, httpd, grace=300.0, poll=0.5,
+                                settle=1.0, clock=clock)
+    wall = _time.monotonic() - t0
+    assert wall < 5.0, "FakeClock drain burned real time"
+    assert httpd.shut_down
+    # the deadline was hit in MODELLED time: the fake clock advanced by
+    # (grace - settle) worth of poll sleeps, give or take one poll
+    assert clock.now() - 7_000.0 >= 300.0 - 1.0 - 0.5
+    warned = " ".join(r.getMessage() for r in caplog.records)
+    assert "drain deadline" in warned and str(rid) in warned

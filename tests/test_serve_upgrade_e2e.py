@@ -29,6 +29,8 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
 from k8s_operator_libs_tpu.models.generate import generate
 from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
 from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
+from k8s_operator_libs_tpu.serving import (BatcherRuntime, Replica,
+                                           ReplicaPool, RequestRouter)
 from k8s_operator_libs_tpu.tpu.operator import ManagedComponent, TPUOperator
 from k8s_operator_libs_tpu.tpu.topology import (
     GKE_ACCELERATOR_LABEL,
@@ -168,3 +170,179 @@ def test_zero_loss_upgrade_with_live_serving(cluster, clock, fleet):
     pods = cluster.client.direct().list_pods(namespace=NS)
     assert [p.metadata.labels["controller-revision-hash"]
             for p in pods] == ["v2"]
+
+
+# ----------------------------------------------------- N=3 router fleet
+
+
+N_HOSTS = [f"fleet-{c}-host" for c in "abc"]
+
+
+@pytest.fixture
+def router_fleet(cluster):
+    """Three serving nodes, ALL running the managed driver (the rolling
+    upgrade must walk the entire fleet), each with a serve pod the
+    wait-for-jobs gate watches."""
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels={"app": "libtpu"}, revision_hash="v1")
+    for i, host in enumerate(N_HOSTS):
+        cluster.add_node(host, labels=_slice_labels(f"fleet-pool-{i}"))
+        cluster.add_pod(f"libtpu-{host}", host, namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+        cluster.add_pod(f"serve-{i}", host, labels={"job": "serve"})
+    return ds
+
+
+def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
+    """The tentpole scenario (docs/router.md): a rolling libtpu upgrade
+    walks ALL THREE serving nodes while the router keeps serving.
+
+    Holds at every iteration: admission never lands on a node that is
+    cordoned/quarantined (router invariant vs cluster truth), and the
+    admitting fleet never drops below N - maxUnavailable = 2.
+    Holds at the end: every request completed EXACTLY once, tokens
+    identical to a solo decode no matter which replica (or replica
+    generation) served it, every replica drained BEFORE its node's
+    cordon landed, and the fleet is back to 3 admitting replicas at v2.
+    """
+    from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+    keys = KeyFactory("libtpu")
+    operator = TPUOperator(
+        cluster.client,
+        components=[ManagedComponent(
+            name="libtpu", namespace=NS, driver_labels={"app": "libtpu"},
+            policy=DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=1,
+                wait_for_completion=WaitForCompletionSpec(
+                    pod_selector="job=serve"),
+                drain=DrainSpec(enable=True, force=True,
+                                timeout_second=60)))],
+        recorder=cluster.recorder, clock=clock, synchronous=True)
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    pool = ReplicaPool(client=cluster.client, component="libtpu",
+                       clock=clock)
+    router = RequestRouter(pool, clock=clock)
+    gen = {host: 1 for host in N_HOSTS}
+
+    def spawn(host):
+        replica = Replica(f"{host}-g{gen[host]}", host,
+                          BatcherRuntime(params, CFG, max_slots=2,
+                                         capacity_per_slot=64,
+                                         block_size=8, clock=clock))
+        gen[host] += 1
+        return pool.register(replica)
+
+    for host in N_HOSTS:
+        spawn(host)
+
+    rng = np.random.default_rng(21)
+    expected = {}          # rid -> (prompt, max_new)
+
+    def submit(n, session=None):
+        for _ in range(n):
+            prompt = rng.integers(0, CFG.vocab_size,
+                                  size=int(rng.integers(4, 12))
+                                  ).astype(np.int32)
+            max_new = int(rng.integers(2, 7))
+            rid = router.submit(prompt, max_new, session=session)
+            expected[rid] = (prompt, max_new)
+
+    submit(9)
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+
+    exited = set()         # replica ids whose serve pod completed
+    min_admitting = len(N_HOSTS)
+    done = False
+    for it in range(600):
+        operator.reconcile()
+        cluster.reconcile_daemonsets()
+        router.tick()
+
+        # the standing router invariants, against cluster truth, every
+        # single iteration
+        nodes = {n.metadata.name: n
+                 for n in cluster.client.direct().list_nodes()}
+        assert router.check_invariants(nodes) == []
+        min_admitting = min(min_admitting, len(pool.admitting()))
+
+        # keep traffic flowing mid-upgrade
+        if it in (5, 25):
+            submit(3, session=f"s{it}")
+
+        for replica in list(pool.replicas.values()):
+            if not replica.failed:
+                replica.runtime.step()
+            # a fully drained replica's server process exits; the
+            # wait-for-jobs gate sees its pod complete
+            if replica.draining and replica.drained \
+                    and replica.id not in exited:
+                i = N_HOSTS.index(replica.node_name)
+                try:
+                    cluster.set_pod_status("default", f"serve-{i}",
+                                           phase="Succeeded")
+                except KeyError:
+                    pass   # already drained/deleted by the node drain
+                exited.add(replica.id)
+            # the node came back (upgrade-done, uncordoned): a fresh
+            # replica generation takes over the slice
+            if replica.id in exited:
+                node = nodes[replica.node_name]
+                if (node.metadata.labels.get(keys.state_label)
+                        == UpgradeState.DONE
+                        and not node.spec.unschedulable):
+                    pool.deregister(replica.id)
+                    i = N_HOSTS.index(replica.node_name)
+                    try:
+                        cluster.set_pod_status("default", f"serve-{i}",
+                                               phase="Running")
+                    except KeyError:
+                        cluster.add_pod(f"serve-{i}", replica.node_name,
+                                        labels={"job": "serve"})
+                    spawn(replica.node_name)
+
+        all_done = all(
+            n.metadata.labels.get(keys.state_label) == UpgradeState.DONE
+            and not n.spec.unschedulable for n in nodes.values())
+        if all_done and router.outstanding == 0:
+            router.tick()   # collect the final completions
+            done = True
+            break
+
+    assert done, "rolling upgrade + serving never converged"
+
+    # ZERO LOST, ZERO DUPLICATED: every request delivered exactly once
+    assert sorted(router.completed_counts) == sorted(expected)
+    assert all(c == 1 for c in router.completed_counts.values())
+    assert router.check_invariants() == []
+
+    # fleet capacity never dropped below N - maxUnavailable
+    assert min_admitting >= len(N_HOSTS) - 1
+
+    # every node was walked, and each drain began BEFORE its cordon:
+    # reason is the pre-cordon pipeline signal, node still schedulable
+    drained_nodes = {node for (_rid, node, reason, sched) in router.drains}
+    assert drained_nodes == set(N_HOSTS)
+    for replica_id, node, reason, schedulable_at_drain in router.drains:
+        assert reason == "upgrade:cordon-required", \
+            f"{replica_id} drained on {reason}, not the pre-cordon signal"
+        assert schedulable_at_drain, \
+            f"{replica_id} drain started only after {node} was cordoned"
+
+    # requests were actually served by multiple replicas/generations
+    served_by = {rid: router.requests[rid].replica_id for rid in expected}
+    assert len(set(served_by.values())) >= 2
+
+    # no replica changed any request's output: all equal solo decodes
+    for rid, (prompt, max_new) in expected.items():
+        np.testing.assert_array_equal(
+            router.result(rid), _solo(params, prompt, max_new),
+            err_msg=f"request {rid} (served by {served_by[rid]}) "
+                    f"diverged across the rolling upgrade")
+
+    # the upgrade itself completed fleet-wide: every driver pod at v2,
+    # and the serving fleet is back to N admitting replicas
+    pods = cluster.client.direct().list_pods(namespace=NS)
+    assert sorted(p.metadata.labels["controller-revision-hash"]
+                  for p in pods) == ["v2"] * len(N_HOSTS)
+    assert len(pool.admitting()) == len(N_HOSTS)

@@ -60,10 +60,11 @@ LAYERS: Dict[str, Set[str]] = {
     "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs",
             "wire"},
     # chaos sits at the TOP of the operator spine: it drives the whole
-    # stack (operator, electors, health, SLO) under injected faults and
-    # asserts cross-layer invariants — nothing below may import it back
+    # stack (operator, electors, health, SLO, the serving router tier)
+    # under injected faults and asserts cross-layer invariants — nothing
+    # below may import it back
     "chaos": {"core", "utils", "api", "upgrade", "health", "tpu", "obs",
-              "wire"},
+              "wire", "serving"},
     "data": {"utils"},
     "ops": {"utils"},
     # obs sits below BOTH spines: the workload side (goodput ledger,
@@ -72,6 +73,12 @@ LAYERS: Dict[str, Set[str]] = {
     "models": {"ops", "utils", "data", "obs"},
     "parallel": {"models", "ops", "utils"},
     "train": {"models", "parallel", "ops", "utils", "data", "obs"},
+    # serving is the router tier spanning BOTH spines: it consumes the
+    # batcher (models), the SLO engine (obs), slice placement (tpu) and
+    # node state (upgrade/core) — only chaos sits above it, and neither
+    # spine may import it back
+    "serving": {"core", "utils", "api", "obs", "models", "tpu",
+                "upgrade", "wire"},
 }
 
 Finding = Tuple[str, int, str, str]

@@ -311,18 +311,25 @@ register(Check(name="obs-attribution", codes=ATTRIBUTION_CODES,
 # ------------------------------------------------ OBS003 (SLO/alerting)
 
 SLO_CODES = {
-    "OBS003": "SLO/alerting metric drift: an SLO spec references an "
-              "unregistered metric family, an emitted slo/alert gauge "
-              "family has no HELP_TEXTS entry, or a tpu_operator_slo_*/"
-              "tpu_operator_alert_* HELP entry matches no emitted family",
+    "OBS003": "SLO/alerting/router metric drift: an SLO spec references "
+              "an unregistered metric family, an emitted slo/alert/"
+              "router family has no HELP_TEXTS entry, or a "
+              "tpu_operator_slo_*/tpu_operator_alert_*/tpu_router_* "
+              "HELP entry matches no emitted family",
 }
 
 SLO_PATH = "k8s_operator_libs_tpu/obs/slo.py"
 ALERTS_PATH = "k8s_operator_libs_tpu/obs/alerts.py"
 METRICS_PATH = "k8s_operator_libs_tpu/obs/metrics.py"
+# the router tier's emitted-family tables (ROUTER_GAUGE_FAMILIES /
+# ROUTER_HISTOGRAM_FAMILIES); absent when a checkout has no serving
+# package — the router closure is then skipped entirely, like CHS001
+# with no chaos package
+ROUTER_METRICS_PATH = "k8s_operator_libs_tpu/serving/metrics.py"
 # HELP entries under these prefixes must correspond to families the
 # engine/alert manager actually emits (no stale catalog entries)
 SLO_FAMILY_PREFIXES = ("tpu_operator_slo_", "tpu_operator_alert_")
+ROUTER_FAMILY_PREFIX = "tpu_router_"
 
 
 def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
@@ -459,6 +466,37 @@ def run_slo(root) -> List[Finding]:
                  f"HELP_TEXTS entry {key!r} matches no emitted family in "
                  f"SLO_GAUGE_FAMILIES ({SLO_PATH}) or ALERT_GAUGE_FAMILIES "
                  f"({ALERTS_PATH}) (renamed or removed gauge?)"))
+
+    # router tier: the serving/metrics.py emitted-family tables close
+    # over HELP_TEXTS exactly like the slo/alert tables (skipped when
+    # the checkout carries no serving package)
+    if index.exists(ROUTER_METRICS_PATH):
+        router_tree = index.tree(ROUTER_METRICS_PATH)
+        router_emitted: Dict[str, int] = {}
+        for table in ("ROUTER_GAUGE_FAMILIES",
+                      "ROUTER_HISTOGRAM_FAMILIES"):
+            fams, fams_line = _string_tuple(router_tree, table)
+            if fams_line == 0:
+                findings.append(
+                    (ROUTER_METRICS_PATH, 1, "OBS003",
+                     f"{table} table not found (parse drift?)"))
+                continue
+            router_emitted.update(fams)
+        for family, lineno in sorted(router_emitted.items()):
+            if family not in help_keys:
+                findings.append(
+                    (ROUTER_METRICS_PATH, lineno, "OBS003",
+                     f"emitted router family {family!r} has no "
+                     f"HELP_TEXTS entry ({METRICS_PATH})"))
+        for key, lineno in sorted(help_keys.items()):
+            if (key.startswith(ROUTER_FAMILY_PREFIX)
+                    and key not in router_emitted):
+                findings.append(
+                    (METRICS_PATH, lineno, "OBS003",
+                     f"HELP_TEXTS entry {key!r} matches no emitted "
+                     f"family in ROUTER_GAUGE_FAMILIES or "
+                     f"ROUTER_HISTOGRAM_FAMILIES ({ROUTER_METRICS_PATH})"
+                     f" (renamed or removed router metric?)"))
     return findings
 
 
