@@ -12,9 +12,9 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-chaos test-router health-sim chaos lint lint-domain \
-  lint-smoke cov-report cov-artifact bench bench-decode dryrun \
-  apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+  test-obs-slo test-chaos test-router test-race health-sim chaos race \
+  race-smoke lint lint-domain lint-smoke cov-report cov-artifact bench \
+  bench-decode dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -55,6 +55,18 @@ SEEDS ?= 20
 chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md)
 	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS)
 
+RACE_SEEDS ?= 40
+race:  ## deterministic schedule exploration of the six real-component harnesses (drain/evict workers, leader renew-vs-demote, informer-vs-reader, uploader, router ticker-vs-proxy) with lockset race detection; failures report seed + shrunk replayable trace (docs/static-analysis.md "Schedule exploration")
+	$(PYTHON) -m tools.race --seeds $(RACE_SEEDS)
+
+RACE_BUDGET ?= 120
+race-smoke:  ## fixed seeds under a wall-clock budget (the CI gate, like lint-smoke): planted-bug self-test first — the detector must still detect — then the six harnesses on a few seeds
+	$(PYTHON) -m tools.race --self-test
+	$(PYTHON) -m tools.race --smoke --budget $(RACE_BUDGET)
+
+test-race:  ## concurrency sanitizer unit/regression suite: shim, scheduler determinism, deadlock/livelock reports, planted-race detect+shrink+replay, harness smokes, CLI shutdown hygiene
+	$(PYTHON) -m pytest tests/test_race.py -q
+
 lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — see docs/static-analysis.md) + import sanity
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu cmd tools bench.py __graft_entry__.py
 	$(PYTHON) -m tools.lint --generic
@@ -70,7 +82,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 # ProjectIndex parse per file (tools/lint/index.py).
 LINT_FLAGS ?=
 
-lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, ARC001 import layering (docs/static-analysis.md)
+lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, THR001/GRD001 thread discipline, ARC001 import layering (docs/static-analysis.md)
 	$(PYTHON) -m tools.lint --domain $(LINT_FLAGS)
 
 LINT_BUDGET ?= 60

@@ -36,13 +36,14 @@ import json
 import logging
 import signal
 import sys
-import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu import __version__  # noqa: E402
+from k8s_operator_libs_tpu.utils import threads  # noqa: E402
+from k8s_operator_libs_tpu.utils.clock import RealClock  # noqa: E402
 from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: E402
 from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
@@ -172,8 +173,8 @@ class MetricsServer:
                 self.wfile.write(body)
 
         self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-        threading.Thread(target=self._server.serve_forever,
-                         daemon=True).start()
+        self._thread = threads.spawn("operator-metrics-server",
+                                     self._server.serve_forever)
 
     @property
     def port(self) -> int:
@@ -182,6 +183,7 @@ class MetricsServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=5.0)
 
 
 def render_metrics(operator: TPUOperator, states, hub: MetricsHub) -> str:
@@ -227,9 +229,10 @@ def alerts_payload(operator: TPUOperator) -> str:
                        "data": operator.alert_manager.status()})
 
 
-def main(argv=None, stop=None, on_ready=None) -> int:
-    """``stop`` (threading.Event) and ``on_ready(metrics_server)`` are
-    injection points for embedding/tests; production runs use signals."""
+def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
+    """``stop`` (an Event), ``on_ready(metrics_server)`` and ``clock``
+    (bounds the shutdown joins) are injection points for embedding and
+    tests; production runs use signals and real time."""
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", required=True,
                    help="operator config YAML (components + policies)")
@@ -307,7 +310,8 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                     ", ".join(s.name for s in slo.specs))
     if args.trace_log:
         logger.info("tracing reconcile spans to %s", args.trace_log)
-    stop = stop or threading.Event()
+    stop = stop or threads.make_event("operator-stop")
+    clock = clock or RealClock()
     elector = None
     cache_started = not args.leader_elect  # see build_client
     if args.leader_elect and args.once:
@@ -349,7 +353,8 @@ def main(argv=None, stop=None, on_ready=None) -> int:
               if args.metrics_port >= 0 else None)
     if on_ready is not None:
         on_ready(server)
-    dirty = threading.Event()  # watch events request an early tick
+    dirty = threads.make_event("operator-dirty")  # watch events request an early tick
+    watch_threads = []  # joined on shutdown — daemon threads still drain
 
     def _is_driver_pod(obj) -> bool:
         labels = obj.metadata.labels or {}
@@ -395,8 +400,8 @@ def main(argv=None, stop=None, on_ready=None) -> int:
                                       namespace=comp.namespace,
                                       label_selector=comp.driver_labels)))
             for name, fn in sources:
-                threading.Thread(target=watch_loop, args=(name, fn),
-                                 daemon=True).start()
+                watch_threads.append(threads.spawn(
+                    f"operator-watch-{name}", watch_loop, args=(name, fn)))
     logger.info("managing %s every %.0fs%s",
                 [c.name for c in components], args.interval,
                 f", metrics on :{server.port}" if server else "")
@@ -460,6 +465,19 @@ def main(argv=None, stop=None, on_ready=None) -> int:
             server.stop()
         if hasattr(client, "stop"):  # CachedClient informers
             client.stop()
+        # shutdown hygiene: the uncached watch threads used to be
+        # fire-and-forget daemons — join them under one bounded deadline
+        # on the injected clock (a watch window ends within --interval,
+        # so a clean exit arrives inside interval + slack; a wedged
+        # socket is reported, never waited on forever)
+        if watch_threads:
+            deadline = clock.now() + args.interval + 5.0
+            for t in watch_threads:
+                t.join(timeout=max(0.0, deadline - clock.now()))
+            stuck = [t.name for t in watch_threads if t.is_alive()]
+            if stuck:
+                logger.warning("watch threads still running at shutdown "
+                               "deadline: %s", ", ".join(stuck))
         if isinstance(tracer.sink, JsonlSink):
             tracer.sink.close()
         for sig, handler in prev_handlers.items():

@@ -39,14 +39,28 @@ import argparse
 import json
 import logging
 import sys
-import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
+from k8s_operator_libs_tpu.utils import threads  # noqa: E402
+
 logger = logging.getLogger("tpu-router")
+
+
+def http_post_json(url, payload, timeout):
+    """POST ``payload`` as JSON, return the decoded JSON response. The
+    default transport of :class:`RouterFront` — the race harness injects
+    a socket-free stand-in with the same raise surface (HTTPError for
+    HTTP failures, OSError family for a dead peer)."""
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
 
 
 class HTTPRuntime:
@@ -104,7 +118,7 @@ class RouterFront:
     this front unchanged."""
 
     def __init__(self, pool, metrics=None, clock=None, queue_high=8.0,
-                 proxy_timeout=300.0):
+                 proxy_timeout=300.0, post_json=None):
         from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
         from k8s_operator_libs_tpu.utils.clock import RealClock
         self.pool = pool
@@ -112,8 +126,9 @@ class RouterFront:
         self._clock = clock or RealClock()
         self.queue_high = queue_high
         self.proxy_timeout = proxy_timeout
+        self._post_json = post_json or http_post_json
         self._prefix_tokens = PREFIX_KEY_TOKENS
-        self.lock = threading.Lock()
+        self.lock = threads.make_lock("router-front")
         self._session = {}
         self._prefix = {}
         self._outstanding = {}
@@ -160,15 +175,10 @@ class RouterFront:
                     self._session[session] = replica.id
                 self._prefix[prefix_key] = replica.id
             try:
-                body = json.dumps({"tokens": tokens,
-                                   "max_new": max_new}).encode()
-                req = urllib.request.Request(
-                    replica.url.rstrip("/") + "/generate", data=body,
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                with urllib.request.urlopen(
-                        req, timeout=self.proxy_timeout) as resp:
-                    out = json.loads(resp.read())
+                out = self._post_json(
+                    replica.url.rstrip("/") + "/generate",
+                    {"tokens": tokens, "max_new": max_new},
+                    self.proxy_timeout)
                 with self.lock:
                     self._routed += 1
                     self._completed += 1
@@ -389,7 +399,10 @@ def parse_replica_flag(value):
     return rid, url, node, float(weight) if weight else 1.0
 
 
-def main(argv=None):
+def main(argv=None, on_ready=None):
+    """``on_ready(httpd)`` is the embedding/test injection point (the
+    cmd/operator.py convention): tests call ``httpd.shutdown()`` on it
+    to drive the clean-stop path and then assert the ticker joined."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--port", type=int, default=8300)
     ap.add_argument("--component", default="libtpu",
@@ -434,7 +447,7 @@ def main(argv=None):
                                 max_replicas=args.max_replicas,
                                 queue_high=args.queue_high))
 
-    stop = threading.Event()
+    stop = threads.make_event("router-ticker-stop")
 
     def ticker():
         while not stop.is_set():
@@ -445,17 +458,27 @@ def main(argv=None):
                 logger.exception("router tick failed; retrying")
             stop.wait(args.tick)
 
-    t = threading.Thread(target=ticker, daemon=True)
-    t.start()
+    t = threads.spawn("router-ticker", ticker)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port),
                                 make_handler(front, pool, hub,
                                              autoscaler))
     logger.info("tpu-router on :%d (%d replicas seeded, tick %.1fs)",
                 args.port, len(pool.replicas), args.tick)
+    if on_ready is not None:
+        on_ready(httpd)
     try:
         httpd.serve_forever()
     finally:
+        # shutdown hygiene: the drain-watch ticker used to be a
+        # fire-and-forget daemon — stop it and JOIN under a bounded
+        # deadline on the front's clock (the worst case is one full
+        # tick sleep plus an in-flight scrape)
         stop.set()
+        t.join(timeout=args.tick + 5.0)
+        if t.is_alive():
+            logger.warning("router ticker still running at shutdown "
+                           "deadline")
+        httpd.server_close()
     return 0
 
 

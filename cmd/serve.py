@@ -45,10 +45,11 @@ import json
 import logging
 import signal
 import sys
-import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from k8s_operator_libs_tpu.utils import threads  # noqa: E402
 
 logger = logging.getLogger("tpu-serve")
 
@@ -92,15 +93,14 @@ class ServingRuntime:
                                      metrics=self.hub, tracer=tracer,
                                      draft=draft, spec_k=spec_k)
         self.chunk = chunk
-        self.lock = threading.Lock()
+        self.lock = threads.make_lock("serve-runtime")
         self.results = {}
         self.events = {}
         self.draining = False
         self.failed = False
         self.handoff = None
-        self._stop = threading.Event()
-        self.thread = threading.Thread(target=self._loop, daemon=True)
-        self.thread.start()
+        self._stop = threads.make_event("serve-stepper-stop")
+        self.thread = threads.spawn("serve-stepper", self._loop)
 
     def submit(self, tokens, max_new):
         import numpy as np
@@ -108,7 +108,7 @@ class ServingRuntime:
             if self.draining or self.failed:
                 return None
             rid = self.srv.submit(np.asarray(tokens, np.int32), max_new)
-            ev = threading.Event()
+            ev = threads.make_event(f"serve-result-{rid}")
             self.events[rid] = ev
         return rid, ev
 
@@ -361,8 +361,8 @@ def main(argv=None):
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_handler(rt))
 
     def on_term(signum, frame):
-        threading.Thread(target=drain_then_shutdown,
-                         args=(rt, httpd, args.grace), daemon=True).start()
+        threads.spawn("serve-drain", drain_then_shutdown,
+                      args=(rt, httpd, args.grace))
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)

@@ -43,9 +43,9 @@ from __future__ import annotations
 
 import copy
 import logging
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 from .client import Client, NotFoundError, WatchError
 from .objects import ControllerRevision, DaemonSet, Job, Node, Pod
@@ -99,11 +99,11 @@ class _Informer:
         self._rv: Optional[str] = None  # watch resume point; None → re-list
         self._resume_ok = False         # baseline RV came from the LIST
         self._supports_resume = True    # cleared on first TypeError
-        self._lock = threading.Lock()
-        self._synced = threading.Event()
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name=f"informer-{kind.lower()}")
+        name = f"informer-{kind.lower()}"
+        self._lock = threads.make_lock(f"{name}-store")
+        self._synced = threads.make_event(f"{name}-synced")
+        self._stop = threads.make_event(f"{name}-stop")
+        self._thread = threads.spawn(name, self._run, start=False)
 
     # ----------------------------------------------------------- lifecycle
 

@@ -24,9 +24,9 @@ from __future__ import annotations
 import heapq
 import queue
 import itertools
-import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 from .client import (Client, ConflictError, EventRecorder, ExpiredError,
                      InvalidError, NotFoundError,
@@ -64,7 +64,7 @@ class FakeRecorder(EventRecorder):
 
     def __init__(self):
         self.events: List[Event] = []
-        self._lock = threading.Lock()
+        self._lock = threads.make_lock("fake-recorder")
 
     def event(self, obj, event_type: str, reason: str, message: str) -> None:
         with self._lock:
@@ -90,7 +90,7 @@ class FakeCluster:
         self.clock = clock or RealClock()
         self.cache_lag = cache_lag
         self._store: Dict[Key, object] = {}
-        self._lock = threading.RLock()
+        self._lock = threads.make_rlock("fake-cluster-store")
         self._version = itertools.count(1)
         # pending cache deliveries: (due_time, seq, key, obj-or-None)
         self._pending: List[Tuple[float, int, Key, Optional[object]]] = []

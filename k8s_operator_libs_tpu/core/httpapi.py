@@ -32,11 +32,11 @@ from __future__ import annotations
 
 import json
 import re
-import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import threads
 from . import serde
 from .client import ConflictError, InvalidError
 from .fakecluster import FakeCluster
@@ -613,9 +613,10 @@ class FakeAPIServer:
         self._server.cluster = cluster          # type: ignore[attr-defined]
         self._server.token = token              # type: ignore[attr-defined]
         self._server.event_names = set()        # type: ignore[attr-defined]
-        self._server.event_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
+        self._server.event_lock = threads.make_lock(  # type: ignore[attr-defined]
+            "fake-apiserver-events")
+        self._thread = threads.spawn("fake-apiserver",
+                                     self._server.serve_forever, start=False)
 
     @property
     def base_url(self) -> str:

@@ -57,9 +57,9 @@ the one in-flight reconcile.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Optional
 
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 from .client import ConflictError, NotFoundError
 from .objects import Lease, LeaseSpec, ObjectMeta
@@ -86,14 +86,19 @@ class LeaderElector:
         self._is_leader = False
         self._last_attempt: float = -1e18
         self._last_renew_ok: float = -1e18
-        self._bg_stop = threading.Event()
-        self._bg_thread: Optional[threading.Thread] = None
+        self._bg_stop = threads.make_event(f"leader-elector-{identity}-stop")
+        self._bg_thread = None
         self._on_lost = None
 
     @property
     def is_leader(self) -> bool:
         """Last observed leadership state (updated by :meth:`tick`)."""
-        return self._is_leader
+        # thr: allow — deliberate lock-free bool read: GIL-atomic, stale
+        # by at most one retry period, and the module docstring's fencing
+        # argument covers the deposed-leader window; a lock here would be
+        # held across every reconcile gate check for nothing
+        return self._is_leader  # thr: allow — see above
+
 
     # ------------------------------------------------------------------ tick
 
@@ -148,8 +153,7 @@ class LeaderElector:
                     self._on_lost()
             return self._is_leader
 
-    def run_background(self, stop_event: threading.Event,
-                       on_lost=None) -> threading.Thread:
+    def run_background(self, stop_event, on_lost=None):
         """Renew/acquire on a daemon thread every ``retry_period`` until
         ``stop_event`` (or :meth:`release`) fires — leadership stays alive
         through reconciles longer than the lease duration. The caller gates
@@ -166,7 +170,8 @@ class LeaderElector:
             while not (stop_event.is_set() or self._bg_stop.is_set()):
                 self.tick_safely()
                 self._bg_stop.wait(self.retry_period)
-        t = threading.Thread(target=loop, name="leader-elector", daemon=True)
+        t = threads.spawn(f"leader-elector-{self.identity}", loop,
+                          start=False)
         self._bg_thread = t
         t.start()
         return t
@@ -183,7 +188,14 @@ class LeaderElector:
         if self._bg_thread is not None:
             self._bg_thread.join(timeout=max(5.0, self.retry_period * 3))
             self._bg_thread = None
-        if not self._is_leader:
+        was_leader = self._is_leader
+        # step down BEFORE the record clears (the module contract: the
+        # old holder stops acting as leader before a new holder can
+        # start) — clearing the lease first left a window where a
+        # standby acquired while is_leader here still read True; the
+        # schedule explorer's two-leader observation caught it
+        self._is_leader = False
+        if not was_leader:
             return
         try:
             lease = self._client.get_lease(self._ns, self._name)
@@ -194,7 +206,6 @@ class LeaderElector:
         except Exception as exc:
             logger.warning("could not release lease %s/%s (%s); it will "
                            "expire on its own", self._ns, self._name, exc)
-        self._is_leader = False
 
     # ------------------------------------------------------------- internals
 

@@ -17,10 +17,11 @@ import logging
 import os
 import queue
 import subprocess
-import threading
 from typing import Iterator, Optional
 
 import numpy as np
+
+from ..utils import threads
 
 logger = logging.getLogger(__name__)
 
@@ -29,7 +30,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _SRC = os.path.join(_REPO_ROOT, "csrc", "tokenloader.cpp")
 _SO = os.path.join(_REPO_ROOT, "build", "libtokenloader.so")
 
-_lib_lock = threading.Lock()
+_lib_lock = threads.make_lock("tokenloader-native-compile")
 _lib: Optional[ctypes.CDLL] = None
 _lib_failed = False
 
@@ -82,7 +83,7 @@ class _ProducerDied:
 class _PrefetchStream:
     """Handle for one live prefetch thread, so close() can stop it first."""
 
-    def __init__(self, stop: threading.Event, thread: threading.Thread):
+    def __init__(self, stop, thread):
         self.stop = stop
         self.thread = thread
 
@@ -126,7 +127,7 @@ class TokenDataset:
         self._handle = None
         self._closed = False
         self._streams: list = []  # live prefetch streams, for close()
-        self._streams_lock = threading.Lock()
+        self._streams_lock = threads.make_lock("tokenloader-streams")
         header_elem = _read_header(path)
         # headered files carry their element size; raw files default to int32
         self._open(elem_size=header_elem or 4,
@@ -238,7 +239,7 @@ class TokenDataset:
         empty queue.
         """
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
-        stop = threading.Event()
+        stop = threads.make_event("tokenloader-prefetch-stop")
 
         def _put(item) -> bool:
             """put() that stays interruptible by stop; True if delivered."""
@@ -261,8 +262,8 @@ class TokenDataset:
                 step += 1
                 _put(item)
 
-        t = threading.Thread(target=producer, daemon=True,
-                             name=f"tokenloader-prefetch-{id(q):x}")
+        t = threads.spawn(f"tokenloader-prefetch-{id(q):x}", producer,
+                          start=False)
         stream = _PrefetchStream(stop=stop, thread=t)
         with self._streams_lock:
             self._streams.append(stream)

@@ -23,8 +23,9 @@ stdlib HTTP server.
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from ..utils import threads
 
 # Latency buckets sized for control-plane work: sub-second handler passes
 # up to multi-minute drains (drain timeout default 300 s) and hour-scale
@@ -356,7 +357,7 @@ class MetricsHub:
     threads observe concurrently with the reconcile loop."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threads.make_lock("metrics-hub")
         self._hists: Dict[str, _Histogram] = {}
         # name -> {label-items tuple -> value}
         self._gauges: Dict[str, Dict[Tuple[Tuple[str, str], ...],

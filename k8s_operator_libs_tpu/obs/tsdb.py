@@ -36,9 +36,9 @@ finite bound), so no raw observations need to be retained.
 from __future__ import annotations
 
 import collections
-import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 
 DEFAULT_RAW_POINTS = 1024
@@ -151,7 +151,7 @@ class TimeSeriesStore:
         self.coarse_points = int(coarse_points)
         self.max_series = int(max_series)
         self._series: Dict[Tuple[str, LabelItems], _Series] = {}
-        self._lock = threading.Lock()
+        self._lock = threads.make_lock("tsdb")
         self.scrapes = 0
         self.dropped_series = 0  # writes refused at the series cap
 

@@ -12,13 +12,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import threading
 from typing import List, Optional
 
 from ..api.v1alpha1 import DrainSpec
 from ..core.client import Client, EventRecorder
 from ..core.drain import Helper
 from ..core.objects import Node
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 from .consts import UpgradeState
 from .node_state_provider import NodeUpgradeStateProvider
@@ -50,7 +50,7 @@ class DrainManager:
         # synchronous=True runs drains inline — used by deterministic tests
         # and by bench.py's simulated clock (threads + FakeClock would race).
         self._synchronous = synchronous
-        self._threads: List[threading.Thread] = []
+        self._threads: List[object] = []
 
     @property
     def draining_nodes(self) -> StringSet:
@@ -97,8 +97,8 @@ class DrainManager:
                 continue
             log_event(self._recorder, node, "Normal", self._keys.event_reason,
                       "Scheduling drain of the node")
-            t = threading.Thread(target=self._drain_one, args=(helper, node),
-                                 daemon=True)
+            t = threads.spawn(f"drain-{node.metadata.name}", self._drain_one,
+                              args=(helper, node), start=False)
             self._threads.append(t)
             t.start()
 

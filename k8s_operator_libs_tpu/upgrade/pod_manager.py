@@ -16,13 +16,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import threading
 from typing import Callable, List, Optional
 
 from ..api.v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
 from ..core.client import Client, EventRecorder, NotFoundError
 from ..core.drain import Helper
 from ..core.objects import DaemonSet, Node, Pod
+from ..utils import threads
 from ..utils.clock import Clock, RealClock
 from .consts import UpgradeState
 from .node_state_provider import NULL, NodeUpgradeStateProvider
@@ -80,7 +80,7 @@ class PodManager:
         self._clock = clock or RealClock()
         self._in_progress = StringSet()
         self._synchronous = synchronous
-        self._threads: List[threading.Thread] = []
+        self._threads: List[object] = []
 
     # ----------------------------------------------------- revision hashes
 
@@ -134,9 +134,10 @@ class PodManager:
             if self._synchronous:
                 self._evict_one(helper, node, config.drain_enabled)
             else:
-                t = threading.Thread(target=self._evict_one,
-                                     args=(helper, node, config.drain_enabled),
-                                     daemon=True)
+                t = threads.spawn(f"evict-{node.metadata.name}",
+                                  self._evict_one,
+                                  args=(helper, node, config.drain_enabled),
+                                  start=False)
                 self._threads.append(t)
                 t.start()
 
@@ -228,15 +229,14 @@ class PodManager:
             self._provider.change_nodes_state_and_annotations(
                 advancing, UpgradeState.POD_DELETION_REQUIRED, {key: NULL})
             return
-        threads = []
+        workers = []
         for node in config.nodes:
             pods = self._client.direct().list_pods(
                 label_selector=selector, field_node_name=node.metadata.name)
-            worker = threading.Thread(
-                target=self._check_one, args=(node, pods, spec), daemon=True)
-            threads.append(worker)
-            worker.start()
-        for t in threads:
+            worker = threads.spawn(f"podcheck-{node.metadata.name}",
+                                   self._check_one, args=(node, pods, spec))
+            workers.append(worker)
+        for t in workers:
             t.join()
 
     def _check_one(self, node: Node, pods: List[Pod],

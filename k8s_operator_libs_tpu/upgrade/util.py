@@ -11,10 +11,10 @@ manager instead.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Set
 
 from ..core.client import EventRecorder
+from ..utils import threads
 from . import consts
 
 
@@ -24,7 +24,7 @@ class StringSet:
 
     def __init__(self):
         self._set: Set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = threads.make_lock("string-set")
 
     def add(self, s: str) -> None:
         with self._lock:
@@ -58,14 +58,14 @@ class KeyedMutex:
     (reference util.go:69-85; used at node_upgrade_state_provider.go:43-78)."""
 
     def __init__(self):
-        self._locks: Dict[str, threading.Lock] = {}
-        self._guard = threading.Lock()
+        self._locks: Dict[str, object] = {}
+        self._guard = threads.make_lock("keyed-mutex-guard")
 
-    def _lock_for(self, key: str) -> threading.Lock:
+    def _lock_for(self, key: str):
         with self._guard:
             lock = self._locks.get(key)
             if lock is None:
-                lock = threading.Lock()
+                lock = threads.make_lock(f"keyed-mutex-{key}")
                 self._locks[key] = lock
             return lock
 

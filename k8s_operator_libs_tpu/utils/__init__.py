@@ -1,3 +1,4 @@
-"""Shared utilities: clock abstraction, logging helpers."""
+"""Shared utilities: clock abstraction, threading shim, logging helpers."""
 
+from . import threads  # noqa: F401
 from .clock import Clock, FakeClock, RealClock  # noqa: F401

@@ -12,8 +12,9 @@ modelled wall-clock.
 from __future__ import annotations
 
 import abc
-import threading
 import time
+
+from . import threads
 
 
 class Clock(abc.ABC):
@@ -52,7 +53,7 @@ class FakeClock(Clock):
 
     def __init__(self, start: float = 0.0):
         self._now = start
-        self._lock = threading.Lock()
+        self._lock = threads.make_lock("fake-clock")
 
     def now(self) -> float:
         with self._lock:

@@ -21,7 +21,7 @@ sys.path.insert(0, str(REPO / "tools"))
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
 from lint import (chaos_check, determinism, jax_hygiene, layering,  # noqa: E402
                   lock_discipline, lock_order, obs_check, state_machine,
-                  sync_check, wire_check)
+                  sync_check, thread_discipline, wire_check)
 from lint.registry import REGISTRY  # noqa: E402
 
 
@@ -42,12 +42,12 @@ def test_registry_has_all_passes():
     assert {"generic", "jax-hygiene", "lock-discipline", "lock-order",
             "determinism", "state-machine", "obs-journey",
             "obs-attribution", "obs-slo", "chaos-closure", "wire-closure",
-            "sync-hygiene", "import-layering"} <= names
+            "sync-hygiene", "thread-discipline", "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
             "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
-            "OBS002", "OBS003", "CHS001", "WIRE001", "SYN001",
-            "ARC001"} <= set(all_codes)
+            "OBS002", "OBS003", "CHS001", "WIRE001", "SYN001", "THR001",
+            "GRD001", "ARC001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
@@ -982,6 +982,158 @@ def test_det_real_repo_offenders_fixed():
            for line in lint.lint_file(f)
            if " DET00" in line]
     assert det == [], det
+
+
+# ------------------------------ THR001/GRD001 (package + cmd scoped)
+
+def test_thr_fixture_pairs_shipped():
+    assert set(thread_discipline.OFFENDERS) == set(thread_discipline.CODES)
+    assert set(thread_discipline.CLEAN) == set(thread_discipline.CODES)
+
+
+@pytest.mark.parametrize("code", sorted(thread_discipline.CODES))
+def test_thr_offenders_fire(code, tmp_path):
+    found = run_lint_pkg(tmp_path, thread_discipline.OFFENDERS[code],
+                         name=f"off_{code.lower()}.py")
+    assert code in codes(found), found
+
+
+@pytest.mark.parametrize("code", sorted(thread_discipline.CODES))
+def test_thr_clean_fixtures_stay_silent(code, tmp_path):
+    found = run_lint_pkg(tmp_path, thread_discipline.CLEAN[code],
+                         name=f"clean_{code.lower()}.py")
+    assert found == [], found
+
+
+def test_thr_fires_under_cmd_tree_too(tmp_path):
+    """cmd/ binaries spawn the ticker and watch threads — the shim
+    closure covers them, not just the package."""
+    d = tmp_path / "cmd"
+    d.mkdir(parents=True)
+    f = d / "somecli.py"
+    f.write_text(thread_discipline.OFFENDERS["THR001"])
+    assert "THR001" in codes(lint.lint_file(f))
+
+
+def test_thr_out_of_scope_paths_silent(tmp_path):
+    f = tmp_path / "case.py"
+    f.write_text(thread_discipline.OFFENDERS["THR001"])
+    assert lint.lint_file(f) == []
+
+
+def test_thr_shim_module_itself_exempt(tmp_path):
+    d = tmp_path / "k8s_operator_libs_tpu" / "utils"
+    d.mkdir(parents=True)
+    f = d / "threads.py"
+    f.write_text("import threading\n\n\ndef make():\n"
+                 "    return threading.Lock()\n")
+    assert lint.lint_file(f) == []
+
+
+def test_thr_alias_and_hatch(tmp_path):
+    src = (
+        "import threading as _t\n"
+        "\n"
+        "\n"
+        "def a():\n"
+        "    return _t.RLock()\n"
+        "\n"
+        "\n"
+        "def b():\n"
+        "    return _t.Lock()  # thr: allow — interpreter-startup guard "
+        "before the shim imports\n"
+    )
+    found = run_lint_pkg(tmp_path, src)
+    assert codes(found) == ["THR001"] and "RLock" in found[0]
+
+
+def test_grd_finding_names_lock_and_both_sites(tmp_path):
+    found = run_lint_pkg(tmp_path, thread_discipline.OFFENDERS["GRD001"])
+    grd = [f for f in found if " GRD001 " in f]
+    assert len(grd) == 1
+    msg = grd[0]
+    assert "self._lock" in msg            # the lock
+    assert "Runtime.drain()" in msg       # the guarded-write site
+    assert "Runtime.admitting()" in msg   # the lock-free site
+    assert "(line " in msg                # guarded-write line number
+
+
+def test_grd_lock_free_write_in_other_method_fires(tmp_path):
+    src = '''
+from ..utils import threads
+
+
+class Runtime:
+    def __init__(self):
+        self._lock = threads.make_lock("runtime")
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        self.count = 0
+'''
+    found = run_lint_pkg(tmp_path, src)
+    assert "GRD001" in codes(found)
+    assert any("written lock-free" in f for f in found)
+
+
+def test_grd_same_method_lock_free_access_silent(tmp_path):
+    """Cross-METHOD discipline only: a snapshot read in the same method
+    after dropping the lock is the check-then-act idiom the author can
+    see locally."""
+    src = '''
+from ..utils import threads
+
+
+class Runtime:
+    def __init__(self):
+        self._lock = threads.make_lock("runtime")
+        self.state = {}
+
+    def tick(self):
+        with self._lock:
+            self.state = {"n": 1}
+        return self.state
+'''
+    found = run_lint_pkg(tmp_path, src)
+    assert found == [], found
+
+
+def test_grd_hatch_respected(tmp_path):
+    src = '''
+from ..utils import threads
+
+
+class Runtime:
+    def __init__(self):
+        self._lock = threads.make_lock("runtime")
+        self.draining = False
+
+    def drain(self):
+        with self._lock:
+            self.draining = True
+
+    def admitting(self):
+        return not self.draining  # thr: allow — GIL-atomic bool, stale ok
+'''
+    assert run_lint_pkg(tmp_path, src) == []
+
+
+def test_thr_grd_real_repo_clean():
+    """The routing satellite: every library/cmd thread, lock and event
+    goes through the shim, and every guarded field holds its lock (or
+    carries a documented hatch) — zero findings, empty baseline."""
+    hits = []
+    for tree in ("k8s_operator_libs_tpu", "cmd"):
+        for f in sorted((REPO / tree).rglob("*.py")):
+            if "__pycache__" in f.parts:
+                continue
+            hits += [line for line in lint.lint_file(f)
+                     if " THR001 " in line or " GRD001 " in line]
+    assert hits == [], hits
 
 
 # ------------------------------------------------ LCK004 (scratch roots)

@@ -489,6 +489,10 @@ def test_operator_binary_serves_identity_and_histograms(tmp_path):
         t.join(timeout=20)
         srv.stop()
     assert rcs == [0]
+    # shutdown hygiene: a clean stop leaves no registered operator
+    # thread running (metrics server joined; no watch threads leaked)
+    from k8s_operator_libs_tpu.utils import threads as _threads
+    assert _threads.live_threads(prefix="operator-") == []
     records = [json.loads(line)
                for line in trace_path.read_text().splitlines()]
     assert any(r["name"] == "reconcile-tick" for r in records)

@@ -22,6 +22,8 @@ Passes:
 - :mod:`.chaos_check`     — CHS001 chaos fault-catalog closure
 - :mod:`.wire_check`      — WIRE001 wire-key registry closure
 - :mod:`.sync_check`      — SYN001 host-sync hygiene on the hot paths
+- :mod:`.thread_discipline` — THR001 threading-shim closure, GRD001
+                            guarded-field discipline
 - :mod:`.layering`        — ARC001 import layering + cycle rejection
 
 Usage::
@@ -56,7 +58,7 @@ from .registry import REGISTRY, Check, FileContext, all_codes, register
 from .index import ProjectIndex, as_index
 from . import (core, jax_hygiene, lock_discipline, lock_order, determinism,  # noqa: F401,E501  (registration imports)
                state_machine, obs_check, chaos_check, wire_check, sync_check,
-               layering)
+               thread_discipline, layering)
 from .core import BUILTINS, Checker, Scope  # noqa: F401  (compat re-exports)
 
 __all__ = ["lint_file", "lint_project", "run_suite", "main", "REGISTRY",

@@ -1,0 +1,40 @@
+"""tools.race — the deterministic concurrency sanitizer.
+
+The runtime half of the thread-discipline story (the static half is
+THR001/GRD001 in ``tools/lint/thread_discipline.py``):
+
+- :mod:`.scheduler` — cooperative CHESS/loom-style scheduler installed
+  as the ``utils/threads.py`` backend: one runnable thread at a time,
+  a preemption point at every shim lock/event/clock operation, seeded
+  choices, replayable decision trace, virtual time, deadlock reports;
+- :mod:`.explore`  — seeded bounded exploration with greedy trace
+  shrinking (the ``chaos/campaign.py`` seed-replay discipline applied
+  to interleavings);
+- :mod:`.lockset`  — Eraser-style lockset checker (module-scoped
+  ``sys.settrace`` over the operator-spine files) that convicts shared
+  attributes whose candidate lockset goes empty — races are found even
+  on schedules that happen not to corrupt anything;
+- :mod:`.harnesses` — the six real-component harnesses ``make race``
+  explores (drain workers, eviction workers, leader renew-vs-demote,
+  informer-vs-readers, uploader mirror-vs-wait_idle, router
+  ticker-vs-proxy);
+- :mod:`.planted`  — scratch components with deliberate bugs, the
+  sanitizer's own regression oracles.
+
+CLI::
+
+    python -m tools.race                   # make race: full exploration
+    python -m tools.race --smoke           # make race-smoke: fixed seeds
+    python -m tools.race --self-test       # planted bugs must be found
+    python -m tools.race --harness NAME --seeds N --base-seed K
+
+docs/static-analysis.md ("Schedule exploration") documents the model;
+docs/chaos.md cross-references the shared seed-replay discipline.
+"""
+
+from .explore import (ExploreResult, ScheduleResult, explore, replay,  # noqa: F401
+                      run_once, shrink)
+from .harnesses import HARNESSES, LOCKSET_FILES  # noqa: F401
+from .lockset import LocksetChecker, RaceFinding  # noqa: F401
+from .scheduler import (BudgetExceeded, CoopScheduler, DeadlockError,  # noqa: F401
+                        RunReport)
