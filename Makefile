@@ -12,9 +12,10 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-chaos test-router test-race health-sim chaos race \
-  race-smoke lint lint-domain lint-smoke cov-report cov-artifact bench \
-  bench-decode dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
+  test-obs-slo test-obs-profile test-chaos test-router test-race \
+  health-sim chaos race race-smoke fleetbench fleetbench-smoke lint \
+  lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
+  dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
 all: lint lint-domain native test
 
@@ -41,6 +42,21 @@ test-obs-workload:  ## workload telemetry: goodput ledger, serving metrics, down
 
 test-obs-slo:  ## SLO engine: tsdb, error budgets, burn-rate alerting, dashboard (docs/observability.md "SLOs & alerting")
 	$(PYTHON) -m pytest tests/test_slo.py -q
+
+test-obs-profile:  ## tick flight recorder: CountingClient accounting, profile decomposition + critical path, journey size guard, profiler-invariance under chaos (docs/observability.md "Tick profiling & apiserver accounting")
+	$(PYTHON) -m pytest tests/test_obs_profile.py -q
+
+FLEET_NODES ?= 10000
+FLEET_SLICES ?= 1000
+FLEET_TICKS ?= 12
+fleetbench:  ## control-plane scale baseline: ~10k-node/~1k-slice fakecluster through upgrade+health+SLO ticks with the profiler on; writes FLEET_r01.json (reconcile-tick p99, apiserver calls by verb, tsdb + journey integrity at scale) — the number the ROADMAP item-2 sharded reconcile must beat
+	$(PYTHON) tools/fleetbench.py --nodes $(FLEET_NODES) --slices $(FLEET_SLICES) --ticks $(FLEET_TICKS)
+
+FLEET_SMOKE_BUDGET ?= 300
+fleetbench-smoke:  ## budgeted CI gate (like lint-smoke): the same harness at ~500 nodes must finish inside FLEET_SMOKE_BUDGET seconds with every assertion holding
+	timeout $(FLEET_SMOKE_BUDGET) $(PYTHON) tools/fleetbench.py \
+	  --nodes 500 --slices 50 --ticks 6 --warmup 2 \
+	  --out /tmp/fleet_smoke.json
 
 test-chaos:  ## chaos harness + elastic training suites (docs/chaos.md)
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_elastic.py -q

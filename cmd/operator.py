@@ -48,6 +48,8 @@ from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: 
 from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
 from k8s_operator_libs_tpu.obs import JsonlSink, MetricsHub, Tracer  # noqa: E402
+from k8s_operator_libs_tpu.obs.profile import (TickProfiler,  # noqa: E402
+                                               counting_client)
 from k8s_operator_libs_tpu.obs.slo import SLOOptions  # noqa: E402
 from k8s_operator_libs_tpu.tpu.operator import (  # noqa: E402
     ManagedComponent, TPUOperator)
@@ -140,7 +142,7 @@ class MetricsServer:
 
     def __init__(self, port: int):
         self.snapshot = {"text": "", "healthy": False,
-                         "slo": None, "alerts": None}
+                         "slo": None, "alerts": None, "profile": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -156,10 +158,12 @@ class MetricsServer:
                     body = b"ok" if snapshot["healthy"] else b"not ready"
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
-                elif self.path in ("/slo", "/alerts"):
+                elif self.path in ("/slo", "/alerts", "/profile"):
                     payload = snapshot[self.path[1:]]
                     if payload is None:
-                        body = b'{"error": "slo engine disabled"}'
+                        body = (b'{"error": "profiler disabled"}'
+                                if self.path == "/profile" else
+                                b'{"error": "slo engine disabled"}')
                         ctype, code = "application/json", 404
                     else:
                         body = payload.encode()
@@ -258,6 +262,12 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                    help="append reconcile span records (one JSON object "
                         "per line) to PATH — the Dapper-style tick trace "
                         "(docs/observability.md)")
+    p.add_argument("--profile", action="store_true",
+                   help="tick flight recorder: per-handler self-time "
+                        "profiles with apiserver-call attribution "
+                        "(CountingClient), served as the /profile "
+                        "envelope and rendered by cmd/status.py "
+                        "--profile (docs/observability.md)")
     p.add_argument("--ensure-crds", default=None, metavar="DIR",
                    help="apply CRDs from DIR before the first tick")
     p.add_argument("--leader-elect", action="store_true",
@@ -291,8 +301,17 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         logger.info("bootstrapped %d CRDs", n)
 
     hub = MetricsHub()
-    tracer = Tracer(sink=JsonlSink(args.trace_log)) if args.trace_log \
-        else Tracer()
+    trace_sink = JsonlSink(args.trace_log) if args.trace_log else None
+    profiler = TickProfiler(inner=trace_sink) if args.profile else None
+    tracer = Tracer(sink=profiler or trace_sink) \
+        if (profiler or trace_sink) else Tracer()
+    if args.profile:
+        # apiserver-call accounting at the client boundary: every call
+        # the operator (and its elector) issues is counted per verb/kind
+        # and attributed to the span that issued it
+        client = counting_client(client, metrics=hub, tracer=tracer)
+        logger.info("tick profiling on (apiserver-call accounting at the "
+                    "client boundary)")
     # identity metrics: dashboards tell replicas and builds apart even
     # before the first reconcile (and on permanent standbys)
     hub.set_gauge("build_info", 1.0, labels={
@@ -441,6 +460,9 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if operator.slo_engine is not None:
                     server.snapshot["slo"] = slo_payload(operator)
                     server.snapshot["alerts"] = alerts_payload(operator)
+                if profiler is not None:
+                    server.snapshot["profile"] = json.dumps(
+                        {"kind": "profile", "data": profiler.payload()})
             if args.once:
                 break
             remaining = max(0.0, args.interval - (time.monotonic() - t0))
@@ -478,8 +500,8 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
             if stuck:
                 logger.warning("watch threads still running at shutdown "
                                "deadline: %s", ", ".join(stuck))
-        if isinstance(tracer.sink, JsonlSink):
-            tracer.sink.close()
+        if trace_sink is not None:
+            trace_sink.close()
         for sig, handler in prev_handlers.items():
             signal.signal(sig, handler)
     logger.info("exiting after %d ticks", ticks)

@@ -44,14 +44,24 @@ decision (docs/router.md):
 
     python cmd/status.py --replicas --router-url http://router:8300
 
+``--profile`` renders the TICK FLIGHT RECORDER's view fetched from a
+running operator's ``/profile`` endpoint (operator started with
+``--profile``): the last reconcile tick decomposed into per-handler
+self-times plus attributed apiserver calls, its critical path, and the
+ring aggregate (docs/observability.md "Tick profiling & apiserver
+accounting"):
+
+    python cmd/status.py --profile --operator-url http://operator:8080
+
 ``--json`` always emits one ``{"kind": <view>, "data": ...}`` envelope
-(kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``, ``replicas``).
+(kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``, ``replicas``,
+``profile``).
 
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
 gates and scripts can wait on it. ``--timeline``, ``--goodput``,
-``--slo``, ``--alerts``, and ``--replicas`` always exit 0 (except 2 when
-the endpoint is unreachable).
+``--slo``, ``--alerts``, ``--replicas``, and ``--profile`` always exit 0
+(except 2 when the endpoint is unreachable).
 """
 
 import argparse
@@ -65,7 +75,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 from k8s_operator_libs_tpu.health import consts as health_consts  # noqa: E402
 from k8s_operator_libs_tpu.obs.attribution import attribute_downtime  # noqa: E402
 from k8s_operator_libs_tpu.obs.goodput import read_ledger, summarize  # noqa: E402
-from k8s_operator_libs_tpu.obs.journey import parse_journey  # noqa: E402
+from k8s_operator_libs_tpu.obs.journey import (parse_journey,  # noqa: E402
+                                               parse_journey_full)
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState  # noqa: E402
 from k8s_operator_libs_tpu.upgrade.util import KeyFactory, parse_selector  # noqa: E402
 from k8s_operator_libs_tpu.tpu.topology import slice_info_for_node  # noqa: E402
@@ -161,13 +172,14 @@ def render_table(component: str, rows) -> str:
 
 
 def collect_timeline(client, component: str, node_name: str, now=None):
-    """The node's journey for one component, as duration-annotated rows.
-    ``now`` closes the open-ended last phase (defaults to wall clock;
-    injectable for deterministic tests)."""
+    """The node's journey for one component, as duration-annotated rows
+    plus the count of size-guard-truncated older entries. ``now`` closes
+    the open-ended last phase (defaults to wall clock; injectable for
+    deterministic tests)."""
     now = time.time() if now is None else now
     keys = KeyFactory(component)
     node = client.get_node(node_name)
-    entries = parse_journey(
+    entries, truncated = parse_journey_full(
         node.metadata.annotations.get(keys.journey_annotation))
     rows = []
     for i, (state, entered) in enumerate(entries):
@@ -180,7 +192,7 @@ def collect_timeline(client, component: str, node_name: str, now=None):
             "ongoing": ongoing,
         })
     stuck = node.metadata.annotations.get(keys.stuck_reported_annotation)
-    return rows, stuck
+    return rows, stuck, truncated
 
 
 def collect_goodput(ledger_path: str, client=None, components=(),
@@ -426,6 +438,86 @@ def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
         sleep(args.watch_interval)
 
 
+# ------------------------------------------------------ profile dashboard
+
+
+def _fmt_ms(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1000:.1f}ms"
+
+
+def render_profile(data) -> str:
+    """The tick flight recorder's view: the last profiled tick's
+    decomposition (self time + attributed apiserver time per handler),
+    its critical path, and the ring aggregate."""
+    last = data.get("last") or []
+    if not last:
+        return ("no ticks profiled yet (operator warming up, or running "
+                "without --profile?)")
+    tick = last[-1]
+    lines = [f"{data.get('ticks_profiled', len(last))} ticks profiled "
+             f"(ring keeps {data.get('ring_capacity', '?')})",
+             "",
+             f"last tick (trace {tick['trace']}): "
+             f"{_fmt_ms(tick['duration_s'])} = "
+             f"self {_fmt_ms(tick['self_total_s'])} + "
+             f"apiserver {_fmt_ms(tick['api_total_s'])} "
+             f"({tick['api_call_count']} calls)"]
+    path = " -> ".join(
+        f"{hop['name']}"
+        + (f"[{hop['component']}]" if hop["component"] else "")
+        + f" {_fmt_ms(hop['duration_s'])}"
+        for hop in tick.get("critical_path") or [])
+    lines.append(f"critical path: {path}")
+    lines.append("")
+    headers = ("COMPONENT", "HANDLER", "STATE", "SELF", "API", "CALLS",
+               "%TICK")
+    total = tick["duration_s"] or 1.0
+    table = []
+    for e in tick["entries"]:
+        spent = e["self_s"] + e["api_s"]
+        calls = sum(e["api_calls"].values())
+        table.append((e["component"] or "-", e["handler"],
+                      e["state"] or "-", _fmt_ms(e["self_s"]),
+                      _fmt_ms(e["api_s"]), str(calls),
+                      f"{spent / total:.0%}"))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    agg = data.get("aggregate") or {}
+    calls = agg.get("api_calls") or {}
+    if agg.get("ticks"):
+        lines.append("")
+        lines.append(
+            f"ring aggregate over {agg['ticks']} tick(s): "
+            f"{_fmt_ms(agg['duration_s'])} total "
+            f"(apiserver {_fmt_ms(agg['api_total_s'])}); calls: "
+            + (", ".join(f"{name} x{n}" for name, n in
+                         sorted(calls.items(), key=lambda kv: -kv[1])[:8])
+               or "-"))
+    return "\n".join(lines)
+
+
+def run_profile_view(args, fetch=fetch_view) -> int:
+    """--profile: fetch the operator's /profile envelope and render the
+    last tick's decomposition + critical path (exit 2 when the endpoint
+    is unreachable, like the other HTTP views)."""
+    try:
+        env = fetch(args.operator_url, "/profile")
+    except Exception as exc:
+        print(f"error: cannot read {args.operator_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_profile(env.get("data") or {}))
+    return 0
+
+
 def render_replicas(data) -> str:
     """One row per serving replica from the router's /replicas view."""
     replicas = data.get("replicas") or []
@@ -481,12 +573,17 @@ def run_replicas_view(args, fetch=fetch_view) -> int:
     return 0
 
 
-def render_timeline(component: str, node_name: str, rows, stuck) -> str:
+def render_timeline(component: str, node_name: str, rows, stuck,
+                    truncated: int = 0) -> str:
     lines = [f"component: {component}  node: {node_name}"]
     if not rows:
         lines.append("  (no journey recorded — the node never transitioned "
                      "under this component's state machine)")
         return "\n".join(lines)
+    if truncated:
+        lines.append(f"  ({truncated} older entr"
+                     f"{'y' if truncated == 1 else 'ies'} truncated by the "
+                     f"journey size guard)")
     headers = ("STATE", "ENTERED", "DURATION")
     table = []
     for r in rows:
@@ -548,6 +645,10 @@ def main(argv=None, client=None, now=None) -> int:
                    metavar="SECONDS")
     p.add_argument("--watch-count", type=int, default=0, metavar="N",
                    help="stop after N refreshes (0 = forever)")
+    p.add_argument("--profile", action="store_true",
+                   help="render the tick flight recorder's last-tick "
+                        "decomposition and critical path from a running "
+                        "operator's /profile endpoint")
     p.add_argument("--replicas", action="store_true",
                    help="render the serving router's replica registry "
                         "from a running cmd/router.py")
@@ -561,6 +662,10 @@ def main(argv=None, client=None, now=None) -> int:
         # the replica registry is the router's HTTP view, never the
         # cluster's (the router owns the authoritative in-memory state)
         return run_replicas_view(args)
+    if args.profile:
+        # the flight recorder lives in the operator process; its ring is
+        # the authoritative state, so this is an HTTP view too
+        return run_profile_view(args)
     if args.slo or args.alerts or args.watch:
         # SLO views read the operator's HTTP endpoints, never the cluster
         return run_slo_view(args)
@@ -588,12 +693,13 @@ def main(argv=None, client=None, now=None) -> int:
     if args.timeline:
         out = {}
         for comp in args.component:
-            rows, stuck = collect_timeline(client, comp, args.timeline,
-                                           now=now)
+            rows, stuck, truncated = collect_timeline(
+                client, comp, args.timeline, now=now)
             out[comp] = {"node": args.timeline, "timeline": rows,
-                         "stuck_reported": stuck}
+                         "stuck_reported": stuck, "truncated": truncated}
             if not args.as_json:
-                print(render_timeline(comp, args.timeline, rows, stuck))
+                print(render_timeline(comp, args.timeline, rows, stuck,
+                                      truncated))
                 print()
         if args.as_json:
             print(json.dumps({"kind": "timeline", "data": out}, indent=2))

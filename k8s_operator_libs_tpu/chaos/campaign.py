@@ -40,7 +40,9 @@ from ..health.monitor import HealthOptions
 from ..health.remediation import RemediationPolicy
 from ..obs.goodput import GoodputLedger
 from ..obs.metrics import MetricsHub
+from ..obs.profile import TickProfiler, counting_client
 from ..obs.slo import SLOOptions
+from ..obs.trace import Tracer
 from ..serving.pool import DRAIN_STATES, Replica, ReplicaPool
 from ..serving.router import RequestRouter
 from ..serving.sim import SimReplicaRuntime, sim_tokens
@@ -81,6 +83,10 @@ class CampaignResult:
     # serving-tier summary: submitted/completed/rerouted request counts,
     # drain handoffs, and how many replica generations were spawned
     router_stats: Optional[Dict[str, int]] = None
+    # per-candidate flight-recorder payloads when run with profile=True
+    # (None otherwise) — the profiler-determinism test compares these
+    # across reruns of the same seed
+    profile_payloads: Optional[Dict[str, dict]] = None
 
     @property
     def failed(self) -> bool:
@@ -129,8 +135,8 @@ def build_fleet(cluster: FakeCluster, fleet) -> List[str]:
     return nodes
 
 
-def _make_operator(client, recorder, clock, max_unavailable: str
-                   ) -> TPUOperator:
+def _make_operator(client, recorder, clock, max_unavailable: str,
+                   tracer=None) -> TPUOperator:
     return TPUOperator(
         client,
         components=[ManagedComponent(
@@ -149,7 +155,7 @@ def _make_operator(client, recorder, clock, max_unavailable: str
             policy=RemediationPolicy(recovery_seconds=45.0,
                                      backoff_base_seconds=60.0,
                                      max_unavailable=max_unavailable)),
-        slo=SLOOptions.from_dict({}))
+        slo=SLOOptions.from_dict({}), tracer=tracer)
 
 
 class SimJob:
@@ -333,11 +339,18 @@ def run_scenario(scenario: Scenario, seed: int,
                  workdir: Optional[str] = None,
                  invariants: Optional[List[Invariant]] = None,
                  hooks: Optional[List[Callable]] = None,
-                 stop_on_violation: bool = True) -> CampaignResult:
+                 stop_on_violation: bool = True,
+                 profile: bool = False) -> CampaignResult:
     """Run one scenario under one seed to convergence (or violation /
     tick exhaustion). ``hooks`` run each tick after the reconcile and
     before the invariant pass — tests inject rogue out-of-band writes
-    there to prove the checkers catch them."""
+    there to prove the checkers catch them.
+
+    ``profile=True`` runs each candidate with the full flight recorder
+    (Tracer + TickProfiler + CountingClient between operator and chaos
+    client) — pure accounting, so every invariant outcome, journey
+    annotation, and router stat must be IDENTICAL to a profile=False run
+    of the same seed; tests/test_obs_profile.py pins exactly that."""
     clock = FakeClock(10_000.0)
     cluster = FakeCluster(clock=clock, cache_lag=0.5)
     fleet_nodes = build_fleet(cluster, scenario.fleet)
@@ -346,13 +359,19 @@ def run_scenario(scenario: Scenario, seed: int,
                              namespace=NS, driver_labels=DRIVER_LABELS,
                              lease_duration_s=LEASE_DURATION_S)
     candidates = []
+    profilers: Dict[str, TickProfiler] = {}
     for identity in ("op-a", "op-b"):
         client = injector.client(identity)
+        tracer = None
+        if profile:
+            profilers[identity] = TickProfiler()
+            tracer = Tracer(sink=profilers[identity], clock=clock)
+            client = counting_client(client, tracer=tracer, clock=clock)
         elector = LeaderElector(client, LEASE_NAME, LEASE_NS, identity,
                                 lease_duration_s=LEASE_DURATION_S,
                                 retry_period_s=LEASE_RETRY_S, clock=clock)
         op = _make_operator(client, cluster.recorder, clock,
-                            scenario.max_unavailable)
+                            scenario.max_unavailable, tracer=tracer)
         candidates.append((identity, elector, op))
 
     tmp = None
@@ -451,7 +470,9 @@ def run_scenario(scenario: Scenario, seed: int,
             "rerouted": tier.router._rerouted,
             "drains": len(tier.router.drains),
             "generations": tier._gen,
-        })
+        },
+        profile_payloads={identity: p.payload()
+                          for identity, p in profilers.items()} or None)
 
 
 def _converged(cluster: FakeCluster, keys: KeyFactory,

@@ -56,7 +56,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs.attribution import attribute_downtime, windows_from_journey
 from ..obs.goodput import read_ledger
-from ..obs.journey import MAX_JOURNEY_ENTRIES, parse_journey
+from ..obs.journey import (MAX_JOURNEY_ENTRIES, parse_journey,
+                           parse_journey_full)
 from ..upgrade.consts import UpgradeState
 
 INVARIANT_NAMES = (
@@ -203,12 +204,17 @@ class JourneyInvariant(Invariant):
 
     def __init__(self):
         self._prev: Dict[str, List[Tuple[str, float]]] = {}
+        self._prev_truncated: Dict[str, int] = {}
 
     def check(self, view: CampaignView) -> List[Violation]:
         out: List[Violation] = []
         for name, node in view.nodes.items():
-            entries = parse_journey(
+            entries, truncated = parse_journey_full(
                 node.metadata.annotations.get(view.keys.journey_annotation))
+            if truncated < self._prev_truncated.get(name, 0):
+                out.append(self._v(
+                    view, f"{name}: journey truncation marker regressed "
+                    f"{self._prev_truncated[name]} -> {truncated}"))
             for (s1, t1), (s2, t2) in zip(entries, entries[1:]):
                 if t2 < t1:
                     out.append(self._v(
@@ -225,22 +231,30 @@ class JourneyInvariant(Invariant):
                         f"{s1 or 'unknown'} -> {s2} (legal: "
                         f"{', '.join(legal) or 'none'})"))
             prev = self._prev.get(name)
-            if prev is not None and not self._extends(prev, entries):
+            newly_truncated = truncated > self._prev_truncated.get(name, 0)
+            if prev is not None and not self._extends(
+                    prev, entries, trimmed=newly_truncated):
                 out.append(self._v(
                     view, f"{name}: journey not continuous — previous "
                     f"{prev[-3:]} is no prefix of current "
                     f"{entries[-3:]} (reset across failover?)"))
             self._prev[name] = entries
+            self._prev_truncated[name] = truncated
         return out
 
     @staticmethod
     def _extends(prev: List[Tuple[str, float]],
-                 cur: List[Tuple[str, float]]) -> bool:
+                 cur: List[Tuple[str, float]],
+                 trimmed: bool = False) -> bool:
         if cur[:len(prev)] == prev:
             return True
-        # trimming the oldest entries is legal only at the cap
-        if len(cur) >= MAX_JOURNEY_ENTRIES:
-            for drop in range(1, len(prev) + 1):
+        # trimming the oldest entries is legal only when the size guard
+        # says it happened: the durable `truncated` marker grew, or the
+        # journey sits at the entry cap (pre-marker journeys)
+        if trimmed or len(cur) >= MAX_JOURNEY_ENTRIES:
+            # some NON-EMPTY tail of prev must prefix cur — a trim drops
+            # the head, it never severs all overlap between ticks
+            for drop in range(1, len(prev)):
                 tail = prev[drop:]
                 if cur[:len(tail)] == tail:
                     return True
@@ -308,9 +322,10 @@ class EventDedupInvariant(Invariant):
             node = view.nodes.get(node_name)
             if node is None:
                 continue
-            entries = parse_journey(node.metadata.annotations.get(
-                view.keys.journey_annotation))
-            if len(entries) >= MAX_JOURNEY_ENTRIES:
+            entries, truncated = parse_journey_full(
+                node.metadata.annotations.get(
+                    view.keys.journey_annotation))
+            if truncated or len(entries) >= MAX_JOURNEY_ENTRIES:
                 continue  # trimmed: entry count no longer evidentiary
             entered = sum(1 for s, _ in entries if s == state)
             if count > entered:

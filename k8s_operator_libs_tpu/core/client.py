@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import abc
 import logging
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+from ..utils import threads
+from ..utils.clock import Clock, RealClock
 from .objects import ControllerRevision, DaemonSet, Event, Job, Node, Pod
 
 logger = logging.getLogger(__name__)
@@ -169,6 +171,131 @@ class ClientEventRecorder(EventRecorder):
                    namespace=self._namespace)
         except Exception as exc:
             logger.debug("event write failed (%s); dropping %s", exc, reason)
+
+
+# ---------------------------------------------------------------------------
+# apiserver-call accounting (the obs flight recorder's client-boundary
+# half — docs/observability.md "Tick profiling & apiserver accounting")
+
+# method prefixes that are apiserver requests; anything else on a client
+# (start/stop/set_event_hook/flush_cache) is client machinery, passed
+# through untouched and uncounted
+API_VERBS = ("get", "list", "watch", "create", "update", "patch",
+             "delete", "evict")
+
+# method-name token after the verb -> Kubernetes kind (longest first so
+# "controller_revisions" never resolves as a bare prefix of something
+# shorter)
+_KIND_TOKENS = (
+    ("controller_revisions", "ControllerRevision"),
+    ("daemonsets", "DaemonSet"),
+    ("daemonset", "DaemonSet"),
+    ("services", "Service"),
+    ("service", "Service"),
+    ("events", "Event"),
+    ("event", "Event"),
+    ("leases", "Lease"),
+    ("lease", "Lease"),
+    ("nodes", "Node"),
+    ("node", "Node"),
+    ("pods", "Pod"),
+    ("pod", "Pod"),
+    ("jobs", "Job"),
+    ("job", "Job"),
+)
+
+
+def method_verb_kind(name: str) -> Optional[Tuple[str, str]]:
+    """Client method name → (verb, kind), or None for non-API machinery:
+    ``patch_node_metadata`` → ("patch", "Node"), ``list_pods`` →
+    ("list", "Pod"), ``evict_pod`` → ("evict", "Pod"). Unknown kinds
+    under a known verb count as kind "" rather than going dark."""
+    verb, _, rest = name.partition("_")
+    if verb not in API_VERBS:
+        return None
+    for token, kind in _KIND_TOKENS:
+        if rest == token or rest.startswith(token + "_"):
+            return verb, kind
+    return verb, ""
+
+
+class CountingClient:
+    """Transparent accounting wrapper at the client boundary — the same
+    ``__getattr__`` shape as chaos's ChaosClient, and composes with it
+    (wrap the ChaosClient, never the reverse, so fault decisions see the
+    exact call sequence an unwrapped operator would issue). Every API
+    call is counted per (verb, kind), timed on the injected clock, and —
+    when a tracer is wired — attributed to the span that issued it (the
+    ``api_calls`` / ``api_time_s`` span attributes the tick profiler
+    reads). Pure accounting: no call is ever delayed, reordered, or
+    failed, which the chaos profiler-invariance test pins."""
+
+    def __init__(self, inner, metrics=None, tracer=None,
+                 clock: Optional[Clock] = None,
+                 duration_buckets: Optional[Tuple[float, ...]] = None,
+                 _counts=None, _lock=None):
+        self._inner = inner
+        self._metrics = metrics
+        self._tracer = tracer
+        self._clock = clock or RealClock()
+        self._duration_buckets = duration_buckets
+        # shared across direct() views so one tally covers both paths
+        self._counts: Dict[Tuple[str, str], int] = (
+            {} if _counts is None else _counts)
+        self._counts_lock = _lock or threads.make_lock("counting-client")
+
+    def direct(self) -> "CountingClient":
+        return CountingClient(self._inner.direct(), metrics=self._metrics,
+                              tracer=self._tracer, clock=self._clock,
+                              duration_buckets=self._duration_buckets,
+                              _counts=self._counts,
+                              _lock=self._counts_lock)
+
+    def counts(self) -> Dict[Tuple[str, str], int]:
+        """Cumulative {(verb, kind): calls} since construction (shared
+        with every direct() view)."""
+        with self._counts_lock:
+            return dict(self._counts)
+
+    def total_calls(self) -> int:
+        with self._counts_lock:
+            return sum(self._counts.values())
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+        vk = method_verb_kind(name)
+        if vk is None:
+            return attr
+        verb, kind = vk
+
+        def call(*args, **kwargs):
+            t0 = self._clock.now()
+            try:
+                return attr(*args, **kwargs)
+            finally:
+                dt = max(0.0, self._clock.now() - t0)
+                with self._counts_lock:
+                    self._counts[(verb, kind)] = \
+                        self._counts.get((verb, kind), 0) + 1
+                if self._metrics is not None:
+                    labels = {"verb": verb, "kind": kind}
+                    self._metrics.inc("apiserver_requests_total",
+                                      labels=labels)
+                    self._metrics.observe(
+                        "apiserver_request_duration_seconds", dt,
+                        labels=labels, buckets=self._duration_buckets)
+                if self._tracer is not None:
+                    span = self._tracer.current()
+                    if span is not None:
+                        calls = span.attrs.setdefault("api_calls", {})
+                        key = f"{verb} {kind}".rstrip()
+                        calls[key] = calls.get(key, 0) + 1
+                        span.attrs["api_time_s"] = \
+                            span.attrs.get("api_time_s", 0.0) + dt
+
+        return call
 
 
 def make_event(obj, event_type: str, reason: str, message: str) -> Event:

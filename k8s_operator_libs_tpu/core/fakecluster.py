@@ -250,23 +250,35 @@ class FakeCluster:
 
     def list(self, kind: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None,
-             cached: bool = False) -> List[object]:
+             cached: bool = False,
+             field_node_name: Optional[str] = None) -> List[object]:
+        """``field_node_name`` is served store-side like the real
+        apiserver's ``spec.nodeName`` field selector — filtering BEFORE
+        the deep copy, not after, so a per-node pod list on a 10k-pod
+        fleet copies one object, not ten thousand (the fleetbench
+        hot path). Output order is (namespace, name), identical to the
+        previous full-store sort for a single kind."""
         with self._lock:
             if cached:
                 self._sync_cache()
                 src = self._cache
             else:
                 src = self._store
-            out = []
-            for (k, ns, _), obj in sorted(src.items()):
+            matched = []
+            for (k, ns, name), obj in src.items():
                 if k != kind:
                     continue
                 if namespace is not None and ns != namespace:
                     continue
+                if (field_node_name is not None
+                        and getattr(obj.spec, "node_name", None)
+                        != field_node_name):
+                    continue
                 if not _match_labels(obj.metadata.labels, label_selector):
                     continue
-                out.append(deep_copy(obj))
-            return out
+                matched.append(((ns, name), obj))
+            matched.sort(key=lambda kv: kv[0])
+            return [deep_copy(obj) for _, obj in matched]
 
     def list_with_rv(self, kind: str, namespace: Optional[str] = None,
                      label_selector: Optional[Dict[str, str]] = None
@@ -516,11 +528,12 @@ class _FakeClient(Client):
 
     def list_pods(self, namespace=None, label_selector=None,
                   field_node_name=None) -> List[Pod]:
-        pods = self._c.list("Pod", namespace=namespace, label_selector=label_selector,
-                            cached=self._cached)
-        if field_node_name is not None:
-            pods = [p for p in pods if p.spec.node_name == field_node_name]
-        return pods
+        # field selector served store-side (pre-copy), like the real
+        # apiserver's spec.nodeName index
+        return self._c.list("Pod", namespace=namespace,
+                            label_selector=label_selector,
+                            cached=self._cached,
+                            field_node_name=field_node_name)
 
     def list_daemonsets(self, namespace=None, label_selector=None) -> List[DaemonSet]:
         return self._c.list("DaemonSet", namespace=namespace,

@@ -31,6 +31,12 @@ al., 2010) and the time-series-first philosophy of Borgmon/Prometheus:
                     and gauge collectors once per reconcile tick, with
                     downsampling for long windows and a bucket-quantile
                     estimator;
+- :mod:`.profile` — the tick FLIGHT RECORDER: a span sink folding each
+                    reconcile tick into a per-(component, handler)
+                    self-time profile with apiserver-call attribution
+                    (CountingClient at the client boundary) and
+                    critical-path extraction, kept in a fixed-memory
+                    ring and served as the ``/profile`` envelope;
 - :mod:`.slo`     — declarative SLO specs over the tsdb: error-budget
                     accounting and Google-SRE multi-window multi-burn-
                     rate evaluation;
@@ -53,6 +59,8 @@ from .goodput import (GoodputLedger, read_ledger, summarize,
 from .journey import (DEFAULT_STUCK_THRESHOLDS, JourneyRecorder,
                       StuckNodeDetector, parse_journey)
 from .metrics import HELP_TEXTS, MetricsHub, escape_label_value, help_for
+from .profile import (HANDLER_STATES, TickProfiler, build_profile,
+                      counting_client)
 from .slo import (DEFAULT_BURN_WINDOWS, DEFAULT_SLO_SPECS, BurnWindow,
                   SLOEngine, SLOOptions, SLOSpec, parse_duration)
 from .trace import JsonlSink, ListSink, NullSink, Span, Tracer
@@ -69,4 +77,5 @@ __all__ = [
     "DEFAULT_BURN_WINDOWS", "DEFAULT_SLO_SPECS", "BurnWindow",
     "SLOEngine", "SLOOptions", "SLOSpec", "parse_duration",
     "AlertManager", "AlertRule",
+    "HANDLER_STATES", "TickProfiler", "build_profile", "counting_client",
 ]

@@ -16,8 +16,19 @@ Wire format (one annotation per managed component)::
          ...]
 
 — a JSON list of ``[state wire value, entered-at wall seconds]`` pairs,
-newest last, capped at :data:`MAX_JOURNEY_ENTRIES` (oldest dropped; a
-journey entry is ~30 bytes, far under the 256 KiB annotation budget).
+newest last, capped at :data:`MAX_JOURNEY_ENTRIES` entries AND
+:data:`MAX_JOURNEY_BYTES` serialized bytes (k8s enforces a hard
+per-object annotation budget; a 10k-node fleet with long repair
+histories would hit it silently otherwise). Once truncation has
+happened the journey switches to the object form::
+
+    {"truncated": 3, "entries": [["drain-required",1722700150.0], ...]}
+
+carrying the count of dropped oldest entries, so readers (``cmd/status
+--timeline``, the fleet benchmark's integrity sweep) can tell a short
+journey from a clipped one. Untruncated journeys keep the legacy list
+form byte-for-byte — existing annotations, golden patch fixtures, and
+external parsers are unaffected until the cap actually binds.
 
 This module deliberately does NOT import the upgrade package (obs sits
 below it in the layering DAG), so :data:`DEFAULT_STUCK_THRESHOLDS` is keyed
@@ -37,6 +48,10 @@ from ..utils.clock import Clock, RealClock
 logger = logging.getLogger(__name__)
 
 MAX_JOURNEY_ENTRIES = 48
+# serialized-size guard: k8s caps TOTAL annotations per object at 256 KiB,
+# and one node carries a journey per managed component plus the health /
+# repair / heartbeat annotations — budget each journey well under that
+MAX_JOURNEY_BYTES = 8192
 
 # Per-state stuck thresholds (seconds of dwell before the node is reported
 # stuck); 0 disables detection for that state. Keyed by wire value — OBS001
@@ -75,22 +90,41 @@ DEFAULT_STUCK_THRESHOLDS: Dict[str, float] = {
 STUCK_EVENT_REASON = "StuckNode"
 
 
-def parse_journey(raw: Optional[str]) -> List[Tuple[str, float]]:
-    """Annotation value → [(state wire value, entered-at wall seconds)].
+def parse_journey_full(raw: Optional[str]
+                       ) -> Tuple[List[Tuple[str, float]], int]:
+    """Annotation value → ([(state wire value, entered-at wall seconds)],
+    truncated-entry count). Accepts both the legacy list form (truncated
+    count 0) and the object form a size-guarded journey switches to.
     Malformed values (operator downgrade, fat-fingered kubectl edit) parse
     as an empty journey rather than wedging the reconcile loop."""
     if not raw:
-        return []
+        return [], 0
     try:
         data = json.loads(raw)
-        return [(str(s), float(t)) for s, t in data]
+        truncated = 0
+        if isinstance(data, dict):
+            truncated = int(data.get("truncated", 0))
+            data = data.get("entries", [])
+        return [(str(s), float(t)) for s, t in data], truncated
     except (ValueError, TypeError):
         logger.warning("unparseable journey annotation %r; starting fresh",
                        raw[:120])
-        return []
+        return [], 0
 
 
-def dump_journey(entries: List[Tuple[str, float]]) -> str:
+def parse_journey(raw: Optional[str]) -> List[Tuple[str, float]]:
+    """Entries only — the read every consumer that cares about the tail
+    (stuck detection, attribution, dwell math) uses; truncation clips the
+    OLDEST entries, so those reads are unaffected by the size guard."""
+    return parse_journey_full(raw)[0]
+
+
+def dump_journey(entries: List[Tuple[str, float]],
+                 truncated: int = 0) -> str:
+    if truncated:
+        return json.dumps({"truncated": truncated,
+                           "entries": [[s, t] for s, t in entries]},
+                          separators=(",", ":"))
     return json.dumps([[s, t] for s, t in entries],
                       separators=(",", ":"))
 
@@ -106,20 +140,22 @@ class JourneyRecorder:
 
     def __init__(self, component: str, annotation_key: str, stuck_key: str,
                  clock: Optional[Clock] = None, metrics=None,
-                 max_entries: int = MAX_JOURNEY_ENTRIES):
+                 max_entries: int = MAX_JOURNEY_ENTRIES,
+                 max_bytes: int = MAX_JOURNEY_BYTES):
         self.component = component
         self.annotation_key = annotation_key
         self.stuck_key = stuck_key
         self._clock = clock or RealClock()
         self._metrics = metrics
         self._max_entries = max_entries
+        self._max_bytes = max_bytes
 
     def record(self, node, old_state: str,
                new_state: str) -> Dict[str, Optional[str]]:
         """→ annotation updates (None value = delete) for the transition
         ``old_state -> new_state`` on ``node``; empty dict when the journey
         already ends in ``new_state`` (not a real transition)."""
-        entries = parse_journey(
+        entries, truncated = parse_journey_full(
             node.metadata.annotations.get(self.annotation_key))
         if entries and entries[-1][0] == new_state:
             return {}
@@ -131,11 +167,22 @@ class JourneyRecorder:
                 labels={"component": self.component,
                         "state": prev_state or "unknown"})
         entries.append((new_state, now))
-        if len(entries) > self._max_entries:
-            entries = entries[-self._max_entries:]
+        # size guard, oldest first: entry-count cap, then the serialized
+        # byte cap (k8s annotation budget). The dropped count rides the
+        # wire as the `truncated` marker so a clipped journey is never
+        # mistaken for a short one; the TAIL — what stuck detection and
+        # --timeline dwell math read — is always intact.
+        while len(entries) > self._max_entries:
+            entries.pop(0)
+            truncated += 1
+        while (len(entries) > 1 and self._max_bytes > 0
+               and len(dump_journey(entries, truncated))
+               > self._max_bytes):
+            entries.pop(0)
+            truncated += 1
         # entering a new state clears the stuck-reported marker so the NEXT
         # dwell gets its own (single) event
-        return {self.annotation_key: dump_journey(entries),
+        return {self.annotation_key: dump_journey(entries, truncated),
                 self.stuck_key: None}
 
     def entered_at(self, node, state: str) -> Optional[float]:

@@ -132,6 +132,22 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_operator_leader":
         "1 on the replica holding the leader lease (or running without "
         "leader election), 0 on hot standbys",
+    # flight-recorder / apiserver-accounting families (core/client.py
+    # CountingClient + the tick profiler's scrape self-metrics,
+    # obs/profile.py — OBS003 closes these over the PROFILE_*_FAMILIES
+    # tables both ways)
+    "tpu_operator_apiserver_request_duration_seconds":
+        "Seconds one apiserver request took at the client boundary "
+        "(CountingClient middleware; labels carry verb and kind)",
+    "tpu_operator_apiserver_requests_total":
+        "Apiserver requests issued through the client boundary since "
+        "process start, by verb and kind",
+    "tpu_operator_tsdb_series":
+        "In-process tsdb series by state: active (retained rings) and "
+        "evicted (writes refused at the series cap)",
+    "tpu_operator_obs_scrape_duration_seconds":
+        "Seconds the per-tick tsdb scrape of the hub snapshot and gauge "
+        "collectors took — observability overhead, itself observable",
     # SLO engine + alert manager families (obs/slo.py, obs/alerts.py —
     # OBS003 closes these over the emitted-family tables both ways)
     "tpu_operator_slo_error_budget_remaining":
@@ -267,6 +283,13 @@ TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
 QUEUE_DEPTH_BUCKETS: Tuple[float, ...] = (
     0, 1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+# apiserver round-trips and the tsdb scrape live in the ms-to-seconds
+# range — the control-plane ladder's first bucket (10 ms) would flatten
+# every healthy call into one bin
+API_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0)
+
 
 def help_for(metric: str, default: Optional[str] = None) -> str:
     """Description for a fully-prefixed metric name; unknown names keep the
@@ -362,6 +385,10 @@ class MetricsHub:
         # name -> {label-items tuple -> value}
         self._gauges: Dict[str, Dict[Tuple[Tuple[str, str], ...],
                                      float]] = {}
+        # cumulative counters (rendered TYPE counter; names must follow
+        # the *_total convention — the exposition validator enforces it)
+        self._counters: Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                       float]] = {}
 
     # -------------------------------------------------------------- writes
 
@@ -383,6 +410,16 @@ class MetricsHub:
             series = self._gauges.setdefault(name, {})
             series[tuple(sorted((labels or {}).items()))] = float(value)
 
+    def inc(self, name: str, by: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        """Increment a cumulative counter family (name the family
+        ``*_total`` — counters render with TYPE counter and the
+        exposition validator rejects any other naming)."""
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + float(by)
+
     # --------------------------------------------------------------- reads
 
     def histogram_families(self) -> List[str]:
@@ -392,12 +429,17 @@ class MetricsHub:
     def snapshot(self) -> Dict[str, object]:
         """Point-in-time copy for the tsdb scraper (names UNprefixed, as
         stored): ``{"gauges": {name: [(labels, value), ...]},
-        "histograms": {name: [(labels, [(le, cumulative_count), ...
-        (+Inf, total)], sum, count), ...]}}``."""
+        "counters": same shape (cumulative values — tsdb ``increase()``
+        is exact over them), "histograms": {name: [(labels,
+        [(le, cumulative_count), ... (+Inf, total)], sum, count),
+        ...]}}``."""
         with self._lock:
             gauges = {name: [(dict(key), value)
                              for key, value in series.items()]
                       for name, series in self._gauges.items()}
+            counters = {name: [(dict(key), value)
+                               for key, value in series.items()]
+                        for name, series in self._counters.items()}
             hists: Dict[str, list] = {}
             for name, hist in self._hists.items():
                 fam = []
@@ -411,7 +453,8 @@ class MetricsHub:
                     cum.append((float("inf"), cumulative))
                     fam.append((dict(key), cum, total, cumulative))
                 hists[name] = fam
-        return {"gauges": gauges, "histograms": hists}
+        return {"gauges": gauges, "counters": counters,
+                "histograms": hists}
 
     def get_histogram(self, name: str) -> Optional[_Histogram]:
         with self._lock:
@@ -421,17 +464,21 @@ class MetricsHub:
         """Text exposition of every family, name-sorted, HELP/TYPE once per
         family (the format forbids repeating them)."""
         with self._lock:
-            names = sorted(set(self._hists) | set(self._gauges))
+            names = sorted(set(self._hists) | set(self._gauges)
+                           | set(self._counters))
             lines: List[str] = []
             for name in names:
                 full = f"{prefix}_{name}" if prefix else name
                 if name in self._hists:
                     lines.extend(self._hists[name].render(full))
-                else:
-                    lines.append(f"# HELP {full} {help_for(full)}")
-                    lines.append(f"# TYPE {full} gauge")
-                    for key in sorted(self._gauges[name]):
-                        value = self._gauges[name][key]
-                        lines.append(f"{full}{_label_str(dict(key))} "
-                                     f"{_fmt_float(value)}")
+                    continue
+                series = (self._counters.get(name)
+                          if name in self._counters
+                          else self._gauges[name])
+                mtype = "counter" if name in self._counters else "gauge"
+                lines.append(f"# HELP {full} {help_for(full)}")
+                lines.append(f"# TYPE {full} {mtype}")
+                for key in sorted(series):
+                    lines.append(f"{full}{_label_str(dict(key))} "
+                                 f"{_fmt_float(series[key])}")
         return "\n".join(lines) + "\n" if lines else ""

@@ -185,7 +185,10 @@ class TimeSeriesStore:
         t = self._clock.wall()
         if hub is not None:
             snap = hub.snapshot()
-            for name, entries in snap["gauges"].items():
+            # counters are cumulative like gauges on the wire; increase()
+            # over kept samples stays exact for both
+            for name, entries in list(snap["gauges"].items()) + list(
+                    snap.get("counters", {}).items()):
                 full = f"{prefix}_{name}" if prefix else name
                 for labels, value in entries:
                     self.record(full, labels, value, t=t)

@@ -29,6 +29,7 @@ from ..health.monitor import (FleetHealthMonitor, HealthOptions,
                               HealthReport)
 from ..obs.alerts import AlertManager
 from ..obs.journey import StuckNodeDetector
+from ..obs.metrics import API_LATENCY_BUCKETS
 from ..obs.slo import SLOEngine, SLOOptions
 from ..obs.tsdb import TimeSeriesStore
 from ..upgrade import metrics as upgrade_metrics
@@ -283,7 +284,21 @@ class TPUOperator:
             if len(entries) > 1 or entries[0][0]:
                 extra[full] = entries + [
                     ({}, max(value for _, value in entries))]
+        # observability overhead is itself observable: time the scrape on
+        # the injected clock and publish the tsdb's series accounting, so
+        # fleetbench can assert scrape cost stays sub-tick at 10k nodes
+        scrape_t0 = self.clock.now()
         self.tsdb.scrape(hub=self.metrics, extra_gauges=extra)
+        if self.metrics is not None:
+            self.metrics.observe("obs_scrape_duration_seconds",
+                                 max(0.0, self.clock.now() - scrape_t0),
+                                 buckets=API_LATENCY_BUCKETS)
+            self.metrics.set_gauge("tsdb_series",
+                                   self.tsdb.series_count(),
+                                   labels={"state": "active"})
+            self.metrics.set_gauge("tsdb_series",
+                                   self.tsdb.dropped_series,
+                                   labels={"state": "evicted"})
         self.last_slo = self.slo_engine.evaluate()
         opts = self._slo_options
         self.alert_manager.evaluate(self.slo_engine.alert_conditions(

@@ -594,7 +594,8 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 # ------------------------------------- OBS003 (SLO catalog, mutated)
 
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
-              obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH]
+              obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH,
+              obs_check.PROFILE_PATH]
 
 
 def _obs3_root(tmp_path, mutate=None, skip=()):
@@ -719,6 +720,43 @@ def test_obs003_no_serving_package_skips_router_closure(tmp_path):
     of older passes, a stripped deployment) must not fire on its
     tpu_router_* HELP entries — the closure needs both sides present."""
     root = _obs3_root(tmp_path, skip={obs_check.ROUTER_METRICS_PATH})
+    assert obs_check.run_slo(root) == []
+
+
+def test_obs003_profile_family_without_help_fails(tmp_path):
+    """A new flight-recorder family in obs/profile.py's emitted tables
+    with no HELP_TEXTS entry would render with the fallback HELP."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.PROFILE_PATH: lambda s: s.replace(
+            '    "tpu_operator_apiserver_requests_total",',
+            '    "tpu_operator_apiserver_requests_total",\n'
+            '    "tpu_operator_apiserver_retries_total",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "tpu_operator_apiserver_retries_total" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_stale_profile_help_entry_fails(tmp_path):
+    """A tpu_operator_apiserver_*/tsdb_*/obs_scrape_* HELP entry nothing
+    emits is a renamed or removed flight-recorder metric seen from the
+    catalog side."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_operator_tsdb_series":',
+            '    "tpu_operator_tsdb_ghost": "phantom tsdb gauge",\n'
+            '    "tpu_operator_tsdb_series":')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_operator_tsdb_ghost" in msgs
+    assert "no emitted" in msgs and "PROFILE_*_FAMILIES" in msgs
+
+
+def test_obs003_no_profile_module_skips_flight_recorder_closure(tmp_path):
+    """Without obs/profile.py the flight-recorder closure is skipped
+    entirely (like the router closure without a serving package)."""
+    root = _obs3_root(tmp_path, skip={obs_check.PROFILE_PATH})
     assert obs_check.run_slo(root) == []
 
 

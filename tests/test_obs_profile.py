@@ -1,0 +1,582 @@
+"""obs/profile.py + core CountingClient — the tick flight recorder.
+
+Acceptance bars pinned here:
+- a profiled tick DECOMPOSES: per-handler self-times + attributed
+  apiserver time sum to within 5% of the tick's
+  ``reconcile_tick_duration`` sample;
+- ``cmd/status.py --profile`` renders the critical path;
+- profiling is honest: the chaos campaign behaves IDENTICALLY (journeys,
+  invariants, router stats) with the profiler on and off on the same
+  seed, and the same seed yields the same profile twice;
+- CountingClient is transparent accounting: wraps ChaosClient cleanly,
+  lease ops are counted but never delayed;
+- the journey size guard truncates oldest-first with a durable
+  ``truncated`` marker that stuck detection and --timeline survive.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
+                                                DriverUpgradePolicySpec)
+from k8s_operator_libs_tpu.chaos.campaign import run_scenario
+from k8s_operator_libs_tpu.chaos.injector import ChaosInjector
+from k8s_operator_libs_tpu.chaos.scenario import parse_scenario
+from k8s_operator_libs_tpu.core.client import method_verb_kind
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.obs.journey import (MAX_JOURNEY_BYTES,
+                                               JourneyRecorder,
+                                               StuckNodeDetector,
+                                               dump_journey,
+                                               parse_journey,
+                                               parse_journey_full)
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.obs.profile import (TickProfiler, build_profile,
+                                               counting_client)
+from k8s_operator_libs_tpu.obs.trace import ListSink, Tracer
+from k8s_operator_libs_tpu.tpu.operator import (ManagedComponent,
+                                                TPUOperator)
+from k8s_operator_libs_tpu.tpu.topology import (GKE_ACCELERATOR_LABEL,
+                                                GKE_NODEPOOL_LABEL,
+                                                GKE_TOPOLOGY_LABEL)
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+NS = "kube-system"
+
+
+def _load_cmd(name):
+    """cmd/ is a plain directory of entry points, not a package — load a
+    binary by file path like the other CLI tests do."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_cli_profile",
+        os.path.join(os.path.dirname(__file__), "..", "cmd", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------- CountingClient
+
+
+def test_method_verb_kind_table():
+    assert method_verb_kind("get_node") == ("get", "Node")
+    assert method_verb_kind("list_pods") == ("list", "Pod")
+    assert method_verb_kind("patch_node_metadata") == ("patch", "Node")
+    assert method_verb_kind("patch_node_unschedulable") == ("patch", "Node")
+    assert method_verb_kind("evict_pod") == ("evict", "Pod")
+    assert method_verb_kind("list_controller_revisions") == \
+        ("list", "ControllerRevision")
+    assert method_verb_kind("update_lease") == ("update", "Lease")
+    assert method_verb_kind("create_event") == ("create", "Event")
+    assert method_verb_kind("watch_nodes") == ("watch", "Node")
+    # client machinery is not an apiserver request
+    assert method_verb_kind("flush_cache") is None
+    assert method_verb_kind("set_event_hook") is None
+    assert method_verb_kind("start") is None
+
+
+def test_counting_client_counts_and_exposes_families():
+    clock = FakeClock(50.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n0")
+    hub = MetricsHub()
+    client = counting_client(cluster.client, metrics=hub, clock=clock)
+    client.get_node("n0")
+    client.get_node("n0")
+    client.list_nodes()
+    client.patch_node_metadata("n0", labels={"x": "y"})
+    assert client.counts() == {("get", "Node"): 2, ("list", "Node"): 1,
+                               ("patch", "Node"): 1}
+    assert client.total_calls() == 4
+    # direct() shares the tally
+    client.direct().get_node("n0")
+    assert client.counts()[("get", "Node")] == 3
+    text = hub.render()
+    assert "# TYPE tpu_operator_apiserver_requests_total counter" in text
+    assert ('tpu_operator_apiserver_requests_total'
+            '{kind="Node",verb="get"} 3') in text
+    assert ("# TYPE tpu_operator_apiserver_request_duration_seconds "
+            "histogram") in text
+
+
+def test_counting_client_attributes_calls_to_issuing_span():
+    clock = FakeClock(10.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n0")
+    tracer = Tracer(sink=ListSink(), clock=clock)
+    client = counting_client(cluster.client, tracer=tracer, clock=clock)
+    with tracer.span("reconcile-tick"):
+        with tracer.span("apply_state", component="libtpu") as span:
+            client.get_node("n0")
+            client.get_node("n0")
+            client.list_pods()
+    assert span.attrs["api_calls"] == {"get Node": 2, "list Pod": 1}
+    assert span.attrs["api_time_s"] >= 0.0
+    # outside any span: counted, no attribution crash
+    client.get_node("n0")
+    assert client.counts()[("get", "Node")] == 3
+
+
+def test_counting_client_wraps_chaos_client_lease_ops_counted_not_delayed():
+    """Transparency over the chaos boundary: lease traffic (exempt from
+    chaos latency by design) is COUNTED by the accounting layer but
+    never delayed by it, and a latency fault taxes a wrapped get_node
+    exactly as it would unwrapped."""
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n0")
+    injector = ChaosInjector(cluster, clock, seed=3, events=[])
+    wrapped = counting_client(injector.client("op-a"), clock=clock)
+    t0 = clock.now()
+    with pytest.raises(KeyError):
+        wrapped.get_lease(NS, "tpu-operator")
+    assert clock.now() == t0  # counted, zero added latency
+    assert wrapped.counts() == {("get", "Lease"): 1}
+    # identity and non-callable attrs pass through the double wrapper
+    assert wrapped.identity == "op-a"
+    assert wrapped.direct().get_node("n0").metadata.name == "n0"
+    assert wrapped.counts()[("get", "Node")] == 1
+
+
+# ----------------------------------------------------------- profiles
+
+
+def _spans_for_tick(clock, client=None):
+    sink = TickProfiler()
+    tracer = Tracer(sink=sink, clock=clock)
+    with tracer.span("reconcile-tick", components=1):
+        with tracer.span("apply_state", component="libtpu"):
+            with tracer.span("process_drain_nodes", component="libtpu"):
+                if client is not None:
+                    counting = counting_client(client, tracer=tracer,
+                                               clock=clock)
+                    counting.get_node("n0")
+                clock.advance(0.5)
+            clock.advance(0.25)
+        with tracer.span("placement"):
+            clock.advance(0.1)
+    return sink
+
+
+def test_profile_self_time_and_critical_path():
+    clock = FakeClock(100.0)
+    sink = _spans_for_tick(clock)
+    profile = sink.last()
+    assert profile is not None and sink.ticks_profiled == 1
+    assert profile["duration_s"] == pytest.approx(0.85)
+    by_handler = {e["handler"]: e for e in profile["entries"]}
+    assert by_handler["process_drain_nodes"]["self_s"] == pytest.approx(0.5)
+    assert by_handler["process_drain_nodes"]["state"] == "drain-required"
+    assert by_handler["apply_state"]["self_s"] == pytest.approx(0.25)
+    assert by_handler["placement"]["self_s"] == pytest.approx(0.1)
+    assert by_handler["reconcile-tick"]["self_s"] == pytest.approx(0.0)
+    # decomposition telescopes exactly under an injected clock
+    assert profile["self_total_s"] + profile["api_total_s"] == \
+        pytest.approx(profile["duration_s"])
+    # critical path descends through the max-duration child chain
+    assert [hop["name"] for hop in profile["critical_path"]] == \
+        ["reconcile-tick", "apply_state", "process_drain_nodes"]
+
+
+def test_profiler_ring_and_open_trace_bounds():
+    clock = FakeClock()
+    sink = TickProfiler(max_ticks=4, max_open_traces=3)
+    tracer = Tracer(sink=sink, clock=clock)
+    for _ in range(10):
+        with tracer.span("reconcile-tick"):
+            clock.advance(1.0)
+    assert sink.ticks_profiled == 10
+    assert len(sink.profiles()) == 4  # ring holds the last N only
+    # non-root-named traces are dropped on close, not profiled
+    with tracer.span("slo-tick"):
+        pass
+    assert sink.ticks_profiled == 10
+    assert not sink._open
+
+
+def test_profiler_tees_to_inner_sink():
+    clock = FakeClock()
+    inner = ListSink()
+    tracer = Tracer(sink=TickProfiler(inner=inner), clock=clock)
+    with tracer.span("reconcile-tick"):
+        with tracer.span("apply_state"):
+            pass
+    assert [r["name"] for r in inner.records] == ["apply_state",
+                                                  "reconcile-tick"]
+
+
+def _small_fleet(clock):
+    cluster = FakeCluster(clock=clock, cache_lag=0.1)
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels={"app": "libtpu"},
+                               revision_hash="v1")
+    for h in range(4):
+        cluster.add_node(f"pool-0-h{h}", labels={
+            GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            GKE_TOPOLOGY_LABEL: "4x4", GKE_NODEPOOL_LABEL: "pool-0"})
+        cluster.add_pod(f"drv-pool-0-h{h}", f"pool-0-h{h}", namespace=NS,
+                        owner_ds=ds, revision_hash="v1")
+    return cluster
+
+
+def _operator_with_profiler(cluster, clock):
+    hub = MetricsHub()
+    profiler = TickProfiler()
+    tracer = Tracer(sink=profiler, clock=clock)
+    client = counting_client(cluster.client, metrics=hub, tracer=tracer,
+                             clock=clock)
+    op = TPUOperator(
+        client,
+        components=[ManagedComponent(
+            name="libtpu", namespace=NS, driver_labels={"app": "libtpu"},
+            policy=DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=0,
+                max_unavailable="50%",
+                drain=DrainSpec(enable=True, force=True,
+                                timeout_second=60)))],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        metrics=hub, tracer=tracer)
+    return op, hub, profiler, client
+
+
+def _tick_duration_sum(hub):
+    hist = hub.get_histogram("reconcile_tick_duration_seconds")
+    return 0.0 if hist is None else sum(t for _, t in
+                                        hist.series.values())
+
+
+def test_profiled_tick_decomposes_within_5pct_of_tick_sample():
+    """ACCEPTANCE: per-handler self-times + attributed apiserver time sum
+    to within 5% of the tick's reconcile_tick_duration sample, on a real
+    operator reconcile doing real upgrade work."""
+    clock = FakeClock(2000.0)
+    cluster = _small_fleet(clock)
+    op, hub, profiler, client = _operator_with_profiler(cluster, clock)
+    op.reconcile()
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    for _ in range(3):
+        before = _tick_duration_sum(hub)
+        op.reconcile()
+        cluster.reconcile_daemonsets()
+        clock.advance(30.0)
+        sample = _tick_duration_sum(hub) - before
+        profile = profiler.last()
+        decomposed = profile["self_total_s"] + profile["api_total_s"]
+        assert sample > 0
+        assert abs(decomposed - sample) <= 0.05 * sample, \
+            (decomposed, sample)
+    # the upgrade work really was profiled with attributed calls
+    profile = profiler.last()
+    assert profile["api_call_count"] > 0
+    handlers = {e["handler"] for p in profiler.profiles()
+                for e in p["entries"]}
+    assert any(h.startswith("process_") for h in handlers)
+    assert client.total_calls() > 0
+
+
+def test_operator_scrape_self_metrics_present():
+    """Satellite: tsdb series gauge (active/evicted) + scrape-duration
+    self-metric appear once the SLO engine scrapes."""
+    from k8s_operator_libs_tpu.obs.slo import SLOOptions
+    clock = FakeClock(3000.0)
+    cluster = _small_fleet(clock)
+    hub = MetricsHub()
+    op = TPUOperator(
+        cluster.client,
+        components=[ManagedComponent(
+            name="libtpu", namespace=NS, driver_labels={"app": "libtpu"},
+            policy=DriverUpgradePolicySpec(auto_upgrade=True))],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        metrics=hub, slo=SLOOptions.from_dict({}))
+    op.reconcile()
+    text = hub.render()
+    assert 'tpu_operator_tsdb_series{state="active"}' in text
+    assert 'tpu_operator_tsdb_series{state="evicted"} 0' in text
+    assert "tpu_operator_obs_scrape_duration_seconds_count" in text
+    # the self-metrics land in the NEXT scrape, so the tsdb sees them too
+    op.reconcile()
+    assert op.tsdb.latest("tpu_operator_tsdb_series",
+                          {"state": "active"}) is not None
+
+
+# ------------------------------------------- campaign: honest profiling
+
+
+PROFILE_SCENARIO = {
+    "name": "profile-invariance",
+    "max_ticks": 200,
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 1},
+    "upgrade_at": 30.0,
+    "faults": [
+        {"type": "driver-crashloop", "at": 45.0, "duration": 90.0,
+         "slices": [1]},
+        {"type": "leader-loss", "at": 120.0},
+    ],
+}
+
+
+def _journey_capture(store):
+    def hook(cluster=None, keys=None, tick=None, **kw):
+        snap = {}
+        for n in cluster.client.direct().list_nodes():
+            raw = n.metadata.annotations.get(keys.journey_annotation)
+            if raw:
+                snap[n.metadata.name] = raw
+        store.append(snap)
+    return hook
+
+
+def test_campaign_identical_with_profiler_on_and_off(tmp_path):
+    """ACCEPTANCE: profiling is free when idle and honest when on — the
+    same seed converges identically (per-tick journey annotations,
+    violations, router stats, failovers) with the flight recorder wired
+    in and without it."""
+    sc = parse_scenario(PROFILE_SCENARIO)
+    journeys_off, journeys_on = [], []
+    off = run_scenario(sc, seed=7, workdir=str(tmp_path / "off"),
+                       hooks=[_journey_capture(journeys_off)])
+    on = run_scenario(sc, seed=7, workdir=str(tmp_path / "on"),
+                      hooks=[_journey_capture(journeys_on)],
+                      profile=True)
+    assert off.violations == [] and on.violations == []
+    assert off.converged and on.converged
+    assert (off.ticks, off.failovers, off.modelled_s) == \
+        (on.ticks, on.failovers, on.modelled_s)
+    assert off.trace == on.trace
+    assert off.router_stats == on.router_stats
+    assert journeys_off == journeys_on
+    assert off.profile_payloads is None
+    assert on.profile_payloads is not None
+    assert sum(p["ticks_profiled"]
+               for p in on.profile_payloads.values()) > 0
+
+
+def test_campaign_profile_deterministic_per_seed(tmp_path):
+    """Same seed → byte-identical flight-recorder payloads (FakeClock
+    timings included) across two runs."""
+    sc = parse_scenario(PROFILE_SCENARIO)
+    r1 = run_scenario(sc, seed=9, workdir=str(tmp_path / "a"),
+                      profile=True)
+    r2 = run_scenario(sc, seed=9, workdir=str(tmp_path / "b"),
+                      profile=True)
+    assert json.dumps(r1.profile_payloads, sort_keys=True) == \
+        json.dumps(r2.profile_payloads, sort_keys=True)
+
+
+# ------------------------------------------------- journey size guard
+
+
+def test_journey_truncates_oldest_with_marker():
+    clock = FakeClock(100.0)
+    recorder = JourneyRecorder("libtpu", "j", "s", clock=clock,
+                               max_entries=4)
+
+    class Node:
+        class metadata:
+            name = "n0"
+            labels = {}
+            annotations = {}
+
+    node = Node()
+    raw = None
+    states = ["a", "b", "c", "d", "e", "f"]
+    for i, state in enumerate(states):
+        node.metadata.annotations = {"j": raw} if raw else {}
+        prev = states[i - 1] if i else ""
+        updates = recorder.record(node, prev, state)
+        raw = updates["j"]
+        clock.advance(10.0)
+    entries, truncated = parse_journey_full(raw)
+    assert [s for s, _ in entries] == ["c", "d", "e", "f"]
+    assert truncated == 2
+    assert json.loads(raw)["truncated"] == 2
+    # legacy list form is kept verbatim until the cap binds
+    assert dump_journey([("a", 1.0)]) == '[["a",1.0]]'
+    assert parse_journey(dump_journey([("a", 1.0)])) == [("a", 1.0)]
+
+
+def test_journey_byte_cap_binds_and_tail_survives():
+    clock = FakeClock(100.0)
+    recorder = JourneyRecorder("libtpu", "j", "s", clock=clock,
+                               max_entries=1000, max_bytes=220)
+
+    class Node:
+        class metadata:
+            name = "n0"
+            labels = {}
+            annotations = {}
+
+    node = Node()
+    raw = None
+    for i in range(40):
+        node.metadata.annotations = {"j": raw} if raw else {}
+        updates = recorder.record(node, f"state-{i - 1}", f"state-{i}")
+        raw = updates["j"]
+        clock.advance(5.0)
+        assert len(raw) <= 220
+    entries, truncated = parse_journey_full(raw)
+    assert truncated > 0 and entries
+    assert entries[-1][0] == "state-39"  # the tail is never clipped
+    assert truncated + len(entries) == 40
+    # default cap is comfortably under the k8s annotation budget
+    assert MAX_JOURNEY_BYTES <= 64 * 1024
+
+
+def test_stuck_detection_works_on_truncated_journey():
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock)
+    keys = KeyFactory("libtpu")
+    # a journey long since truncated, tail dwelling in cordon-required
+    entries = [["drain-required", 400.0], ["cordon-required", 500.0]]
+    raw = json.dumps({"truncated": 37, "entries": entries})
+    cluster.add_node("n0", labels={keys.state_label: "cordon-required"},
+                     annotations={keys.journey_annotation: raw})
+    hub = MetricsHub()
+    detector = StuckNodeDetector(
+        cluster.client.direct(), component="libtpu",
+        state_label=keys.state_label,
+        annotation_key=keys.journey_annotation,
+        stuck_key=keys.stuck_reported_annotation,
+        recorder=cluster.recorder, clock=clock, metrics=hub)
+    result = detector.check(cluster.client.direct().list_nodes())
+    assert [(n, s) for n, s, _ in result["stuck"]] == \
+        [("n0", "cordon-required")]
+    assert len(result["reported"]) == 1
+
+
+def test_status_timeline_renders_truncation_marker(capsys):
+    status = _load_cmd("status")
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock)
+    keys = KeyFactory("libtpu")
+    raw = json.dumps({"truncated": 3, "entries": [
+        ["drain-required", 400.0], ["upgrade-done", 500.0]]})
+    cluster.add_node("n0", annotations={keys.journey_annotation: raw})
+    rc = status.main(["--component", "libtpu", "--timeline", "n0"],
+                     client=cluster.client.direct(), now=600.0)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "3 older entries truncated" in out
+    assert "upgrade-done" in out
+    rc = status.main(["--component", "libtpu", "--timeline", "n0",
+                      "--json"], client=cluster.client.direct(), now=600.0)
+    envelope = json.loads(capsys.readouterr().out)
+    assert envelope["kind"] == "timeline"
+    assert envelope["data"]["libtpu"]["truncated"] == 3
+
+
+# ------------------------------------------------ status.py --profile
+
+
+def _profile_envelope(profiler):
+    return {"kind": "profile", "data": profiler.payload()}
+
+
+def test_status_profile_renders_critical_path(capsys):
+    """ACCEPTANCE: cmd/status.py --profile renders the critical path of
+    the last profiled tick from the /profile envelope."""
+    status = _load_cmd("status")
+    clock = FakeClock(100.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n0")
+    sink = _spans_for_tick(clock, client=cluster.client)
+    envelope = _profile_envelope(sink)
+    ns = type("A", (), {"operator_url": "http://op:8080",
+                        "as_json": False})()
+    rc = status.run_profile_view(ns, fetch=lambda url, path: envelope)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert ("reconcile-tick" in out and "->" in out
+            and "process_drain_nodes[libtpu]" in out)
+    assert "CALLS" in out and "get Node x1" in out
+    # --json emits the envelope verbatim
+    ns.as_json = True
+    rc = status.run_profile_view(ns, fetch=lambda url, path: envelope)
+    assert json.loads(capsys.readouterr().out)["kind"] == "profile"
+
+
+def test_status_profile_unreachable_exits_2(capsys):
+    status = _load_cmd("status")
+
+    def boom(url, path):
+        raise OSError("connection refused")
+
+    ns = type("A", (), {"operator_url": "http://nowhere:1",
+                        "as_json": False})()
+    assert status.run_profile_view(ns, fetch=boom) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_metrics_server_profile_endpoint_envelope():
+    """The operator's metrics server serves /profile as a {kind, data}
+    envelope (404 with the profiler off, like /slo)."""
+    operator_mod = _load_cmd("operator")
+    server = operator_mod.MetricsServer(0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/profile", timeout=5)
+        assert err.value.code == 404
+        clock = FakeClock(10.0)
+        sink = _spans_for_tick(clock)
+        server.snapshot["profile"] = json.dumps(_profile_envelope(sink))
+        with urllib.request.urlopen(f"{base}/profile", timeout=5) as resp:
+            env = json.loads(resp.read().decode())
+        assert env["kind"] == "profile"
+        assert env["data"]["ticks_profiled"] == 1
+        assert env["data"]["last"][0]["critical_path"][0]["name"] == \
+            "reconcile-tick"
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------- fleetbench
+
+
+def test_fleetbench_smoke_tiny(tmp_path):
+    """The benchmark harness end to end at toy scale: artifact written,
+    every standing assertion holds, headline + attribution keys present."""
+    from tools import fleetbench
+    out = tmp_path / "fleet.json"
+    rc = fleetbench.main(["--nodes", "24", "--slices", "4",
+                          "--ticks", "3", "--warmup", "1",
+                          "--max-unavailable", "50%",
+                          "--out", str(out)])
+    assert rc == 0
+    artifact = json.loads(out.read_text())
+    assert artifact["config"]["nodes"] == 24
+    assert all(artifact["assertions"].values()), artifact["assertions"]
+    assert artifact["headline"]["apiserver_calls_per_tick_mean"] > 0
+    assert artifact["apiserver_calls_per_tick_mean_by_call"]
+    assert artifact["profile_last_tick"]["critical_path"]
+    assert artifact["journeys"]["with_journey"] > 0
+    assert artifact["tsdb"]["series_active"] > 0
+
+
+def test_build_profile_handles_empty_and_orphan_records():
+    assert build_profile([])["entries"] == []
+    # a record whose parent never closed (crashed thread) still profiles
+    orphan = [{"trace": 1, "span": 2, "parent": 99, "name": "x",
+               "start": 0.0, "duration_s": 1.0, "attrs": {},
+               "error": None}]
+    profile = build_profile(orphan)
+    assert profile["duration_s"] == 1.0
+    assert profile["critical_path"][0]["name"] == "x"
+
+
+def test_journey_invariant_accepts_marker_trim_rejects_reset():
+    """The chaos journey-continuity invariant accepts an oldest-entry
+    trim only when the durable truncation marker grew (or the journey
+    sits at the legacy entry cap) — a reset is still a reset."""
+    from k8s_operator_libs_tpu.chaos.invariants import JourneyInvariant
+    prev = [("a", 1.0), ("b", 2.0), ("c", 3.0)]
+    cur = [("b", 2.0), ("c", 3.0), ("d", 4.0)]
+    assert JourneyInvariant._extends(prev, cur, trimmed=True)
+    assert not JourneyInvariant._extends(prev, cur)
+    assert not JourneyInvariant._extends(prev, [("x", 9.0)], trimmed=True)

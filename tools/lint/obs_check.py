@@ -311,11 +311,13 @@ register(Check(name="obs-attribution", codes=ATTRIBUTION_CODES,
 # ------------------------------------------------ OBS003 (SLO/alerting)
 
 SLO_CODES = {
-    "OBS003": "SLO/alerting/router metric drift: an SLO spec references "
-              "an unregistered metric family, an emitted slo/alert/"
-              "router family has no HELP_TEXTS entry, or a "
-              "tpu_operator_slo_*/tpu_operator_alert_*/tpu_router_* "
-              "HELP entry matches no emitted family",
+    "OBS003": "SLO/alerting/router/flight-recorder metric drift: an SLO "
+              "spec references an unregistered metric family, an emitted "
+              "slo/alert/router/profile family has no HELP_TEXTS entry, "
+              "or a tpu_operator_slo_*/tpu_operator_alert_*/tpu_router_*/"
+              "tpu_operator_apiserver_*/tpu_operator_tsdb_*/"
+              "tpu_operator_obs_scrape_* HELP entry matches no emitted "
+              "family",
 }
 
 SLO_PATH = "k8s_operator_libs_tpu/obs/slo.py"
@@ -326,10 +328,17 @@ METRICS_PATH = "k8s_operator_libs_tpu/obs/metrics.py"
 # package — the router closure is then skipped entirely, like CHS001
 # with no chaos package
 ROUTER_METRICS_PATH = "k8s_operator_libs_tpu/serving/metrics.py"
+# the tick flight recorder's emitted-family tables (PROFILE_*_FAMILIES:
+# apiserver-call accounting + scrape self-metrics); same absent-package
+# skip rule
+PROFILE_PATH = "k8s_operator_libs_tpu/obs/profile.py"
 # HELP entries under these prefixes must correspond to families the
 # engine/alert manager actually emits (no stale catalog entries)
 SLO_FAMILY_PREFIXES = ("tpu_operator_slo_", "tpu_operator_alert_")
 ROUTER_FAMILY_PREFIX = "tpu_router_"
+PROFILE_FAMILY_PREFIXES = ("tpu_operator_apiserver_",
+                           "tpu_operator_tsdb_",
+                           "tpu_operator_obs_scrape_")
 
 
 def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
@@ -497,6 +506,38 @@ def run_slo(root) -> List[Finding]:
                      f"family in ROUTER_GAUGE_FAMILIES or "
                      f"ROUTER_HISTOGRAM_FAMILIES ({ROUTER_METRICS_PATH})"
                      f" (renamed or removed router metric?)"))
+
+    # flight recorder: the obs/profile.py emitted-family tables close
+    # over HELP_TEXTS both ways too (skipped when the checkout carries
+    # no profile module)
+    if index.exists(PROFILE_PATH):
+        profile_tree = index.tree(PROFILE_PATH)
+        profile_emitted: Dict[str, int] = {}
+        for table in ("PROFILE_HISTOGRAM_FAMILIES",
+                      "PROFILE_COUNTER_FAMILIES",
+                      "PROFILE_GAUGE_FAMILIES"):
+            fams, fams_line = _string_tuple(profile_tree, table)
+            if fams_line == 0:
+                findings.append(
+                    (PROFILE_PATH, 1, "OBS003",
+                     f"{table} table not found (parse drift?)"))
+                continue
+            profile_emitted.update(fams)
+        for family, lineno in sorted(profile_emitted.items()):
+            if family not in help_keys:
+                findings.append(
+                    (PROFILE_PATH, lineno, "OBS003",
+                     f"emitted flight-recorder family {family!r} has no "
+                     f"HELP_TEXTS entry ({METRICS_PATH})"))
+        for key, lineno in sorted(help_keys.items()):
+            if (key.startswith(PROFILE_FAMILY_PREFIXES)
+                    and key not in profile_emitted):
+                findings.append(
+                    (METRICS_PATH, lineno, "OBS003",
+                     f"HELP_TEXTS entry {key!r} matches no emitted "
+                     f"family in the PROFILE_*_FAMILIES tables "
+                     f"({PROFILE_PATH}) (renamed or removed "
+                     f"flight-recorder metric?)"))
     return findings
 
 
