@@ -12,7 +12,8 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-obs-profile test-chaos test-router test-race \
+  test-obs-slo test-obs-profile test-chaos test-router test-migration \
+  test-race \
   health-sim chaos race race-smoke fleetbench fleetbench-smoke lint \
   lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
   dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
@@ -63,6 +64,9 @@ test-chaos:  ## chaos harness + elastic training suites (docs/chaos.md)
 
 test-router:  ## serving router tier: affinity/backpressure/handoff units, autoscaler hysteresis + TTFT-burn scale-up, N=3 rolling-upgrade zero-loss e2e (docs/router.md)
 	$(PYTHON) -m pytest tests/test_router.py tests/test_serve_upgrade_e2e.py -q
+
+test-migration:  ## live KV migration: paged export/import parity (bf16 + int8 twins), batcher export_slot/adopt_slot token identity, router live migration + degraded fallback + stream integrity, cmd-tier SSE splice over real HTTP (docs/router.md "Live migration")
+	$(PYTHON) -m pytest tests/test_migration.py -q
 
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py

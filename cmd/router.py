@@ -20,7 +20,15 @@ HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
   weighted least-outstanding-work with queue-depth backpressure); a 503
   or connection error from a draining/dead replica retries the SAME
   request on the next-best peer (exactly-once holds: a 503 means "not
-  served here").
+  served here"). With ``"stream": true`` the response relays the
+  replica's SSE token stream with GLOBAL per-token sequence numbers —
+  and splices it invisibly across drains: on the replica's
+  ``{"draining"}`` notice the relay exports the request's KV state
+  (``POST /export``), adopts it on a peer (``POST /adopt``), reattaches
+  (``GET /stream``), and resumes from the last sequence number the
+  client acked; a dead replica or failed transfer falls back to
+  re-submitting and de-duplicating by sequence number. The client sees
+  one gapless stream either way.
 - ``POST /register``  {"id", "url", "node", "weight"?} → add a replica
   at runtime (the ``--replica`` flag seeds the registry at boot).
 - ``GET  /replicas``  → the registry view ``cmd/status.py --replicas``
@@ -61,6 +69,27 @@ def http_post_json(url, payload, timeout):
         method="POST")
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def http_open_sse(url, payload, timeout):
+    """Open an SSE response (POST with a JSON body when ``payload`` is
+    given, else GET) and return the live response object — the relay
+    iterates its ``data:`` lines as they arrive."""
+    if payload is None:
+        req = urllib.request.Request(url, method="GET")
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def sse_events(resp):
+    """Decode ``data: {...}`` lines off a live SSE response."""
+    for raw in resp:
+        line = raw.strip()
+        if line.startswith(b"data: "):
+            yield json.loads(line[len(b"data: "):])
 
 
 class HTTPRuntime:
@@ -118,7 +147,7 @@ class RouterFront:
     this front unchanged."""
 
     def __init__(self, pool, metrics=None, clock=None, queue_high=8.0,
-                 proxy_timeout=300.0, post_json=None):
+                 proxy_timeout=300.0, post_json=None, open_sse=None):
         from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
         from k8s_operator_libs_tpu.utils.clock import RealClock
         self.pool = pool
@@ -127,6 +156,7 @@ class RouterFront:
         self.queue_high = queue_high
         self.proxy_timeout = proxy_timeout
         self._post_json = post_json or http_post_json
+        self._open_sse = open_sse or http_open_sse
         self._prefix_tokens = PREFIX_KEY_TOKENS
         self.lock = threads.make_lock("router-front")
         self._session = {}
@@ -136,6 +166,9 @@ class RouterFront:
         self._routed = 0
         self._completed = 0
         self._rerouted = 0
+        self._migrations = 0
+        self._migration_attempts = 0
+        self._migration_fallbacks = 0
         self.drains = []
 
     # --------------------------------------------------------- placement
@@ -211,6 +244,175 @@ class RouterFront:
         with self.lock:
             return self._outstanding.get(replica.id, 0)
 
+    # ------------------------------------------------- streaming + splice
+
+    def generate_stream(self, tokens, max_new, session=None, emit=None):
+        """Relay a streamed generation with GLOBAL per-token sequence
+        numbers; ``emit(event)`` writes one SSE event to the client.
+        The relay makes upgrades invisible mid-stream: a replica's
+        ``{"draining"}`` notice triggers the live-migration splice
+        (:meth:`_splice` — export → adopt → reattach, resuming from the
+        last acked seq), while a dead connection or a failed splice
+        falls back to re-submitting the request and de-duplicating the
+        replayed tokens by sequence number (greedy decode is
+        deterministic, so the replay matches what the client already
+        saw). Returns the terminal HTTP status (200 after ``done``)."""
+        prefix_key = tuple(tokens[:self._prefix_tokens])
+        expected = 0                # next seq the client needs
+        tried = set()
+        source = None               # (replica, local rid) to reattach
+        while True:
+            if source is None:
+                replica = self._pick(session, prefix_key, tried)
+                if replica is None:
+                    emit({"error": "no admitting replica; retry later"})
+                    return 503
+                rid = None
+            else:
+                replica, rid = source
+                source = None
+            with self.lock:
+                self._outstanding[replica.id] = \
+                    self._outstanding.get(replica.id, 0) + 1
+                if session is not None:
+                    self._session[session] = replica.id
+                self._prefix[prefix_key] = replica.id
+            outcome = "lost"        # pessimistic: connection died
+            try:
+                base = replica.url.rstrip("/")
+                if rid is None:
+                    resp = self._open_sse(
+                        base + "/generate",
+                        {"tokens": tokens, "max_new": max_new,
+                         "stream": True}, self.proxy_timeout)
+                else:
+                    resp = self._open_sse(base + f"/stream?rid={rid}",
+                                          None, self.proxy_timeout)
+                try:
+                    for event in sse_events(resp):
+                        if "token" in event:
+                            # dedupe the replay of a fallback re-decode:
+                            # the client's stream is gapless and
+                            # duplicate-free no matter how we got here
+                            if event["seq"] >= expected:
+                                emit({"seq": expected,
+                                      "token": int(event["token"])})
+                                expected += 1
+                            continue
+                        if "rid" in event:
+                            rid = int(event["rid"])
+                            continue
+                        if event.get("draining") and rid is not None:
+                            spliced = self._splice(replica, rid,
+                                                   expected, emit)
+                            if spliced is not None:
+                                peer, new_rid, expected = spliced
+                                source = (peer, new_rid)
+                                outcome = "spliced"
+                            else:
+                                outcome = "fallback"
+                            break
+                        if event.get("detached"):
+                            outcome = "fallback"
+                            break
+                        if event.get("done"):
+                            emit({"done": True,
+                                  "tokens": event["tokens"]})
+                            with self.lock:
+                                self._routed += 1
+                                self._completed += 1
+                            return 200
+                        if "error" in event:
+                            emit(event)
+                            return 502
+                finally:
+                    resp.close()
+            except urllib.error.HTTPError as exc:
+                payload = _safe_json(exc)
+                if exc.code in (503, 404):
+                    # draining/gone: not served there — reroute
+                    with self.lock:
+                        self._rerouted += 1
+                    replica.stats.draining = True
+                    tried.add(replica.id)
+                    continue
+                emit(payload)
+                return exc.code
+            except Exception as exc:
+                logger.warning("stream source %s died mid-relay: %s",
+                               replica.id, exc)
+                replica.runtime.fail()
+                replica.failed = True
+                with self.lock:
+                    self._rerouted += 1
+                tried.add(replica.id)
+                continue
+            finally:
+                with self.lock:
+                    self._outstanding[replica.id] = max(
+                        0, self._outstanding.get(replica.id, 1) - 1)
+            if outcome == "spliced":
+                continue            # reattach on the adopting peer
+            # fallback (or source vanished): re-submit elsewhere, the
+            # seq dedupe above swallows the replay
+            with self.lock:
+                self._rerouted += 1
+            tried.add(replica.id)
+
+    def _splice(self, donor, rid, expected, emit):
+        """The live-migration hop: export the request's KV state from
+        the draining donor, adopt it on the least-loaded peer, emit any
+        catch-up tokens the donor decoded past the client's last acked
+        seq, and hand back ``(peer, new rid, new expected)``. None on
+        any failure — the caller's fallback re-submit takes over
+        (degraded: re-prefills from the prompt; never lost)."""
+        base = donor.url.rstrip("/")
+        try:
+            with self.lock:
+                self._migration_attempts += 1
+            env = self._post_json(base + "/export", {"rid": rid},
+                                  self.proxy_timeout)
+            payload = env["data"]
+        except Exception:
+            logger.warning("export of rid %s from %s failed; falling "
+                           "back to re-submit", rid, donor.id,
+                           exc_info=True)
+            with self.lock:
+                self._migration_fallbacks += 1
+            return None
+        tried = {donor.id}
+        for _ in range(3):
+            with self.lock:
+                peers = [r for r in self.pool.admitting()
+                         if r.id not in tried]
+            if not peers:
+                break
+            peer = min(peers, key=lambda r: (
+                (self._outstanding.get(r.id, 0) + r.stats.queue_depth)
+                / r.weight))
+            tried.add(peer.id)
+            try:
+                out = self._post_json(peer.url.rstrip("/") + "/adopt",
+                                      payload, self.proxy_timeout)
+                data = out["data"]
+            except Exception:
+                logger.warning("peer %s rejected adoption of rid %s",
+                               peer.id, rid, exc_info=True)
+                continue
+            generated = [int(t) for t in data["generated"]]
+            # catch-up: tokens the donor decoded after the last acked
+            # seq ride the adoption response, not the dead stream
+            for seq in range(expected, len(generated)):
+                emit({"seq": seq, "token": generated[seq]})
+            with self.lock:
+                self._migrations += 1
+            logger.info("live-migrated rid %s %s -> %s at seq %d",
+                        rid, donor.id, peer.id, len(generated))
+            return peer, int(data["rid"]), max(expected, len(generated))
+        with self.lock:
+            self._migration_fallbacks += 1
+        return None
+
     # ------------------------------------------------------- drain watch
 
     def drain_replica(self, replica, reason):
@@ -280,6 +482,11 @@ class RouterFront:
             self._metrics.set_gauge("requests_routed", self._routed)
             self._metrics.set_gauge("requests_completed", self._completed)
             self._metrics.set_gauge("requests_rerouted", self._rerouted)
+            self._metrics.set_gauge("migration_attempts",
+                                    self._migration_attempts)
+            self._metrics.set_gauge("migration_success", self._migrations)
+            self._metrics.set_gauge("migration_fallbacks",
+                                    self._migration_fallbacks)
 
 
 def _safe_json(exc):
@@ -367,8 +574,27 @@ def make_handler(front, pool, hub, autoscaler=None):
                 tokens = [int(t) for t in req["tokens"]]
                 max_new = int(req.get("max_new", 32))
                 session = req.get("session")
+                stream = bool(req.get("stream", False))
             except (KeyError, TypeError, ValueError) as exc:
                 self._json(400, {"error": f"bad request: {exc}"})
+                return
+            if stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+
+                def emit(event):
+                    self.wfile.write(b"data: "
+                                     + json.dumps(event).encode()
+                                     + b"\n\n")
+                    self.wfile.flush()
+
+                try:
+                    front.generate_stream(tokens, max_new,
+                                          session=session, emit=emit)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass    # client went away; nothing left to relay to
                 return
             code, body = front.generate(tokens, max_new, session=session)
             self._json(code, body)

@@ -15,6 +15,21 @@ HTTP surface (stdlib ThreadingHTTPServer, JSON):
 - ``POST /generate``  {"tokens": [int...], "max_new": N}
   → blocks until the request completes: {"tokens": [prompt+generated]}.
   Returns 503 once draining (clients reroute to a peer).
+  With ``"stream": true`` the response is SSE (text/event-stream): a
+  ``{"rid": N}`` header event, one ``{"seq": i, "token": t}`` event per
+  generated token (gapless per-request sequence numbers — what the
+  router's stream splice rides), a ``{"draining": true}`` notice the
+  moment this server begins draining (the relay's cue to live-migrate),
+  and a final ``{"done": true, "tokens": [...]}`` — or
+  ``{"detached": true}`` if the request was exported to a peer.
+- ``POST /export``    {"rid": N} → freeze one in-flight request at a
+  step boundary and return its migration payload (KV blocks + cursor,
+  ``models/paged.py`` wire encoding) — the request leaves this server.
+- ``POST /adopt``     payload → {"rid": new, "generated": [...]} —
+  restore a migrated request and keep decoding; its stream continues on
+  ``GET /stream?rid=``. 409 on rejection (version/geometry/no pages).
+- ``GET  /stream?rid=N`` → attach to a running request's SSE stream
+  (the router reattaches here after a migration).
 - ``POST /drain``     → stop admission, return {"handoff": [[rid,
   [tokens...], max_new], ...]} — the queue a peer replica adopts.
   In-flight requests still finish and their /generate calls return.
@@ -96,13 +111,18 @@ class ServingRuntime:
         self.lock = threads.make_lock("serve-runtime")
         self.results = {}
         self.events = {}
+        # rid -> list of SSE event dicts ({"seq", "token"} per token,
+        # plus drain notices); only requests submitted/adopted with
+        # streaming on are tracked here
+        self.streams = {}
+        self._stream_seq = {}
         self.draining = False
         self.failed = False
         self.handoff = None
         self._stop = threads.make_event("serve-stepper-stop")
         self.thread = threads.spawn("serve-stepper", self._loop)
 
-    def submit(self, tokens, max_new):
+    def submit(self, tokens, max_new, stream=False):
         import numpy as np
         with self.lock:
             if self.draining or self.failed:
@@ -110,15 +130,71 @@ class ServingRuntime:
             rid = self.srv.submit(np.asarray(tokens, np.int32), max_new)
             ev = threads.make_event(f"serve-result-{rid}")
             self.events[rid] = ev
+            if stream:
+                self.streams[rid] = []
+                self._stream_seq[rid] = 0
         return rid, ev
 
     def result(self, rid):
         with self.lock:
-            return self.results.pop(rid)
+            return self.results.pop(rid, None)
+
+    def export(self, rid):
+        """Freeze one in-flight request and return its migration payload
+        (KV arrays already wire-encoded). The request leaves this
+        server: its waiter unblocks with the detached signal and a peer
+        continues it via :meth:`adopt`. KeyError if ``rid`` is not
+        running here."""
+        from k8s_operator_libs_tpu.models.paged import encode_kv_payload
+        with self.lock:
+            payload = self.srv.export_slot(rid)
+            payload["kv"] = encode_kv_payload(payload["kv"])
+            self.results[rid] = None
+            ev = self.events.pop(rid, None)
+            if ev:
+                ev.set()
+        return payload
+
+    def adopt(self, obj):
+        """Restore a migration payload; the adopted request streams on
+        ``/stream?rid=``. Returns (rid, generated-so-far) or None while
+        draining/failed; adoption rejections raise (409 at the HTTP
+        surface)."""
+        from k8s_operator_libs_tpu.models.paged import decode_kv_payload
+        with self.lock:
+            if self.draining or self.failed:
+                return None
+            payload = dict(obj)
+            payload["kv"] = decode_kv_payload(payload["kv"])
+            rid = self.srv.adopt_slot(payload)
+            generated = [int(t) for t in payload["generated"]]
+            self.events[rid] = threads.make_event(f"serve-result-{rid}")
+            self.streams[rid] = []
+            # sequence numbers continue from the donor's splice point
+            self._stream_seq[rid] = len(generated)
+        return rid, generated
+
+    def stream_state(self, rid):
+        """(snapshot of the rid's SSE events, done?) — what the
+        streaming handlers poll; (None, False) for unknown rids. Done
+        means the terminal result (tokens, or None = detached) is
+        waiting in :meth:`result`."""
+        with self.lock:
+            buf = self.streams.get(rid)
+            return (list(buf) if buf is not None else None,
+                    rid in self.results)
+
+    def stream_close(self, rid):
+        with self.lock:
+            self.streams.pop(rid, None)
+            self._stream_seq.pop(rid, None)
 
     def drain(self):
         """Stop admission; expose the untouched queue for a peer. The
-        stepper keeps running until in-flight requests finish."""
+        stepper keeps running until in-flight requests finish. Active
+        SSE streams get a ``{"draining": true}`` notice — the router
+        relay's cue to live-migrate the request to a peer instead of
+        racing the grace period."""
         with self.lock:
             if self.handoff is None:
                 self.draining = True
@@ -133,6 +209,8 @@ class ServingRuntime:
                     ev = self.events.pop(rid, None)
                     if ev:
                         ev.set()
+                for buf in self.streams.values():
+                    buf.append({"draining": True})
             return self.handoff
 
     def idle(self):
@@ -178,6 +256,17 @@ class ServingRuntime:
                 with self.lock:
                     if not self.srv.idle:
                         self.srv.step(self.chunk)
+                        if self.streams:
+                            for rid, toks in self.srv.poll_stream().items():
+                                buf = self.streams.get(rid)
+                                if buf is None:
+                                    continue
+                                seq = self._stream_seq.get(rid, 0)
+                                for tok in toks:
+                                    buf.append({"seq": seq,
+                                                "token": int(tok)})
+                                    seq += 1
+                                self._stream_seq[rid] = seq
                         for rid, toks in self.srv.poll().items():
                             self.results[rid] = [int(t) for t in toks]
                             ev = self.events.pop(rid, None)
@@ -217,7 +306,63 @@ def make_handler(rt: ServingRuntime):
             self.end_headers()
             self.wfile.write(body)
 
+        def _sse_open(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+        def _sse(self, obj):
+            self.wfile.write(b"data: " + json.dumps(obj).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
+        def _sse_pump(self, rid, poll_sleep=0.01):
+            """Relay one request's SSE events until its terminal state:
+            {"done", "tokens"} on completion here, {"detached"} when it
+            was exported to (or drained past) a peer. A vanished client
+            just ends the pump — the drain path logs the rid as
+            undelivered."""
+            import time
+            try:
+                self._sse({"rid": rid})
+                sent = 0
+                while True:
+                    buf, done = rt.stream_state(rid)
+                    if buf is None:
+                        return      # exported + already cleaned up
+                    for item in buf[sent:]:
+                        self._sse(item)
+                    sent = len(buf)
+                    if done:
+                        break
+                    time.sleep(poll_sleep)
+                final = rt.result(rid)
+                if final is None:
+                    self._sse({"detached": True})
+                else:
+                    self._sse({"done": True, "tokens": final})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                rt.stream_close(rid)
+
         def do_GET(self):
+            if self.path.startswith("/stream"):
+                from urllib.parse import parse_qs, urlparse
+                query = parse_qs(urlparse(self.path).query)
+                try:
+                    rid = int(query["rid"][0])
+                except (KeyError, ValueError, IndexError):
+                    self._json(400, {"error": "want /stream?rid=N"})
+                    return
+                buf, _done = rt.stream_state(rid)
+                if buf is None:
+                    self._json(404, {"error": f"no stream for rid {rid}"})
+                    return
+                self._sse_open()
+                self._sse_pump(rid)
+                return
             if self.path == "/healthz":
                 if rt.failed:
                     self._json(503, {"status": "failed"})
@@ -240,14 +385,51 @@ def make_handler(rt: ServingRuntime):
             if self.path == "/drain":
                 self._json(200, {"handoff": rt.drain()})
                 return
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/export", "/adopt"):
                 self._json(404, {"error": "not found"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
+            except (ValueError, TypeError) as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            if self.path == "/export":
+                try:
+                    rid = int(req["rid"])
+                except (ValueError, KeyError, TypeError) as exc:
+                    self._json(400, {"error": f"bad export: {exc}"})
+                    return
+                try:
+                    payload = rt.export(rid)
+                except KeyError:
+                    self._json(404, {"error": f"rid {rid} is not "
+                                              f"running here"})
+                    return
+                self._json(200, {"kind": "migration", "data": payload})
+                return
+            if self.path == "/adopt":
+                try:
+                    adopted = rt.adopt(req)
+                except (ValueError, KeyError, TypeError) as exc:
+                    # KVPayloadError is a ValueError: rejection, not a
+                    # server fault — the caller falls back to re-prefill
+                    self._json(409, {"error": f"adoption rejected: "
+                                              f"{exc}"})
+                    return
+                if adopted is None:
+                    self._json(503, {"error": "draining or failed; "
+                                              "adopt on a peer"})
+                    return
+                rid, generated = adopted
+                self._json(200, {"kind": "adopted",
+                                 "data": {"rid": rid,
+                                          "generated": generated}})
+                return
+            try:
                 tokens = [int(t) for t in req["tokens"]]
                 max_new = int(req.get("max_new", 32))
+                stream = bool(req.get("stream", False))
             except (ValueError, KeyError, TypeError) as exc:
                 # TypeError covers null/non-list bodies — every
                 # malformed request must get a JSON 400, not a dropped
@@ -255,7 +437,7 @@ def make_handler(rt: ServingRuntime):
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
             try:
-                sub = rt.submit(tokens, max_new)
+                sub = rt.submit(tokens, max_new, stream=stream)
             except (ValueError, TypeError) as exc:  # over capacity etc.
                 self._json(422, {"error": str(exc)})
                 return
@@ -264,6 +446,10 @@ def make_handler(rt: ServingRuntime):
                                           "to a peer"})
                 return
             rid, ev = sub
+            if stream:
+                self._sse_open()
+                self._sse_pump(rid)
+                return
             ev.wait()
             toks = rt.result(rid)
             if toks is None:    # drained/failed under us, never finished

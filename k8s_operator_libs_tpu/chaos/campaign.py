@@ -248,6 +248,10 @@ class ServingTier:
         self.pool.scrape_gate = self._scrape_gate
         self.router = RequestRouter(self.pool, metrics=self.metrics,
                                     clock=clock)
+        # live-migration transfer gate: the kv-transfer-flake fault
+        # fails payload transfers touching its target nodes, driving
+        # the router's bounded retry/backoff and the degraded fallback
+        self.router.transfer_gate = self._transfer_gate
         self.slice_nodes = [fleet.slice_hosts(s)[0]
                             for s in range(fleet.slices)]
         self.current: Dict[str, str] = {}
@@ -260,6 +264,12 @@ class ServingTier:
         if replica.node_name in self.injector.metrics_flake_nodes():
             raise ServerError("injected metrics-endpoint flake on "
                               + replica.node_name)
+
+    def _transfer_gate(self, donor, peer) -> None:
+        if self.injector.kv_transfer_flaky(donor.node_name,
+                                           peer.node_name):
+            raise ServerError(f"injected kv-transfer flake "
+                              f"{donor.node_name} -> {peer.node_name}")
 
     def _spawn(self, node: str) -> None:
         self._gen += 1
@@ -285,12 +295,22 @@ class ServingTier:
 
     def tick(self, active: bool) -> None:
         killed = self.injector.killed_replica_nodes()
+        # mid-stream-kill waits for the replica to hold streaming
+        # requests mid-generation before pulling the plug — the router
+        # must resume the in-flight streams on peers from the last
+        # acked sequence number (never lost, never duplicated)
+        ms_kill = self.injector.mid_stream_kill_nodes()
+        down = killed | ms_kill
         for node in self.slice_nodes:
             replica = self.pool.replicas.get(self.current.get(node, ""))
             if node in killed and replica is not None \
                     and replica.runtime.alive():
                 replica.runtime.fail()
-            if node not in killed and (
+            if node in ms_kill and replica is not None \
+                    and replica.runtime.alive() \
+                    and getattr(replica.runtime, "busy", False):
+                replica.runtime.fail()
+            if node not in down and (
                     replica is None or replica.failed
                     or replica.drained) and self._node_clean(node):
                 if replica is not None:
@@ -324,14 +344,22 @@ class ServingTier:
         return all(node in admitting for node in self.slice_nodes)
 
     def verify_results(self) -> List[str]:
-        """Token determinism across replicas/handoffs: every completed
-        request's tokens equal the sim model's deterministic decode."""
+        """Token determinism across replicas/handoffs/migrations: every
+        completed request's tokens equal the sim model's deterministic
+        decode, and its spliced client stream equals the result's
+        generated tail."""
         out = []
         for rid, req in self.router.requests.items():
-            if req.state == "completed" and req.tokens != sim_tokens(
-                    req.prompt, req.max_new):
+            if req.state != "completed":
+                continue
+            if req.tokens != sim_tokens(req.prompt, req.max_new):
                 out.append(f"request {rid} tokens diverged after "
                            f"{req.handoffs} handoff(s)")
+            tail = list(req.tokens[len(req.prompt):])
+            if req.stream and list(req.stream) != tail:
+                out.append(f"request {rid} spliced stream diverged "
+                           f"from its result after {req.migrations} "
+                           f"migration(s)")
         return out
 
 
@@ -470,6 +498,8 @@ def run_scenario(scenario: Scenario, seed: int,
             "rerouted": tier.router._rerouted,
             "drains": len(tier.router.drains),
             "generations": tier._gen,
+            "migrations": tier.router.migration_successes,
+            "migration_fallbacks": tier.router.migration_fallbacks,
         },
         profile_payloads={identity: p.payload()
                           for identity, p in profilers.items()} or None)

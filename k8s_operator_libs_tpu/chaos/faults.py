@@ -34,6 +34,16 @@ The catalog (docs/chaos.md has the full fault semantics):
                        target nodes stop answering (the router routes on
                        stale backpressure signals; admission legality
                        must hold anyway)
+``mid-stream-kill``    serving replicas on target nodes are killed the
+                       moment they hold STREAMING requests mid-
+                       generation (the in-flight streams must resume on
+                       peers from the last acked sequence number —
+                       gapless, duplicate-free, never lost)
+``kv-transfer-flake``  live-migration KV payload transfers touching
+                       target nodes fail at a seeded rate (the router's
+                       bounded retry/backoff must absorb the flake or
+                       fall back to degraded re-prefill — never a lost
+                       or corrupted stream)
 """
 
 from __future__ import annotations
@@ -57,6 +67,8 @@ FAULT_TYPES = (
     "spot-reclaim",
     "replica-kill",
     "metrics-flake",
+    "mid-stream-kill",
+    "kv-transfer-flake",
 )
 
 # Spot/preemption reclaim notice wire contract: the cloud (or the chaos

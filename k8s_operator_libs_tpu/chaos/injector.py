@@ -171,6 +171,27 @@ class ChaosInjector:
             out.update(ev.targets)
         return out
 
+    def mid_stream_kill_nodes(self) -> Set[str]:
+        """Nodes under an active mid-stream-kill window: the serving
+        tier kills the replica there the moment it holds streaming
+        requests mid-generation, and blocks respawn until the window
+        heals (the replica-kill twin aimed at in-flight streams)."""
+        out: Set[str] = set()
+        for ev in self._active("mid-stream-kill"):
+            out.update(ev.targets)
+        return out
+
+    def kv_transfer_flaky(self, donor_node: str, peer_node: str) -> bool:
+        """Should THIS live-migration KV transfer fail? True (at the
+        fault's seeded rate) while either endpoint's node sits in an
+        active kv-transfer-flake window — the router's transfer gate
+        raises on it and its bounded retry/backoff takes over."""
+        for ev in self._active("kv-transfer-flake"):
+            if donor_node in ev.targets or peer_node in ev.targets:
+                if self.rng.random() < float(ev.params.get("rate", 0.5)):
+                    return True
+        return False
+
     def quiet(self) -> bool:
         """True once every scheduled fault window has closed and every
         heal has run — the campaign requires this before convergence."""

@@ -42,6 +42,13 @@ The catalog (:data:`INVARIANT_NAMES`):
                       whose node is cordoned, quarantined, or
                       reclaim-tainted (checked against cluster truth at
                       the tick the placement was made).
+``router-stream-integrity``  per-request token sequence numbers are
+                      gapless and duplicate-free across live KV
+                      migrations, fallback re-prefills, and failovers —
+                      every completed streamed request's spliced stream
+                      equals its delivered result, and no replayed
+                      token ever differed from what the client already
+                      saw.
 
 :data:`FAULT_COVERAGE` maps every fault type to the invariants it
 stresses — CHS001 keeps it closed over ``FAULT_TYPES`` in both
@@ -69,6 +76,7 @@ INVARIANT_NAMES = (
     "attribution",
     "router-exactly-once",
     "router-admission",
+    "router-stream-integrity",
 )
 
 # fault type -> invariants that fault is designed to stress; CHS001
@@ -87,8 +95,12 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
     "eviction-storm": ("budget", "journey", "attribution"),
     "spot-reclaim": ("attribution", "event-dedup",
                      "router-exactly-once", "router-admission"),
-    "replica-kill": ("router-exactly-once",),
+    "replica-kill": ("router-exactly-once", "router-stream-integrity"),
     "metrics-flake": ("router-admission", "router-exactly-once"),
+    "mid-stream-kill": ("router-exactly-once",
+                        "router-stream-integrity"),
+    "kv-transfer-flake": ("router-stream-integrity",
+                          "router-exactly-once"),
 }
 
 # Legal pipeline edges (upgrade_state.py processing order + the failure
@@ -456,6 +468,63 @@ class RouterAdmissionInvariant(Invariant):
         return out
 
 
+class RouterStreamIntegrityInvariant(Invariant):
+    """Per-request token sequence numbers are gapless and duplicate-free
+    across live KV migrations, fallback re-prefills, and failovers. Three
+    checks, all over the router's append-only stream bookkeeping:
+
+    - the router recorded no splice-verification failure (a replayed
+      token after a fallback differing from what the client already saw);
+    - every request's stream_log sequence numbers are exactly
+      0..len-1 in order (an out-of-order/duplicate append is a gap or a
+      double-delivered token at the client);
+    - a COMPLETED streamed request's spliced stream equals its delivered
+      result's generated tail (the stream and the result are the same
+      truth seen two ways).
+
+    Stateful so each violation is reported once, at the tick it first
+    appears."""
+
+    name = "router-stream-integrity"
+
+    def __init__(self):
+        self._reported_violations = 0
+        self._checked_done: set = set()
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        router = view.router
+        if router is None:
+            return []
+        out: List[Violation] = []
+        fresh = router.stream_violations[self._reported_violations:]
+        self._reported_violations = len(router.stream_violations)
+        for msg in fresh:
+            out.append(self._v(view, f"splice verification failed: "
+                                     f"{msg}"))
+        for rid, req in router.requests.items():
+            if rid in self._checked_done:
+                continue
+            for i, (seq, replica_id) in enumerate(req.stream_log):
+                if seq != i:
+                    out.append(self._v(
+                        view, f"request {rid}: stream seq {seq} at "
+                        f"position {i} via {replica_id} (gap or "
+                        f"duplicate token at the client)"))
+                    break
+            if req.state == "completed":
+                self._checked_done.add(rid)
+                if req.tokens is None:
+                    continue
+                tail = [int(t) for t in req.tokens[len(req.prompt):]]
+                if req.stream and list(req.stream) != tail:
+                    out.append(self._v(
+                        view, f"request {rid}: spliced stream "
+                        f"({len(req.stream)} tokens) diverged from its "
+                        f"delivered result after {req.migrations} "
+                        f"migration(s)"))
+        return out
+
+
 def default_invariants() -> List[Invariant]:
     alerts = AlertTransitionInvariant()
     return [
@@ -467,4 +536,5 @@ def default_invariants() -> List[Invariant]:
         AttributionInvariant(),
         RouterExactlyOnceInvariant(),
         RouterAdmissionInvariant(),
+        RouterStreamIntegrityInvariant(),
     ]

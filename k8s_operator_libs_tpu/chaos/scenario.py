@@ -196,6 +196,26 @@ def _parse_metrics_flake(entry, fleet) -> FaultEvent:
     return FaultEvent("metrics-flake", targets=_targets(entry, fleet), **w)
 
 
+def _parse_mid_stream_kill(entry, fleet) -> FaultEvent:
+    # the window is the OUTAGE, like replica-kill — but the kill itself
+    # waits until the target replica holds streaming requests in flight
+    w = _window(entry, 120.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("mid-stream-kill: duration must be positive "
+                            "(a zero-length kill window kills nothing)")
+    return FaultEvent("mid-stream-kill", targets=_targets(entry, fleet),
+                      **w)
+
+
+def _parse_kv_transfer_flake(entry, fleet) -> FaultEvent:
+    w = _window(entry, 90.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("kv-transfer-flake: duration must be "
+                            "positive")
+    return FaultEvent("kv-transfer-flake", targets=_targets(entry, fleet),
+                      params={"rate": _rate(entry, default=0.5)}, **w)
+
+
 # fault type -> parser; CHS001 proves this dict's literal keys equal
 # FAULT_TYPES exactly (an unparseable fault type can never register)
 FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
@@ -210,6 +230,8 @@ FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
     "spot-reclaim": _parse_spot_reclaim,
     "replica-kill": _parse_replica_kill,
     "metrics-flake": _parse_metrics_flake,
+    "mid-stream-kill": _parse_mid_stream_kill,
+    "kv-transfer-flake": _parse_kv_transfer_flake,
 }
 
 
@@ -282,6 +304,15 @@ def random_scenario(seed: int) -> Scenario:
         elif ftype == "replica-kill":
             entry.update(duration=rng.choice([60.0, 120.0]),
                          slices=[rng.randrange(fleet["slices"])])
+        elif ftype == "mid-stream-kill":
+            entry.update(duration=rng.choice([60.0, 120.0]),
+                         slices=[rng.randrange(fleet["slices"])])
+        elif ftype == "kv-transfer-flake":
+            entry.update(duration=rng.choice([60.0, 120.0]),
+                         rate=rng.choice([0.3, 0.6]),
+                         slices=sorted(rng.sample(
+                             range(fleet["slices"]),
+                             k=rng.randint(1, fleet["slices"]))))
         elif ftype == "metrics-flake":
             entry.update(duration=rng.choice([60.0, 120.0]),
                          slices=sorted(rng.sample(

@@ -262,6 +262,22 @@ class HealthRemediator:
         for node in members:
             keep_cordon = (consts.PRE_QUARANTINE_CORDON_ANNOTATION
                            in node.metadata.annotations)
+            # crash-safe ordering: undo the taint and the cordon FIRST,
+            # remove the quarantine label LAST. The label is what makes
+            # process_healthy retry the lift — removing it first meant a
+            # failed uncordon (apiserver conflict, restart mid-lift)
+            # left the node cordoned forever with nothing left to retry
+            # (found by the chaos campaign's conflict-storm scenarios;
+            # pinned in tests/test_health.py). Every step is idempotent,
+            # so a partial lift simply re-runs next tick.
+            if any(t.key == consts.QUARANTINE_TAINT_KEY
+                   for t in node.spec.taints):
+                self._client.patch_node_taints(node.metadata.name, [
+                    {"$patch": "delete",
+                     "key": consts.QUARANTINE_TAINT_KEY}])
+            if not keep_cordon and node.spec.unschedulable:
+                self._client.patch_node_unschedulable(node.metadata.name,
+                                                      False)
             self._client.patch_node_metadata(
                 node.metadata.name,
                 labels={consts.QUARANTINE_LABEL: None},
@@ -273,14 +289,6 @@ class HealthRemediator:
                     # request behind to re-cordon the slice later
                     self._keys.upgrade_requested_annotation: None,
                 })
-            if any(t.key == consts.QUARANTINE_TAINT_KEY
-                   for t in node.spec.taints):
-                self._client.patch_node_taints(node.metadata.name, [
-                    {"$patch": "delete",
-                     "key": consts.QUARANTINE_TAINT_KEY}])
-            if not keep_cordon:
-                self._client.patch_node_unschedulable(node.metadata.name,
-                                                      False)
         ctx.actions.lifted_slices.append(sv.key)
         log_event(self._recorder, members[0], "Normal", EVENT_REASON,
                   f"Quarantine lifted on {sv.key}: healthy for "

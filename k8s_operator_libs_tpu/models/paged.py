@@ -436,6 +436,166 @@ def _paged_generate_impl(forward, params: Params, prompt: jax.Array,
                        top_k=top_k, top_p=top_p)
 
 
+# ------------------------------------------------------- KV migration
+#
+# Per-slot KV export/import: the data path that lets a serving tier move
+# ONE request's cache state between replicas (live migration across an
+# upgrade drain — docs/router.md "Live migration") and, later, between
+# disaggregated prefill and decode pools. The payload is a versioned
+# wire object: the used blocks of one sequence's table row (bf16/fp32
+# pools or the int8 twins WITH their scale pools), the block size, the
+# absolute start offset (a shared prefix is not exported — both ends
+# must already hold it), and the absolute valid length. Restoring into
+# free pages on a peer and continuing the decode is bit-identical to
+# never having moved: the gather/kernel paths read exactly the imported
+# rows, positions (RoPE) ride the restored lengths, and rows past
+# ``length`` are masked on both ends (tests/test_migration.py pins
+# bf16 + int8, ragged lengths, and donor-page recycling).
+
+KV_WIRE_VERSION = 1
+
+
+class KVPayloadError(ValueError):
+    """A KV wire payload cannot be produced or adopted here: version or
+    geometry mismatch, unaligned start, or not enough free pages. The
+    serving tier treats this as an adoption REJECTION and falls back to
+    re-prefill-from-prompt — slower, never lost."""
+
+
+def export_slot_kv(k_pool, v_pool, table_row, length: int, *,
+                   start: int = 0, k_scale=None, v_scale=None) -> dict:
+    """Serialize one sequence's used KV blocks into a versioned payload.
+
+    ``k_pool``/``v_pool`` are one replica's shared pools
+    ``[L, NB, BS, KV, Dh]`` (jax or numpy); ``table_row`` ``[MB]`` is the
+    sequence's block-table row; ``length`` its absolute valid length
+    (``cache.lengths[slot]``); ``start`` the absolute position where the
+    exported region begins (the aligned shared-prefix length — shared
+    blocks are NOT exported, the peer must already hold them). int8
+    twins pass the ``[L, NB, BS, KV]`` scale pools and the payload
+    carries them alongside."""
+    bs = int(k_pool.shape[2])
+    if start % bs:
+        raise KVPayloadError(f"start {start} not aligned to block size "
+                             f"{bs}")
+    first = start // bs
+    n = max(0, -(-int(length) // bs) - first)
+    row = np.asarray(table_row, np.int32)
+    if first + n > len(row):
+        raise KVPayloadError(f"length {length} spans {first + n} blocks "
+                             f"but the table row holds {len(row)}")
+    blocks = jnp.asarray(row[first:first + n])
+    k_b = np.asarray(jnp.take(jnp.asarray(k_pool), blocks, axis=1))
+    v_b = np.asarray(jnp.take(jnp.asarray(v_pool), blocks, axis=1))
+    payload = {
+        "version": KV_WIRE_VERSION,
+        "block_size": bs,
+        "start": int(start),
+        "length": int(length),
+        "quantized": k_scale is not None,
+        "dtype": str(k_b.dtype),
+        "k": k_b,
+        "v": v_b,
+    }
+    if k_scale is not None:
+        payload["k_scale"] = np.asarray(
+            jnp.take(jnp.asarray(k_scale), blocks, axis=1))
+        payload["v_scale"] = np.asarray(
+            jnp.take(jnp.asarray(v_scale), blocks, axis=1))
+    return payload
+
+
+def import_slot_kv(k_pool, v_pool, table_row, payload: dict, *,
+                   start: int = 0, k_scale=None, v_scale=None):
+    """Restore an :func:`export_slot_kv` payload into free pages behind
+    ``table_row`` on a peer replica. Returns ``(k_pool, v_pool, k_scale,
+    v_scale, length)`` — the updated pools (scales ``None`` when not
+    quantized) and the absolute valid length to set for the slot.
+    Raises :class:`KVPayloadError` on any mismatch the peer cannot
+    absorb (the adoption-rejection surface): wire version, block size,
+    start offset, quantization mode, pool dtype, or a table row too
+    short for the payload's blocks."""
+    version = payload.get("version")
+    if version != KV_WIRE_VERSION:
+        raise KVPayloadError(f"payload wire version {version!r}; this "
+                             f"replica speaks {KV_WIRE_VERSION}")
+    bs = int(k_pool.shape[2])
+    if int(payload["block_size"]) != bs:
+        raise KVPayloadError(f"payload block size {payload['block_size']}"
+                             f" != pool block size {bs}")
+    if int(payload["start"]) != int(start):
+        raise KVPayloadError(f"payload start {payload['start']} != this "
+                             f"replica's aligned prefix {start}")
+    quant = k_scale is not None
+    if bool(payload["quantized"]) != quant:
+        raise KVPayloadError(
+            f"payload is {'int8' if payload['quantized'] else 'plain'} "
+            f"but this pool is {'int8' if quant else 'plain'}")
+    k_pool = jnp.asarray(k_pool)
+    if str(payload["dtype"]) != str(k_pool.dtype):
+        raise KVPayloadError(f"payload dtype {payload['dtype']} != pool "
+                             f"dtype {k_pool.dtype}")
+    n = payload["k"].shape[1]
+    first = int(start) // bs
+    row = np.asarray(table_row, np.int32)
+    if first + n > len(row):
+        raise KVPayloadError(f"payload spans {n} blocks past position "
+                             f"{start} but the slot's table row holds "
+                             f"{len(row) - first} (no free pages)")
+    blocks = jnp.asarray(row[first:first + n])
+    k_pool = k_pool.at[:, blocks].set(jnp.asarray(payload["k"]))
+    v_pool = jnp.asarray(v_pool).at[:, blocks].set(
+        jnp.asarray(payload["v"]))
+    if quant:
+        k_scale = jnp.asarray(k_scale).at[:, blocks].set(
+            jnp.asarray(payload["k_scale"]))
+        v_scale = jnp.asarray(v_scale).at[:, blocks].set(
+            jnp.asarray(payload["v_scale"]))
+    return k_pool, v_pool, k_scale, v_scale, int(payload["length"])
+
+
+_ARRAY_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def encode_kv_payload(payload: dict) -> dict:
+    """JSON-safe wire form: each array becomes ``{"shape", "dtype",
+    "b64"}`` (raw little-endian bytes, base64). The inverse is
+    :func:`decode_kv_payload`; cmd/serve.py's ``/export``/``/adopt``
+    endpoints speak exactly this object."""
+    import base64
+    out = {key: val for key, val in payload.items()
+           if key not in _ARRAY_KEYS}
+    for key in _ARRAY_KEYS:
+        arr = payload.get(key)
+        if arr is None:
+            continue
+        arr = np.asarray(arr)
+        out[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                    "b64": base64.b64encode(arr.tobytes()).decode()}
+    return out
+
+
+def decode_kv_payload(obj: dict) -> dict:
+    import base64
+    out = {key: val for key, val in obj.items()
+           if key not in _ARRAY_KEYS}
+    for key in _ARRAY_KEYS:
+        enc = obj.get(key)
+        if enc is None:
+            continue
+        out[key] = np.frombuffer(
+            base64.b64decode(enc["b64"]),
+            dtype=np.dtype(enc["dtype"])).reshape(enc["shape"])
+    return out
+
+
+def kv_payload_nbytes(payload: dict) -> int:
+    """Transfer size of the payload's array data (the migration
+    transfer-bytes histogram's sample)."""
+    return sum(np.asarray(payload[key]).nbytes
+               for key in _ARRAY_KEYS if payload.get(key) is not None)
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "temperature",
                           "block_size", "top_k", "top_p", "kv_int8"))

@@ -45,7 +45,9 @@ import numpy as np
 
 from ..utils.clock import RealClock
 from .llama import LlamaConfig
-from .paged import DEFAULT_BLOCK_SIZE, PagedKVCache, _forward_paged
+from .paged import (DEFAULT_BLOCK_SIZE, KV_WIRE_VERSION, KVPayloadError,
+                    PagedKVCache, _forward_paged, export_slot_kv,
+                    import_slot_kv)
 
 Params = Dict[str, Any]
 
@@ -66,6 +68,8 @@ class _Request:
     slot: int = -1
     generated: Optional[List[int]] = None
     submit_t: float = 0.0       # monotonic clock at submit (telemetry)
+    streamed: int = 0           # generated tokens already handed to
+    #                             poll_stream (the client-visible cursor)
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -88,6 +92,10 @@ class ContinuousBatcher:
             srv.step()
         tokens = srv.poll()[rid]
     """
+
+    # KV migration wire version this replica speaks (mirrored onto the
+    # node by the serving registry so routers can pre-check adoptability)
+    payload_version = KV_WIRE_VERSION
 
     def __init__(self, params: Params, cfg: LlamaConfig, max_slots: int = 8,
                  capacity_per_slot: int = 512,
@@ -228,6 +236,12 @@ class ContinuousBatcher:
         self._next_rid = 0
         self._draining = False
         self._last_tok = np.zeros((max_slots,), np.int32)
+        # streaming: armed by the first poll_stream() call (a purely
+        # polled server must not accumulate tails forever); retired
+        # requests park their unstreamed tokens here until collected
+        self._streaming = False
+        self._stream_tail: Dict[int, List[int]] = {}
+        self._stream_emitted = 0
 
         self._metrics = metrics
         self._tracer = tracer
@@ -453,6 +467,151 @@ class ContinuousBatcher:
         each result is returned once."""
         out, self._done = self._done, {}
         return out
+
+    def poll_stream(self) -> Dict[int, List[int]]:
+        """Request id → tokens generated since the last call — the
+        per-token streaming surface (each token is returned exactly
+        once, in generation order, so a consumer numbering them by
+        arrival gets gapless per-request sequence numbers). Requests
+        that retired since the last call surface their final tail here
+        too; completion itself still signals through :meth:`poll`. The
+        first call arms streaming — before that, tails are not
+        retained (a purely polled server must not grow them forever)."""
+        self._streaming = True
+        out: Dict[int, List[int]] = {}
+        tails, self._stream_tail = self._stream_tail, {}
+        out.update(tails)
+        for rid, req in self._running.items():
+            n = len(req.generated) if req.generated else 0
+            if n > req.streamed:
+                out.setdefault(rid, []).extend(
+                    int(t) for t in req.generated[req.streamed:n])
+                req.streamed = n
+        if self._metrics is not None and out:
+            self._stream_emitted += sum(len(t) for t in out.values())
+            self._metrics.set_gauge("stream_emitted_tokens",
+                                    self._stream_emitted)
+            self._metrics.set_gauge(
+                "stream_backlog_tokens",
+                sum(len(r.generated or []) - r.streamed
+                    for r in self._running.values()))
+        return out
+
+    # ------------------------------------------------------ live migration
+
+    def export_slot(self, rid: int) -> dict:
+        """Quiesce one IN-FLIGHT request at the current step boundary
+        and serialize its full migration state: the KV payload
+        (:func:`~.paged.export_slot_kv` over the slot's table row), the
+        prompt, the tokens generated so far, the pending last token,
+        and the sampler state (greedy — deterministic, so the payload
+        needs no RNG). The request leaves this server (its slot and
+        blocks recycle immediately, like :meth:`_retire` without a
+        result) and a peer's :meth:`adopt_slot` continues it
+        token-identically. Raises ``KeyError`` for a request that is
+        not running here (queued requests move via :meth:`handoff`).
+
+        In draft (speculative) mode the draft pools are NOT exported —
+        the peer's draft cache starts cold for the slot, so acceptance
+        decays until the slot turns over, but outputs never change (the
+        target's verify pass is authoritative either way)."""
+        req = self._running.pop(rid)
+        s = req.slot
+        kv = export_slot_kv(self._k, self._v, self._table[s],
+                            int(self._lengths[s]),
+                            start=self._prefix_aligned)
+        payload = {
+            "version": KV_WIRE_VERSION,
+            "kind": "batcher",
+            "prompt": [int(t) for t in req.prompt],
+            "max_new": int(req.max_new),
+            "generated": [int(t) for t in (req.generated or [])],
+            "last_token": int(self._last_tok[s]),
+            "sampler": {"kind": "greedy"},
+            "kv": kv,
+        }
+        # the donor recycles the slot NOW — the exported pages are free
+        # for the next admission (tests pin that a recycled donor page
+        # cannot corrupt the migrated request on the peer)
+        self._free_blocks.extend(
+            int(b) for b in self._table[s, self._prefix_blocks:])
+        self._table[s, self._prefix_blocks:] = self._scratch
+        self._lengths[s] = self._prefix_aligned
+        self._free_slots.append(s)
+        self._stream_tail.pop(rid, None)
+        if self._metrics is not None:
+            self._metrics.set_gauge("serve_slots_busy", len(self._running))
+        return payload
+
+    def adopt_slot(self, payload: dict) -> int:
+        """Restore an :meth:`export_slot` payload into a free slot and
+        continue decoding exactly where the donor stopped. Returns the
+        NEW local request id (the caller maps it back to its own
+        bookkeeping). Raises :class:`~.paged.KVPayloadError` when this
+        replica cannot absorb the payload — wire-version/geometry/
+        shared-prefix mismatch, no free slot, or not enough capacity for
+        the remaining tokens — and ``RuntimeError`` while draining; the
+        serving tier treats every rejection as fall-back-to-re-prefill,
+        never a loss."""
+        if self._draining:
+            raise RuntimeError("server is draining; adopt on a peer")
+        if payload.get("version") != KV_WIRE_VERSION:
+            raise KVPayloadError(
+                f"payload wire version {payload.get('version')!r}; this "
+                f"replica speaks {KV_WIRE_VERSION}")
+        if payload.get("kind") != "batcher":
+            raise KVPayloadError(
+                f"payload kind {payload.get('kind')!r} is not adoptable "
+                f"by a batcher replica")
+        if payload.get("sampler", {}).get("kind") != "greedy":
+            raise KVPayloadError("only greedy sampler state is "
+                                 "adoptable at this wire version")
+        generated = [int(t) for t in payload["generated"]]
+        length = int(payload["kv"]["length"])
+        remaining = int(payload["max_new"]) - len(generated)
+        if (length - self._prefix_aligned) + remaining > self.capacity:
+            raise KVPayloadError(
+                f"{length - self._prefix_aligned} restored + {remaining}"
+                f" remaining tokens exceed slot capacity {self.capacity}")
+        if not self._free_slots:
+            raise KVPayloadError("no free slot to adopt into")
+        if len(self._free_blocks) < self.blocks_per_slot:
+            raise KVPayloadError("no free pages to adopt into")
+        slot = self._free_slots.pop(0)
+        blocks = [self._free_blocks.pop(0)
+                  for _ in range(self.blocks_per_slot)]
+        self._table[slot, self._prefix_blocks:] = np.asarray(blocks,
+                                                             np.int32)
+        try:
+            k, v, _, _, length = import_slot_kv(
+                self._k, self._v, self._table[slot], payload["kv"],
+                start=self._prefix_aligned)
+        except Exception:
+            # roll the allocation back — a rejected adoption must not
+            # leak the slot or its pages
+            self._free_blocks.extend(blocks)
+            self._table[slot, self._prefix_blocks:] = self._scratch
+            self._free_slots.append(slot)
+            raise
+        self._k, self._v = k, v
+        self._lengths[slot] = length
+        self._last_tok[slot] = int(payload["last_token"])
+        # an adopted request IS a streamed request: arm streaming now so
+        # a fast finisher's tail survives until the first poll_stream
+        self._streaming = True
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, np.asarray(payload["prompt"], np.int32),
+                       int(payload["max_new"]), slot=slot,
+                       submit_t=self._clock.now())
+        req.generated = generated
+        # the pre-migration tokens were already streamed by the donor;
+        # this server's stream starts at the splice point
+        req.streamed = len(generated)
+        self._running[rid] = req
+        self._submitted += 1
+        self._refresh_gauges()
+        return rid
 
     def step(self, n: int = 1) -> None:
         """Advance the server ``n`` decode ticks in ONE device call:
@@ -690,6 +849,12 @@ class ContinuousBatcher:
 
     def _retire(self, req: _Request) -> None:
         s = req.slot
+        if self._streaming and len(req.generated) > req.streamed:
+            # park the final tokens for the next poll_stream — retiring
+            # must never swallow the tail of an armed stream
+            self._stream_tail.setdefault(req.rid, []).extend(
+                int(t) for t in req.generated[req.streamed:])
+            req.streamed = len(req.generated)
         self._done[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)])
         self._completed += 1

@@ -226,6 +226,15 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_workload_build_info":
         "Constant 1; labels carry the workload binary's version and "
         "model",
+    # token-streaming families (models/serve.py poll_stream — the
+    # per-token surface cmd/serve.py's SSE endpoint and the router's
+    # stream splice consume)
+    "tpu_workload_stream_emitted_tokens":
+        "Tokens handed to streaming consumers via poll_stream since "
+        "process start (each token exactly once, in order)",
+    "tpu_workload_stream_backlog_tokens":
+        "Generated-but-not-yet-streamed tokens across running requests "
+        "at the last poll_stream (stream consumer staleness)",
     # router-tier families (serving/pool.py, serving/router.py,
     # serving/autoscaler.py, exposed by cmd/router.py under the
     # tpu_router prefix — a third disjoint namespace next to
@@ -266,6 +275,23 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_router_replica_queue_depth":
         "Scraped per-replica admission queue depth, sampled once per "
         "router scrape cycle",
+    # live-migration families (serving/router.py — docs/router.md
+    # "Live migration")
+    "tpu_router_migration_attempts":
+        "KV payload transfer attempts for in-flight live migrations "
+        "since router start (every retry counts once)",
+    "tpu_router_migration_success":
+        "In-flight requests successfully live-migrated to a peer "
+        "(adopted, stream resumed from the last acked sequence number)",
+    "tpu_router_migration_fallbacks":
+        "Migrations that exhausted the transfer budget or were rejected "
+        "by every peer and fell back to re-prefill-from-prompt at "
+        "degraded priority (slower, never lost)",
+    "tpu_router_migration_transfer_seconds":
+        "Seconds one successful KV payload transfer + adoption took "
+        "(per-request migration downtime contribution)",
+    "tpu_router_migration_transfer_bytes":
+        "Serialized KV payload bytes per successful migration transfer",
 }
 
 # ratio-valued histograms (occupancy, utilization) need sub-1.0 buckets —
@@ -276,6 +302,11 @@ RATIO_BUCKETS: Tuple[float, ...] = (
 # token-count histogram (generated tokens per request)
 TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# migration KV payload sizes: a tiny test config exports a few KiB, a
+# production 70B-class slot is hundreds of MiB — decade-ish ladder
+TRANSFER_BYTES_BUCKETS: Tuple[float, ...] = (
+    1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 4e9)
 
 # queue/handoff depth histograms (router tier: requests per handoff
 # batch, scraped per-replica queue depths) — small-count ladder starting

@@ -33,10 +33,15 @@ ROUTER_GAUGE_FAMILIES = (
     "tpu_router_scale_target",
     "tpu_router_scale_ups",
     "tpu_router_scale_downs",
+    "tpu_router_migration_attempts",
+    "tpu_router_migration_success",
+    "tpu_router_migration_fallbacks",
 )
 
 # histogram families (bucket ladders from obs/metrics.py)
 ROUTER_HISTOGRAM_FAMILIES = (
     "tpu_router_handoff_requests",
     "tpu_router_replica_queue_depth",
+    "tpu_router_migration_transfer_seconds",
+    "tpu_router_migration_transfer_bytes",
 )

@@ -19,6 +19,17 @@ Runtime adapter surface::
     alive() -> bool                                  (False once crashed)
     metrics_text() -> str                            (Prometheus text)
 
+Streaming + live-migration surface (optional — runtimes that carry it
+let the router stream tokens to clients and migrate IN-FLIGHT requests
+across a drain instead of finishing them on the drainer;
+``cmd/router.py``'s HTTP adapter does not, so it keeps the legacy
+finish-on-drainer behavior)::
+
+    poll_stream() -> {local rid: [new tokens]}       (each token once)
+    export_slot(local rid) -> payload                (quiesce + freeze)
+    adopt_slot(payload) -> new local rid             (restore + resume)
+    payload_version -> int                           (KV wire version)
+
 Health/backpressure signals are NOT trusted from the adapter object —
 :meth:`ReplicaPool.scrape` parses them out of the replica's OWN
 ``/metrics`` exposition text (``tpu_workload_serve_*`` families, the
@@ -43,9 +54,9 @@ from typing import Callable, Dict, List, Optional
 from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import Clock, RealClock
-from ..wire import (QUARANTINE_LABEL, RECLAIM_TAINT_KEY,
-                    REPLICA_ENDPOINT_ANNOTATION, REPLICA_ID_LABEL,
-                    REPLICA_WEIGHT_LABEL)
+from ..wire import (KV_PAYLOAD_VERSION_ANNOTATION, QUARANTINE_LABEL,
+                    RECLAIM_TAINT_KEY, REPLICA_ENDPOINT_ANNOTATION,
+                    REPLICA_ID_LABEL, REPLICA_WEIGHT_LABEL)
 
 logger = logging.getLogger(__name__)
 
@@ -186,14 +197,22 @@ class ReplicaPool:
         the id) a replica and mirror the registration onto its node."""
         self.replicas[replica.id] = replica
         if self._client is not None:
+            annotations = {}
+            if replica.url:
+                annotations[REPLICA_ENDPOINT_ANNOTATION] = replica.url
+            payload_version = getattr(replica.runtime, "payload_version",
+                                      None)
+            if payload_version is not None:
+                # adoptability pre-check for migrating routers: the KV
+                # wire version this replica speaks, in the cluster
+                annotations[KV_PAYLOAD_VERSION_ANNOTATION] = \
+                    str(int(payload_version))
             try:
                 self._client.patch_node_metadata(
                     replica.node_name,
                     labels={REPLICA_ID_LABEL: replica.id,
                             REPLICA_WEIGHT_LABEL: f"{replica.weight:g}"},
-                    annotations=(
-                        {REPLICA_ENDPOINT_ANNOTATION: replica.url}
-                        if replica.url else None))
+                    annotations=annotations or None)
             except Exception:
                 # in-memory registry stays authoritative; the mirror is
                 # observability, not a correctness dependency
@@ -210,7 +229,8 @@ class ReplicaPool:
                     replica.node_name,
                     labels={REPLICA_ID_LABEL: None,
                             REPLICA_WEIGHT_LABEL: None},
-                    annotations={REPLICA_ENDPOINT_ANNOTATION: None})
+                    annotations={REPLICA_ENDPOINT_ANNOTATION: None,
+                                 KV_PAYLOAD_VERSION_ANNOTATION: None})
             except Exception:
                 logger.warning("could not clear replica %s registration "
                                "from node %s", replica_id,
@@ -323,6 +343,11 @@ class BatcherRuntime:
             capacity_per_slot=capacity_per_slot, block_size=block_size,
             shared_prefix=shared_prefix, metrics=self.hub, clock=clock)
         self._failed = False
+        self.reject_adoptions = 0
+
+    @property
+    def payload_version(self) -> int:
+        return self.srv.payload_version
 
     def submit(self, prompt, max_new: int) -> int:
         return self.srv.submit(prompt, max_new)
@@ -331,6 +356,30 @@ class BatcherRuntime:
         if self._failed:
             return {}
         return self.srv.poll()
+
+    def poll_stream(self):
+        if self._failed:
+            return {}
+        return self.srv.poll_stream()
+
+    def export_slot(self, rid: int) -> dict:
+        if self._failed:
+            raise RuntimeError("runtime failed; nothing to export")
+        return self.srv.export_slot(rid)
+
+    def adopt_slot(self, payload: dict) -> int:
+        if self._failed:
+            raise RuntimeError("runtime failed; adopt on a peer")
+        if self.reject_adoptions > 0:
+            # e2e hook mirroring SimReplicaRuntime.reject_adoptions —
+            # forces the router's degraded re-prefill fallback
+            self.reject_adoptions -= 1
+            raise RuntimeError("adoption refused (forced rejection)")
+        return self.srv.adopt_slot(payload)
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.srv._running)
 
     def drain(self) -> None:
         self.srv.drain()

@@ -38,7 +38,7 @@ import logging
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from ..wire import DRAIN_INTENT_ANNOTATION
+from ..wire import DRAIN_INTENT_ANNOTATION, MIGRATION_INTENT_ANNOTATION
 from .pool import DRAIN_STATES, Replica, ReplicaPool
 
 logger = logging.getLogger(__name__)
@@ -46,6 +46,13 @@ logger = logging.getLogger(__name__)
 QUEUED = "queued"
 ASSIGNED = "assigned"
 COMPLETED = "completed"
+
+# placement priorities: a request re-prefilling from its prompt after a
+# failed migration runs `degraded` — it yields placement to normal
+# traffic (slower) but is never lost (the exactly-once ledger accounts
+# it in exactly one terminal state either way)
+NORMAL = "normal"
+DEGRADED = "degraded"
 
 # how many head tokens key the shared-prefix affinity map
 PREFIX_KEY_TOKENS = 16
@@ -66,6 +73,20 @@ class RouterRequest:
     submitted_t: float = 0.0
     completed_t: Optional[float] = None
     handoffs: int = 0          # times re-placed (drain or crash)
+    priority: str = NORMAL     # DEGRADED after a migration fallback
+    migrations: int = 0        # successful live KV migrations
+    # the client-visible token stream: stream[i] is the request's i-th
+    # generated token, appended exactly once (gapless, duplicate-free —
+    # the router-stream-integrity invariant); stream_log records the
+    # (seq, replica id) provenance of every append, so a spliced stream
+    # is auditable across migrations and failovers
+    stream: list = dataclasses.field(default_factory=list)
+    stream_log: list = dataclasses.field(default_factory=list)
+    # tokens a re-prefilling runtime will re-emit that the client has
+    # already seen: the splice point of the fallback path. The router
+    # swallows exactly this many incoming tokens (verifying each equals
+    # what was already streamed — greedy decode is deterministic)
+    replay_skip: int = 0
 
     @property
     def prefix_key(self) -> Tuple[int, ...]:
@@ -74,11 +95,24 @@ class RouterRequest:
 
 class RequestRouter:
     def __init__(self, pool: ReplicaPool, metrics=None,
-                 clock: Optional[Clock] = None, queue_high: float = 8.0):
+                 clock: Optional[Clock] = None, queue_high: float = 8.0,
+                 transfer_retries: int = 3,
+                 transfer_backoff_s: float = 0.25,
+                 transfer_backoff_cap_s: float = 2.0):
         self.pool = pool
         self._metrics = metrics
         self._clock = clock or RealClock()
         self.queue_high = float(queue_high)
+        # live-migration transfer budget: total adoption attempts per
+        # request across peers, with exponential backoff (clock-injected
+        # — the chaos campaign models multi-second backoffs for free)
+        self.transfer_retries = int(transfer_retries)
+        self.transfer_backoff_s = float(transfer_backoff_s)
+        self.transfer_backoff_cap_s = float(transfer_backoff_cap_s)
+        # chaos hook: fn(donor, peer) called before every KV transfer —
+        # raising models a failed/flaky payload transfer (the
+        # kv-transfer-flake fault plugs in here)
+        self.transfer_gate = None
         self.requests: Dict[int, RouterRequest] = {}
         self._next_rid = 0
         self._queue: List[int] = []                 # FIFO of queued rids
@@ -94,6 +128,13 @@ class RequestRouter:
         self.drains: List[Tuple[str, str, str, bool]] = []
         self._routed = 0
         self._rerouted = 0
+        self.migration_attempts = 0
+        self.migration_successes = 0
+        self.migration_fallbacks = 0
+        # splice-verification failures (a replayed token differing from
+        # what the client already saw) — surfaced by the
+        # router-stream-integrity invariant the tick they appear
+        self.stream_violations: List[str] = []
 
     # ------------------------------------------------------------ submit
 
@@ -117,6 +158,12 @@ class RequestRouter:
         req = self.requests[rid]
         return req.tokens if req.state == COMPLETED else None
 
+    def stream(self, rid: int) -> List[int]:
+        """The request's client-visible token stream so far —
+        ``stream[i]`` is generated token i, spliced gaplessly across any
+        migrations and failovers the request survived."""
+        return list(self.requests[rid].stream)
+
     @property
     def outstanding(self) -> int:
         return sum(1 for r in self.requests.values()
@@ -132,6 +179,7 @@ class RequestRouter:
         self.pool.scrape()
         self._watch_drains()
         self._collect_failures()
+        self._collect_streams()
         self._collect_completions()
         self._place_queued()
         self._mark_drained()
@@ -166,10 +214,18 @@ class RequestRouter:
                 self.drain_replica(replica, reason)
 
     def drain_replica(self, replica: Replica, reason: str) -> None:
-        """Stop admitting to ``replica``, persist the intent, and migrate
-        its untouched queue to peers. In-flight requests keep running on
-        the draining replica until they finish (collected by later
-        ticks); only never-admitted requests move."""
+        """Stop admitting to ``replica``, persist the intent, migrate
+        its untouched queue to peers, and LIVE-MIGRATE its in-flight
+        requests: each one's KV state is exported at a step boundary,
+        transferred to a chosen peer under the bounded retry/backoff
+        budget, and adopted there so its token stream resumes from the
+        last acked sequence number — the client never sees a disconnect
+        or a duplicated/skipped token (docs/router.md "Live migration").
+        A transfer that exhausts the budget, or a peer that rejects
+        adoption, falls back to re-prefill-from-prompt at ``degraded``
+        priority — slower, never lost. Runtimes without the migration
+        surface (the HTTP adapter) keep the legacy behavior: in-flight
+        requests finish on the drainer."""
         if replica.draining:
             return
         state = self.pool.node_states.get(replica.node_name)
@@ -209,6 +265,148 @@ class RequestRouter:
         logger.info("draining replica %s on %s (%s): %d queued requests "
                     "migrated to peers", replica.id, replica.node_name,
                     reason, migrated)
+        if not replica.failed:
+            self._migrate_in_flight(replica)
+
+    # ------------------------------------------------- live KV migration
+
+    def _assigned_to(self, replica: Replica) -> List[int]:
+        return [rid for rid, req in self.requests.items()
+                if req.state == ASSIGNED and req.replica_id == replica.id]
+
+    def _migrate_in_flight(self, replica: Replica) -> None:
+        """Move every in-flight request off a draining donor via KV
+        export/adopt. Streams were last collected on the previous tick —
+        export quiesces the slot at a step boundary, and the payload's
+        ``generated`` cursor carries any not-yet-collected tokens, so
+        :meth:`_collect_streams` resumes gaplessly on the peer."""
+        runtime = replica.runtime
+        if not hasattr(runtime, "export_slot"):
+            return      # legacy runtime: in-flight finishes on the drainer
+        rids = self._assigned_to(replica)
+        if not rids:
+            return
+        if self.pool.client is not None:
+            try:
+                self.pool.client.patch_node_metadata(
+                    replica.node_name, annotations={
+                        MIGRATION_INTENT_ANNOTATION:
+                            f"{len(rids)}@{self._clock.wall():.3f}"})
+            except Exception:
+                logger.warning("could not stamp migration intent on %s",
+                               replica.node_name, exc_info=True)
+        for rid in rids:
+            req = self.requests[rid]
+            # sync the client stream to the donor's cursor BEFORE the
+            # export freezes the slot (tokens decoded since last tick)
+            try:
+                self._drain_stream_of(replica, req)
+                payload = runtime.export_slot(req.local_rid)
+            except KeyError:
+                continue    # finished between the drain and the export
+            except Exception:
+                logger.exception("export of request %d from replica %s "
+                                 "failed; falling back to re-prefill",
+                                 rid, replica.id)
+                self._local2global.pop((replica.id, req.local_rid), None)
+                self._fallback(rid)
+                continue
+            self._local2global.pop((replica.id, req.local_rid), None)
+            if not self._transfer(rid, req, payload, donor=replica):
+                self._fallback(rid)
+
+    def _drain_stream_of(self, replica: Replica, req: RouterRequest
+                         ) -> None:
+        """Collect any tokens the donor generated for ``req`` since the
+        last tick, so the export's splice point equals the client's
+        acked sequence number."""
+        if not hasattr(replica.runtime, "poll_stream"):
+            return
+        for local_rid, toks in replica.runtime.poll_stream().items():
+            rid = self._local2global.get((replica.id, local_rid))
+            if rid is not None:
+                self._append_stream(self.requests[rid], toks, replica.id)
+
+    def _transfer(self, rid: int, req: RouterRequest, payload: dict,
+                  donor: Replica) -> bool:
+        """Bounded retry/backoff transfer of one migration payload to
+        the best adoptable peer. A raised :attr:`transfer_gate` (the
+        chaos kv-transfer-flake) is transient — the same peer may be
+        retried after backoff; a peer REJECTING adoption (version
+        mismatch, no free pages) is deterministic — that peer is
+        excluded. Returns True once a peer adopted."""
+        rejected = set()
+        attempts = 0
+        nbytes = _payload_nbytes(payload)
+        while attempts < self.transfer_retries:
+            peers = [r for r in self.pool.admitting()
+                     if r.id != donor.id and r.id not in rejected
+                     and hasattr(r.runtime, "adopt_slot")]
+            if not peers:
+                break
+            peer = min(peers, key=lambda r: (
+                (self._outstanding_on(r) + r.stats.queue_depth)
+                / r.weight))
+            attempts += 1
+            self.migration_attempts += 1
+            t0 = self._clock.now()
+            try:
+                if self.transfer_gate is not None:
+                    self.transfer_gate(donor, peer)
+            except Exception:
+                logger.warning(
+                    "KV transfer of request %d to %s failed (attempt "
+                    "%d/%d); backing off", rid, peer.id, attempts,
+                    self.transfer_retries)
+                self._backoff(attempts)
+                continue
+            try:
+                local = peer.runtime.adopt_slot(payload)
+            except Exception:
+                logger.warning(
+                    "peer %s rejected adoption of request %d; trying "
+                    "the next peer", peer.id, rid, exc_info=True)
+                rejected.add(peer.id)
+                self._backoff(attempts)
+                continue
+            req.replica_id = peer.id
+            req.local_rid = local
+            req.migrations += 1
+            self._local2global[(peer.id, local)] = rid
+            if req.session is not None:
+                self._session_map[req.session] = peer.id
+            self.migration_successes += 1
+            if self._metrics is not None:
+                self._metrics.observe(
+                    "migration_transfer_seconds",
+                    max(0.0, self._clock.now() - t0))
+                self._metrics.observe("migration_transfer_bytes", nbytes,
+                                      buckets=_transfer_buckets())
+            logger.info("migrated request %d (%d tokens in) %s -> %s",
+                        rid, len(req.stream), donor.id, peer.id)
+            return True
+        return False
+
+    def _backoff(self, attempt: int) -> None:
+        self._clock.sleep(min(self.transfer_backoff_cap_s,
+                              self.transfer_backoff_s
+                              * (2.0 ** (attempt - 1))))
+
+    def _fallback(self, rid: int) -> None:
+        """Migration exhausted its budget: the request re-prefills from
+        its prompt on whichever peer the queue places it, at DEGRADED
+        priority. The re-decode re-emits tokens the client already saw;
+        ``replay_skip`` makes :meth:`_collect_streams` swallow exactly
+        those (verifying each — greedy decode is deterministic), so the
+        client stream resumes from the last acked sequence number."""
+        req = self.requests[rid]
+        req.priority = DEGRADED
+        req.replay_skip = len(req.stream)
+        self.migration_fallbacks += 1
+        self._requeue(rid)
+        logger.warning("request %d falls back to re-prefill at degraded "
+                       "priority (%d tokens already streamed)", rid,
+                       len(req.stream))
 
     def _mark_drained(self) -> None:
         for replica in self.pool.live():
@@ -243,6 +441,9 @@ class RequestRouter:
                 if req.state == ASSIGNED and req.replica_id == replica.id:
                     self._local2global.pop((replica.id, req.local_rid),
                                            None)
+                    # the re-decode on a peer replays tokens the client
+                    # already saw — splice at the last acked seq number
+                    req.replay_skip = len(req.stream)
                     self._requeue(rid)
 
     def _requeue(self, rid: int) -> None:
@@ -253,6 +454,48 @@ class RequestRouter:
         req.handoffs += 1
         self._rerouted += 1
         self._queue.append(rid)
+
+    # --------------------------------------------------------- streaming
+
+    def _append_stream(self, req: RouterRequest, tokens, replica_id: str
+                       ) -> None:
+        """Splice newly generated tokens onto the request's client
+        stream. While ``replay_skip`` is positive the incoming tokens
+        re-play what the client already saw (a fallback re-prefill) —
+        each is verified against the streamed copy and swallowed, so
+        sequence numbers stay gapless and duplicate-free."""
+        for tok in tokens:
+            tok = int(tok)
+            if req.replay_skip > 0:
+                idx = len(req.stream) - req.replay_skip
+                if req.stream[idx] != tok:
+                    self.stream_violations.append(
+                        f"request {req.rid}: replayed token at seq {idx}"
+                        f" is {tok}, client already saw "
+                        f"{req.stream[idx]} (replica {replica_id})")
+                req.replay_skip -= 1
+                continue
+            req.stream_log.append((len(req.stream), replica_id))
+            req.stream.append(tok)
+
+    def _collect_streams(self) -> None:
+        """Pull every streaming runtime's new tokens and splice them
+        into the per-request client streams (sequence numbers = stream
+        indexes, gapless across migrations and failovers)."""
+        for replica in self.pool.replicas.values():
+            if replica.failed or not hasattr(replica.runtime,
+                                             "poll_stream"):
+                continue
+            try:
+                chunks = replica.runtime.poll_stream()
+            except Exception:
+                replica.failed = True
+                continue
+            for local_rid, toks in chunks.items():
+                rid = self._local2global.get((replica.id, local_rid))
+                if rid is None:
+                    continue
+                self._append_stream(self.requests[rid], toks, replica.id)
 
     # ------------------------------------------------------- completions
 
@@ -313,7 +556,11 @@ class RequestRouter:
 
     def _place_queued(self) -> None:
         remaining: List[int] = []
-        for rid in self._queue:
+        # degraded requests (migration fallbacks) yield placement to
+        # normal traffic: slower, never lost. Stable within a class.
+        ordered = sorted(self._queue, key=lambda r:
+                         self.requests[r].priority == DEGRADED)
+        for rid in ordered:
             req = self.requests[rid]
             if req.state != QUEUED:
                 continue        # completed/assigned through another path
@@ -365,6 +612,12 @@ class RequestRouter:
             sum(1 for r in self.requests.values()
                 if r.state == COMPLETED))
         self._metrics.set_gauge("requests_rerouted", self._rerouted)
+        self._metrics.set_gauge("migration_attempts",
+                                self.migration_attempts)
+        self._metrics.set_gauge("migration_success",
+                                self.migration_successes)
+        self._metrics.set_gauge("migration_fallbacks",
+                                self.migration_fallbacks)
 
     # --------------------------------------------------------- invariants
 
@@ -375,10 +628,23 @@ class RequestRouter:
         chaos campaign wires the same checks through
         ``chaos/invariants.py`` instead."""
         out: List[str] = []
+        out.extend(self.stream_violations)
         for rid, count in self.completed_counts.items():
             if count > 1:
                 out.append(f"request {rid} delivered {count} times "
                            f"(double-serve)")
+        for rid, req in self.requests.items():
+            for i, (seq, _replica) in enumerate(req.stream_log):
+                if seq != i:
+                    out.append(f"request {rid} stream seq {seq} at "
+                               f"position {i} (gap or duplicate)")
+                    break
+            if req.state == COMPLETED and req.tokens is not None:
+                tail = [int(t) for t in req.tokens[len(req.prompt):]]
+                if req.stream and req.stream != tail:
+                    out.append(f"request {rid} stream diverged from its "
+                               f"delivered result after "
+                               f"{req.migrations} migration(s)")
         for rid, req in self.requests.items():
             if req.state not in (QUEUED, ASSIGNED, COMPLETED):
                 out.append(f"request {rid} in unknown state {req.state!r}"
@@ -410,3 +676,20 @@ class RequestRouter:
 def _depth_buckets():
     from ..obs.metrics import QUEUE_DEPTH_BUCKETS
     return QUEUE_DEPTH_BUCKETS
+
+
+def _transfer_buckets():
+    from ..obs.metrics import TRANSFER_BYTES_BUCKETS
+    return TRANSFER_BYTES_BUCKETS
+
+
+def _payload_nbytes(payload: dict) -> int:
+    """Transfer size of a migration payload: the KV arrays for a
+    batcher payload (``models/paged.py::kv_payload_nbytes``), a
+    token-count proxy for the JAX-free sim payloads."""
+    kv = payload.get("kv")
+    if kv is not None:
+        from ..models.paged import kv_payload_nbytes
+        return kv_payload_nbytes(kv)
+    return 4 * (len(payload.get("generated", ()))
+                + len(payload.get("prompt", ())))

@@ -3,20 +3,28 @@
 The chaos campaign ticks the whole world thousands of modelled seconds
 per wall second; compiling a real batcher there would dominate the run
 and add nothing — the router's correctness properties (exactly-once,
-admission legality, drain handoff) are about BOOKKEEPING, not tokens.
+admission legality, drain handoff, stream integrity across live
+migration) are about BOOKKEEPING, not tokens.
 :class:`SimReplicaRuntime` implements the same adapter surface as
-:class:`~.pool.BatcherRuntime` (same drain/handoff semantics as
-``models/serve.py``, same ``tpu_workload_serve_*`` gauge names in its
-``/metrics`` text) with a pure-host model: a request with ``max_new``
-tokens completes after ``ceil(max_new / tokens_per_step)`` steps and its
+:class:`~.pool.BatcherRuntime` (same drain/handoff/stream/migration
+semantics as ``models/serve.py``, same ``tpu_workload_serve_*`` gauge
+names in its ``/metrics`` text) with a pure-host model: a request with
+``max_new`` tokens emits ``tokens_per_step`` tokens per step and its
 output is :func:`sim_tokens` — a deterministic function of the prompt,
-so "token-identical no matter which replica served it" stays checkable.
+so "token-identical no matter which replica served it" stays checkable
+even across a mid-generation KV migration (``export_slot`` /
+``adopt_slot`` move the generated-so-far cursor between replicas, the
+sim twin of the paged-block payload in ``models/paged.py``).
 """
 
 from __future__ import annotations
 
-import math
 from typing import Dict, List, Tuple
+
+# Sim migration payloads carry the same wire version the real KV payload
+# does (models/paged.py::KV_WIRE_VERSION) — spelled as a literal so this
+# module stays importable without JAX; test_migration.py pins equality.
+SIM_WIRE_VERSION = 1
 
 
 def sim_tokens(prompt, max_new: int) -> List[int]:
@@ -27,25 +35,40 @@ def sim_tokens(prompt, max_new: int) -> List[int]:
     return prompt + [(basis + 31 * i) % 32000 for i in range(max_new)]
 
 
+class AdoptError(ValueError):
+    """This replica rejects the migration payload (version mismatch, no
+    free slot, draining/failed, or a forced test rejection) — the router
+    falls back to re-prefill-from-prompt, never a loss."""
+
+
 class _SimRequest:
     def __init__(self, rid: int, prompt, max_new: int):
         self.rid = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
-        self.steps_left = 0
+        self.tail = sim_tokens(self.prompt, self.max_new)[len(self.prompt):]
+        self.generated: List[int] = []
+        self.streamed = 0
 
 
 class SimReplicaRuntime:
+    # mirrors ContinuousBatcher.payload_version (see module docstring)
+    payload_version = SIM_WIRE_VERSION
+
     def __init__(self, max_slots: int = 4, tokens_per_step: int = 4):
         self.max_slots = max_slots
         self.tokens_per_step = max(1, tokens_per_step)
         self._queue: List[_SimRequest] = []
         self._running: Dict[int, _SimRequest] = {}
         self._done: Dict[int, List[int]] = {}
+        self._stream_tail: Dict[int, List[int]] = {}
         self._next_rid = 0
         self._draining = False
         self._failed = False
         self.steps = 0
+        # test/e2e hook: the next N adopt_slot calls are refused (forces
+        # the router's degraded re-prefill fallback path)
+        self.reject_adoptions = 0
 
     # ----------------------------------------------------------- surface
 
@@ -65,6 +88,22 @@ class SimReplicaRuntime:
         out, self._done = self._done, {}
         return out
 
+    def poll_stream(self) -> Dict[int, List[int]]:
+        """Same contract as ``ContinuousBatcher.poll_stream``: tokens
+        generated since the last call, per request, each exactly once
+        and in order (retired requests surface their final tail)."""
+        if self._failed:
+            return {}
+        out: Dict[int, List[int]] = {}
+        tails, self._stream_tail = self._stream_tail, {}
+        out.update(tails)
+        for rid, req in self._running.items():
+            if len(req.generated) > req.streamed:
+                out.setdefault(rid, []).extend(
+                    req.generated[req.streamed:])
+                req.streamed = len(req.generated)
+        return out
+
     def drain(self) -> None:
         self._draining = True
 
@@ -76,11 +115,65 @@ class SimReplicaRuntime:
         self._queue.clear()
         return out
 
+    # ---------------------------------------------------- live migration
+
+    def export_slot(self, rid: int) -> dict:
+        """The sim twin of ``ContinuousBatcher.export_slot``: freeze one
+        in-flight request and hand its state (generated-so-far cursor in
+        place of the paged blocks) to a peer. The request leaves this
+        replica immediately."""
+        if self._failed:
+            raise RuntimeError("server failed; nothing to export")
+        req = self._running.pop(rid)
+        self._stream_tail.pop(rid, None)
+        return {
+            "version": SIM_WIRE_VERSION,
+            "kind": "sim",
+            "prompt": list(req.prompt),
+            "max_new": req.max_new,
+            "generated": list(req.generated),
+            "sampler": {"kind": "greedy"},
+        }
+
+    def adopt_slot(self, payload: dict) -> int:
+        if self._draining:
+            raise RuntimeError("server is draining; adopt on a peer")
+        if self._failed:
+            raise RuntimeError("server failed; adopt on a peer")
+        if self.reject_adoptions > 0:
+            self.reject_adoptions -= 1
+            raise AdoptError("adoption refused (forced rejection)")
+        if payload.get("version") != SIM_WIRE_VERSION:
+            raise AdoptError(
+                f"payload wire version {payload.get('version')!r}; this "
+                f"replica speaks {SIM_WIRE_VERSION}")
+        if payload.get("kind") != "sim":
+            raise AdoptError(f"payload kind {payload.get('kind')!r} is "
+                             f"not adoptable by a sim replica")
+        if len(self._running) >= self.max_slots:
+            raise AdoptError("no free slot to adopt into")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _SimRequest(rid, payload["prompt"], payload["max_new"])
+        req.generated = [int(t) for t in payload["generated"]]
+        # continuation must match the donor's decode exactly — the sim
+        # model is deterministic on the prompt, so splicing the cursor
+        # IS token-identity (asserted by the campaign's end-of-run sweep)
+        req.streamed = len(req.generated)
+        self._running[rid] = req
+        return rid
+
     @property
     def idle(self) -> bool:
         if self._draining:
             return not self._running
         return not self._queue and not self._running
+
+    @property
+    def busy(self) -> bool:
+        """True while any request is mid-generation — what the chaos
+        mid-stream-kill fault waits for before pulling the plug."""
+        return bool(self._running)
 
     def alive(self) -> bool:
         return not self._failed
@@ -91,6 +184,7 @@ class SimReplicaRuntime:
         self._failed = True
         self._running.clear()
         self._done.clear()
+        self._stream_tail.clear()
 
     def step(self, n: int = 1) -> None:
         if self._failed:
@@ -100,17 +194,24 @@ class SimReplicaRuntime:
             while (self._queue and len(self._running) < self.max_slots
                    and not self._draining):
                 req = self._queue.pop(0)
-                req.steps_left = max(
-                    1, math.ceil(req.max_new / self.tokens_per_step))
                 self._running[req.rid] = req
             finished = []
             for rid, req in self._running.items():
-                req.steps_left -= 1
-                if req.steps_left <= 0:
+                take = min(self.tokens_per_step,
+                           req.max_new - len(req.generated))
+                if take > 0:
+                    req.generated.extend(
+                        req.tail[len(req.generated):
+                                 len(req.generated) + take])
+                if len(req.generated) >= req.max_new:
                     finished.append(rid)
             for rid in finished:
                 req = self._running.pop(rid)
-                self._done[rid] = sim_tokens(req.prompt, req.max_new)
+                if len(req.generated) > req.streamed:
+                    self._stream_tail.setdefault(rid, []).extend(
+                        req.generated[req.streamed:])
+                    req.streamed = len(req.generated)
+                self._done[rid] = req.prompt + req.generated
 
     # ----------------------------------------------------------- metrics
 

@@ -84,3 +84,14 @@ REPLICA_ENDPOINT_ANNOTATION = "tpu.dev/serving.endpoint"
 # BEFORE the operator cordons the node — so the handoff decision is
 # durable, observable, and attributable (value: "<reason>@<wall secs>").
 DRAIN_INTENT_ANNOTATION = "tpu.dev/serving.drain-intent"
+# Stamped by the router on the DONOR node the moment live KV migration
+# of its in-flight streamed requests begins (value:
+# "<in-flight count>@<wall secs>") — the migration decision is durable
+# and attributable exactly like the drain intent it rides behind.
+MIGRATION_INTENT_ANNOTATION = "tpu.dev/serving.migration-intent"
+# The KV migration wire version the node's replica speaks (mirrored at
+# registration from the runtime's ``payload_version``): routers and
+# status views pre-check donor/peer adoptability without a probe RPC,
+# and a version skew during a rolling binary upgrade is visible in the
+# cluster instead of as a rejected transfer at drain time.
+KV_PAYLOAD_VERSION_ANNOTATION = "tpu.dev/serving.kv-payload-version"

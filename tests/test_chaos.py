@@ -311,6 +311,50 @@ def test_parse_router_faults_validate():
                                     "duration": 0}]})
 
 
+def test_parse_migration_faults_validate():
+    sc = parse_scenario({
+        "name": "mig", "fleet": {"slices": 2, "hosts_per_slice": 2},
+        "faults": [
+            {"type": "mid-stream-kill", "at": 10, "duration": 60,
+             "slices": [1]},
+            {"type": "kv-transfer-flake", "at": 20, "duration": 30,
+             "rate": 0.4, "slices": [0]},
+        ]})
+    assert sc.faults[0].targets == ["pool-1-h0", "pool-1-h1"]
+    assert sc.faults[1].params == {"rate": 0.4}
+    with pytest.raises(ScenarioError, match="duration"):
+        parse_scenario({"faults": [{"type": "mid-stream-kill", "at": 0,
+                                    "duration": 0}]})
+    with pytest.raises(ScenarioError, match="rate"):
+        parse_scenario({"faults": [{"type": "kv-transfer-flake",
+                                    "at": 0, "rate": 1.5}]})
+
+
+def test_injector_migration_fault_windows():
+    clock = FakeClock(1000.0)
+    cluster = FakeCluster(clock=clock)
+    inj = ChaosInjector(cluster, clock, seed=5, events=[
+        FaultEvent("mid-stream-kill", at=10.0, duration=30.0,
+                   targets=["n1"]),
+        FaultEvent("kv-transfer-flake", at=10.0, duration=30.0,
+                   targets=["n2"], params={"rate": 1.0 - 1e-9}),
+    ])
+    assert inj.mid_stream_kill_nodes() == set()
+    assert not inj.kv_transfer_flaky("n2", "n3")
+    clock.advance(15.0)
+    inj.tick()
+    assert inj.mid_stream_kill_nodes() == {"n1"}
+    # rate ~1.0: every transfer touching n2 (either side) flakes
+    assert inj.kv_transfer_flaky("n2", "n3")
+    assert inj.kv_transfer_flaky("n3", "n2")
+    assert not inj.kv_transfer_flaky("n4", "n5")
+    clock.advance(30.0)
+    inj.tick()
+    assert inj.mid_stream_kill_nodes() == set()
+    assert not inj.kv_transfer_flaky("n2", "n3")
+    assert inj.quiet()
+
+
 ROUTER_CHAOS = {
     "name": "router-faults-e2e",
     "max_ticks": 400,
@@ -321,6 +365,24 @@ ROUTER_CHAOS = {
          "slices": [0]},
         {"type": "metrics-flake", "at": 75.0, "duration": 60.0,
          "slices": [0, 1]},
+        {"type": "spot-reclaim", "at": 200.0, "duration": 120.0,
+         "deadlineSeconds": 60.0, "slices": [1]},
+    ],
+}
+
+# the migration acceptance scenario: a replica killed WITH streams in
+# flight, the KV transfer path flaking through a reclaim-driven drain —
+# the stream-integrity + exactly-once invariants must hold every tick
+MIGRATION_CHAOS = {
+    "name": "mid-stream-migration-e2e",
+    "max_ticks": 400,
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 0},
+    "upgrade_at": 30.0,
+    "faults": [
+        {"type": "mid-stream-kill", "at": 60.0, "duration": 90.0,
+         "slices": [0]},
+        {"type": "kv-transfer-flake", "at": 150.0, "duration": 120.0,
+         "rate": 0.6, "slices": [0, 1]},
         {"type": "spot-reclaim", "at": 200.0, "duration": 120.0,
          "deadlineSeconds": 60.0, "slices": [1]},
     ],
@@ -352,6 +414,39 @@ def test_campaign_replica_kill_same_seed_same_router_stats(tmp_path):
     sc = parse_scenario(ROUTER_CHAOS)
     r1 = run_scenario(sc, seed=3)
     r2 = run_scenario(sc, seed=3)
+    assert r1.router_stats == r2.router_stats
+    assert r1.trace == r2.trace
+
+
+def test_campaign_mid_stream_migration_holds_stream_integrity(tmp_path):
+    """The migration acceptance e2e (ISSUE 12): replicas die WITH
+    streaming requests in flight, the KV transfer path flakes while a
+    reclaim drains a serving slice mid-rollout — and the campaign
+    converges with the stream-integrity + exactly-once invariants
+    holding every tick: no request lost, none double-served, every
+    client stream gapless and token-identical to the deterministic
+    decode, and every drain's in-flight work either live-migrated or
+    degraded-not-lost."""
+    res = run_scenario(parse_scenario(MIGRATION_CHAOS), seed=17,
+                       workdir=str(tmp_path))
+    assert res.violations == [], "\n".join(map(str, res.violations))
+    assert res.converged, res.report()
+    stats = res.router_stats
+    assert stats["submitted"] > 0
+    assert stats["completed"] == stats["submitted"], \
+        "requests were lost across the migration faults"
+    # drains live-migrated in-flight work (or degraded it, never lost):
+    # the reclaim + rollout drains guarantee at least one migration
+    assert stats["migrations"] + stats["migration_fallbacks"] >= 1
+    assert stats["drains"] >= 1
+    # the mid-stream kill forced a fresh generation
+    assert stats["generations"] > 2
+
+
+def test_campaign_migration_same_seed_same_stats(tmp_path):
+    sc = parse_scenario(MIGRATION_CHAOS)
+    r1 = run_scenario(sc, seed=23)
+    r2 = run_scenario(sc, seed=23)
     assert r1.router_stats == r2.router_stats
     assert r1.trace == r2.trace
 

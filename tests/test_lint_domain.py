@@ -817,8 +817,8 @@ def test_chs001_dropped_parser_fails_naming_fault(tmp_path):
 def test_chs001_stale_coverage_key_fails(tmp_path):
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "replica-kill": ("router-exactly-once",),',
-            '    "replica-kill": ("router-exactly-once",),\n'
+            '    "conflict-storm": ("budget", "journey"),',
+            '    "conflict-storm": ("budget", "journey"),\n'
             '    "meteor-strike": ("budget",),')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
@@ -839,8 +839,8 @@ def test_chs001_orphan_invariant_fails(tmp_path):
     """An invariant no fault stresses is a checker that rots silently."""
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "router-admission",\n)',
-            '    "router-admission",\n    "entropy",\n)')})
+            '    "router-stream-integrity",\n)',
+            '    "router-stream-integrity",\n    "entropy",\n)')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "entropy" in msgs and "stressed by no fault" in msgs

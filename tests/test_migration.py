@@ -1,0 +1,649 @@
+"""Live KV migration of in-flight requests (docs/router.md "Live
+migration"): the data path that makes a libtpu upgrade invisible
+mid-generation.
+
+Four layers, bottom up:
+
+- ``models/paged.py`` per-slot KV export/import: one sequence's paged
+  blocks (bf16 and int8-with-scale-pools twins) round-trip through the
+  versioned wire payload and continued decoding on the peer is
+  BIT-IDENTICAL to never having moved — including ragged batches,
+  recycled donor pages, and the version-mismatch rejection surface;
+- ``models/serve.py`` ``export_slot``/``adopt_slot``: a request frozen
+  at a step boundary on one ContinuousBatcher finishes token-identically
+  on another, with the per-token stream (``poll_stream``) staying
+  gapless across the splice;
+- ``serving/router.py`` live migration on drain: in-flight requests move
+  to peers with bounded retry/backoff under a flaky transfer gate, fall
+  back to degraded re-prefill when every peer rejects, and the client
+  stream never gains or loses a token (the router-stream-integrity
+  invariant's subject matter);
+- ``cmd/serve.py`` + ``cmd/router.py``: the same contract over real
+  HTTP/SSE — a client streaming through the router front sees one
+  gapless token stream while its replica drains and the request's KV
+  state moves to a peer.
+
+``make test-migration`` runs exactly this file.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_operator_libs_tpu.models.generate import generate
+from k8s_operator_libs_tpu.models.llama import LlamaConfig, init_params
+from k8s_operator_libs_tpu.models.paged import (
+    KV_WIRE_VERSION,
+    KVPayloadError,
+    _forward_paged,
+    decode_kv_payload,
+    encode_kv_payload,
+    export_slot_kv,
+    import_slot_kv,
+    init_paged_cache,
+    kv_payload_nbytes,
+)
+from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
+from k8s_operator_libs_tpu.serving import (Replica, ReplicaPool,
+                                           RequestRouter,
+                                           SimReplicaRuntime, sim_tokens)
+from k8s_operator_libs_tpu.serving.sim import SIM_WIRE_VERSION, AdoptError
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+from k8s_operator_libs_tpu.wire import KV_PAYLOAD_VERSION_ANNOTATION
+
+CFG = LlamaConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _solo(params, prompt, n):
+    return [int(t) for t in np.asarray(
+        generate(params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+                 CFG, max_new_tokens=n))[0]]
+
+
+# ------------------------------------------------- paged KV wire payload
+
+
+def _drive(params, cache, toks):
+    """One decode tick over every sequence: feed toks [B], return the
+    next greedy token per sequence and the new cache."""
+    logits, cache = _forward_paged(
+        params, jnp.asarray(toks, jnp.int32)[:, None], cache, CFG)
+    return np.asarray(jnp.argmax(logits[:, -1], axis=-1)).astype(np.int32), cache
+
+
+def _prefill(params, prompts, lengths, cache):
+    logits, cache = _forward_paged(params, jnp.asarray(prompts), cache,
+                                   CFG)
+    last = np.asarray(jnp.take_along_axis(
+        logits, jnp.asarray(lengths, jnp.int32)[:, None, None] - 1,
+        axis=1))[:, 0]
+    cache = dataclasses.replace(
+        cache, lengths=jnp.asarray(lengths, jnp.int32))
+    return last.argmax(-1).astype(np.int32), cache
+
+
+@pytest.mark.parametrize("kv_int8", [False, True],
+                         ids=["bf16-twin", "int8-twin"])
+def test_export_import_parity_ragged(params, kv_int8):
+    """export → restore on a DIFFERENT slot of a DIFFERENT batch →
+    continue is bit-identical to uninterrupted decoding, for both cache
+    twins, from a ragged prefill."""
+    rng = np.random.default_rng(3)
+    cap = 48
+    # ragged two-sequence donor batch (seq 0 is the one that migrates)
+    prompts = np.zeros((2, 9), np.int32)
+    lens = [6, 9]
+    for b, n in enumerate(lens):
+        prompts[b, :n] = rng.integers(0, CFG.vocab_size, size=n)
+    donor = init_paged_cache(CFG, [cap] * 2, block_size=8,
+                             kv_int8=kv_int8)
+    toks, donor = _prefill(params, prompts, lens, donor)
+    emitted = [[int(toks[0])], [int(toks[1])]]
+    for _ in range(4):
+        toks, donor = _drive(params, donor, toks)
+        for b in range(2):
+            emitted[b].append(int(toks[b]))
+
+    payload = export_slot_kv(donor.k, donor.v,
+                             np.asarray(donor.table)[0],
+                             int(donor.lengths[0]),
+                             k_scale=donor.k_scale,
+                             v_scale=donor.v_scale)
+    assert payload["version"] == KV_WIRE_VERSION
+    assert payload["quantized"] is kv_int8
+    assert kv_payload_nbytes(payload) > 0
+
+    # peer: a 3-slot pool; the migrated sequence adopts into slot 2
+    peer = init_paged_cache(CFG, [cap] * 3, block_size=8,
+                            kv_int8=kv_int8)
+    k, v, ks, vs, length = import_slot_kv(
+        peer.k, peer.v, np.asarray(peer.table)[2], payload,
+        k_scale=peer.k_scale, v_scale=peer.v_scale)
+    lengths = np.asarray(peer.lengths).copy()
+    lengths[2] = length
+    peer = dataclasses.replace(peer, k=k, v=v, k_scale=ks, v_scale=vs,
+                               lengths=jnp.asarray(lengths))
+
+    # continue BOTH for 5 more ticks: donor seq 0 is the uninterrupted
+    # reference, peer slot 2 the migrated copy (other slots decode
+    # garbage — the no-interference property keeps them irrelevant)
+    donor_tok = toks.copy()
+    peer_tok = np.zeros((3,), np.int32)
+    peer_tok[2] = toks[0]
+    for _ in range(5):
+        donor_tok, donor = _drive(params, donor, donor_tok)
+        peer_tok, peer = _drive(params, peer, peer_tok)
+        assert int(peer_tok[2]) == int(donor_tok[0]), \
+            "continued decode diverged after migration"
+
+
+def test_export_import_wire_encoding_roundtrip(params):
+    cache = init_paged_cache(CFG, [32] * 2, block_size=8, kv_int8=True)
+    prompts = np.arange(10, dtype=np.int32).reshape(2, 5) % CFG.vocab_size
+    _, cache = _prefill(params, prompts, [5, 5], cache)
+    payload = export_slot_kv(cache.k, cache.v,
+                             np.asarray(cache.table)[1],
+                             int(cache.lengths[1]),
+                             k_scale=cache.k_scale,
+                             v_scale=cache.v_scale)
+    wire = json.dumps(encode_kv_payload(payload))   # JSON-safe
+    back = decode_kv_payload(json.loads(wire))
+    for key in ("k", "v", "k_scale", "v_scale"):
+        np.testing.assert_array_equal(np.asarray(payload[key]),
+                                      back[key])
+    for key in ("version", "block_size", "start", "length", "quantized",
+                "dtype"):
+        assert back[key] == payload[key]
+
+
+def test_import_rejections(params):
+    cache = init_paged_cache(CFG, [32], block_size=8)
+    prompts = np.arange(5, dtype=np.int32)[None]
+    _, cache = _prefill(params, prompts, [5], cache)
+    payload = export_slot_kv(cache.k, cache.v,
+                             np.asarray(cache.table)[0],
+                             int(cache.lengths[0]))
+    peer = init_paged_cache(CFG, [32], block_size=8)
+    row = np.asarray(peer.table)[0]
+
+    bad = dict(payload, version=KV_WIRE_VERSION + 1)
+    with pytest.raises(KVPayloadError, match="wire version"):
+        import_slot_kv(peer.k, peer.v, row, bad)
+    with pytest.raises(KVPayloadError, match="block size"):
+        import_slot_kv(peer.k, peer.v, row, dict(payload, block_size=16))
+    with pytest.raises(KVPayloadError, match="aligned prefix"):
+        import_slot_kv(peer.k, peer.v, row, payload, start=8)
+    quant_peer = init_paged_cache(CFG, [32], block_size=8, kv_int8=True)
+    with pytest.raises(KVPayloadError, match="plain"):
+        import_slot_kv(quant_peer.k, quant_peer.v,
+                       np.asarray(quant_peer.table)[0], payload,
+                       k_scale=quant_peer.k_scale,
+                       v_scale=quant_peer.v_scale)
+    # table row too short for the payload's span = no free pages
+    with pytest.raises(KVPayloadError, match="free pages"):
+        import_slot_kv(peer.k, peer.v, row[:0], payload)
+
+
+# ------------------------------------------- batcher export_slot/adopt_slot
+
+
+def test_batcher_migration_token_identical_and_recycled(params):
+    """A request frozen mid-decode on batcher A finishes on batcher B
+    token-identical to its solo decode, the stream splice is gapless,
+    and the donor's recycled slot/pages immediately serve a NEW request
+    without corrupting the migrated one."""
+    a = ContinuousBatcher(params, CFG, max_slots=2, capacity_per_slot=64,
+                          block_size=8)
+    b = ContinuousBatcher(params, CFG, max_slots=2, capacity_per_slot=64,
+                          block_size=8)
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, CFG.vocab_size, size=9).astype(np.int32)
+    p2 = rng.integers(0, CFG.vocab_size, size=5).astype(np.int32)
+    rid = a.submit(p1, 10)
+    for _ in range(3):
+        a.step()
+    streamed = a.poll_stream().get(rid, [])
+    payload = a.export_slot(rid)
+    assert payload["generated"] == streamed
+    assert payload["sampler"] == {"kind": "greedy"}
+
+    # donor recycling: the freed slot + pages serve a new request NOW
+    rid2 = a.submit(p2, 6)
+    rid_b = b.adopt_slot(payload)
+    while not (a.idle and b.idle):
+        if not a.idle:
+            a.step()
+        if not b.idle:
+            b.step()
+    tail = b.poll_stream().get(rid_b, [])
+    out_b = b.poll()[rid_b]
+    out_a2 = a.poll()[rid2]
+    np.testing.assert_array_equal(out_b, _solo(params, p1, 10))
+    np.testing.assert_array_equal(out_a2, _solo(params, p2, 6))
+    # the spliced stream (donor half + peer half) is exactly the tail
+    np.testing.assert_array_equal(streamed + tail,
+                                  _solo(params, p1, 10)[len(p1):])
+
+
+def test_batcher_adopt_rejections(params):
+    a = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=64,
+                          block_size=8)
+    b = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=64,
+                          block_size=8)
+    p = np.arange(8, dtype=np.int32)
+    rid = a.submit(p, 8)
+    a.step()
+    payload = a.export_slot(rid)
+
+    with pytest.raises(KVPayloadError, match="wire version"):
+        b.adopt_slot(dict(payload, version=99))
+    with pytest.raises(KVPayloadError, match="kind"):
+        b.adopt_slot(dict(payload, kind="sim"))
+    with pytest.raises(KVPayloadError, match="sampler"):
+        b.adopt_slot(dict(payload, sampler={"kind": "nucleus"}))
+    # occupied peer: no free slot
+    b.submit(np.arange(4, dtype=np.int32), 4)
+    b.step()
+    with pytest.raises(KVPayloadError, match="no free slot"):
+        b.adopt_slot(payload)
+    # a draining peer refuses adoption outright
+    c = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=64,
+                          block_size=8)
+    c.drain()
+    with pytest.raises(RuntimeError, match="draining"):
+        c.adopt_slot(payload)
+    # too small a slot for the remaining tokens
+    d = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=8,
+                          block_size=8)
+    with pytest.raises(KVPayloadError, match="capacity"):
+        d.adopt_slot(payload)
+    # the rejected adoptions leaked nothing: d still admits a request
+    rid_d = d.submit(np.arange(3, dtype=np.int32), 4)
+    while not d.idle:
+        d.step()
+    assert rid_d in d.poll()
+
+
+def test_export_unknown_rid_raises_keyerror(params):
+    a = ContinuousBatcher(params, CFG, max_slots=1, capacity_per_slot=32,
+                          block_size=8)
+    with pytest.raises(KeyError):
+        a.export_slot(123)
+
+
+# ------------------------------------------------------- sim runtime twin
+
+
+def test_sim_wire_version_matches_paged():
+    assert SIM_WIRE_VERSION == KV_WIRE_VERSION
+
+
+def test_sim_streaming_and_migration_roundtrip():
+    a = SimReplicaRuntime(max_slots=2, tokens_per_step=3)
+    b = SimReplicaRuntime(max_slots=2, tokens_per_step=3)
+    rid = a.submit([4, 5, 6], 10)
+    a.step()
+    first = a.poll_stream()[rid]
+    payload = a.export_slot(rid)
+    assert payload["version"] == SIM_WIRE_VERSION
+    rid_b = b.adopt_slot(payload)
+    while not b.idle:
+        b.step()
+    tail = b.poll_stream()[rid_b]
+    out = b.poll()[rid_b]
+    assert out == sim_tokens([4, 5, 6], 10)
+    assert first + tail == out[3:]
+    # forced rejection knob (the e2e fallback driver)
+    b.reject_adoptions = 1
+    with pytest.raises(AdoptError):
+        b.adopt_slot(payload)
+    # version gate
+    with pytest.raises(AdoptError):
+        b.adopt_slot(dict(payload, version=2))
+
+
+# --------------------------------------------------- router live migration
+
+
+def _sim_pair(clock, tokens_per_step=2):
+    pool = ReplicaPool(component="libtpu", clock=clock)
+    ra = Replica("a", "node-a",
+                 SimReplicaRuntime(max_slots=4,
+                                   tokens_per_step=tokens_per_step))
+    rb = Replica("b", "node-b",
+                 SimReplicaRuntime(max_slots=4,
+                                   tokens_per_step=tokens_per_step))
+    pool.register(ra)
+    pool.register(rb)
+    return pool, ra, rb
+
+
+def test_router_drain_live_migrates_in_flight():
+    clock = FakeClock()
+    pool, ra, rb = _sim_pair(clock)
+    router = RequestRouter(pool, clock=clock)
+    rid = router.submit([5, 6, 7], 12)
+    ra.runtime.step()
+    rb.runtime.step()
+    router.tick()
+    seen = list(router.stream(rid))
+    assert len(seen) == 2
+    router.drain_replica(ra, "upgrade:cordon-required")
+    req = router.requests[rid]
+    assert req.replica_id == "b" and req.migrations == 1
+    assert router.migration_successes == 1
+    for _ in range(10):
+        ra.runtime.step()
+        rb.runtime.step()
+        router.tick()
+    assert req.state == "completed"
+    assert req.tokens == sim_tokens([5, 6, 7], 12)
+    assert router.stream(rid) == req.tokens[3:]
+    assert router.check_invariants() == []
+    assert router.completed_counts == {rid: 1}
+
+
+def test_router_transfer_flake_retries_then_succeeds():
+    clock = FakeClock()
+    pool, ra, rb = _sim_pair(clock)
+    router = RequestRouter(pool, clock=clock, transfer_retries=3,
+                           transfer_backoff_s=0.5)
+    flakes = {"n": 2}
+
+    def gate(donor, peer):
+        if flakes["n"] > 0:
+            flakes["n"] -= 1
+            raise OSError("injected transfer flake")
+
+    router.transfer_gate = gate
+    rid = router.submit([1, 2], 8)
+    ra.runtime.step()
+    router.tick()
+    t0 = clock.now()
+    router.drain_replica(ra, "pod-term")
+    req = router.requests[rid]
+    # two flaked attempts backed off on the injected clock, third landed
+    assert req.migrations == 1 and router.migration_attempts == 3
+    assert clock.now() - t0 >= 0.5 + 1.0
+    for _ in range(8):
+        rb.runtime.step()
+        router.tick()
+    assert req.state == "completed"
+    assert req.tokens == sim_tokens([1, 2], 8)
+    assert router.stream(rid) == req.tokens[2:]
+    assert router.check_invariants() == []
+
+
+def test_router_fallback_degraded_when_all_peers_reject():
+    clock = FakeClock()
+    pool, ra, rb = _sim_pair(clock)
+    router = RequestRouter(pool, clock=clock, transfer_retries=2)
+    rid = router.submit([9, 9], 10)
+    ra.runtime.step()
+    router.tick()
+    already = len(router.stream(rid))
+    assert already > 0
+    rb.runtime.reject_adoptions = 99
+    router.drain_replica(ra, "pod-term")
+    req = router.requests[rid]
+    assert req.state == "queued" and req.priority == "degraded"
+    assert req.replay_skip == already
+    assert router.migration_fallbacks == 1
+    rb.runtime.reject_adoptions = 0
+    for _ in range(12):
+        ra.runtime.step()
+        rb.runtime.step()
+        router.tick()
+    assert req.state == "completed"
+    assert req.tokens == sim_tokens([9, 9], 10)
+    # the replayed prefix was swallowed: gapless, duplicate-free
+    assert router.stream(rid) == req.tokens[2:]
+    assert router.check_invariants() == []
+    assert router.completed_counts == {rid: 1}
+
+
+def test_router_degraded_yields_placement_to_normal_traffic():
+    """A migration-fallback request runs at degraded priority: when the
+    router's queue places, normal traffic submitted AFTER it still goes
+    to the replica first (the runtime admits in submission order)."""
+    clock = FakeClock()
+    pool = ReplicaPool(component="libtpu", clock=clock)
+    router = RequestRouter(pool, clock=clock)
+    # no replicas yet: both requests queue at the router
+    degraded = router.submit([1], 4)
+    normal = router.submit([2], 4)
+    router.requests[degraded].priority = "degraded"
+    ra = Replica("a", "node-a", SimReplicaRuntime(max_slots=1,
+                                                  tokens_per_step=1))
+    pool.register(ra)
+    router.tick()
+    order = [router._local2global[("a", r.rid)]
+             for r in ra.runtime._queue + list(
+                 ra.runtime._running.values())]
+    assert order == [normal, degraded]
+
+
+def test_router_mid_stream_kill_fallback_is_gapless():
+    """A replica dies with a streamed request mid-generation (no export
+    possible): the re-placement re-decodes from the prompt and the
+    router swallows the replay — the client stream never gains or loses
+    a token."""
+    clock = FakeClock()
+    pool, ra, rb = _sim_pair(clock)
+    router = RequestRouter(pool, clock=clock)
+    rid = router.submit([7, 8, 9], 12)
+    ra.runtime.step()
+    router.tick()
+    already = list(router.stream(rid))
+    assert already
+    ra.runtime.fail()               # mid-stream kill
+    router.tick()                   # failure collected, re-placed
+    req = router.requests[rid]
+    assert req.replica_id == "b"
+    for _ in range(12):
+        rb.runtime.step()
+        router.tick()
+    assert req.state == "completed"
+    assert req.tokens == sim_tokens([7, 8, 9], 12)
+    assert router.stream(rid) == req.tokens[3:]
+    assert router.stream(rid)[:len(already)] == already
+    assert router.check_invariants() == []
+
+
+def test_stream_integrity_invariant_catches_tampering():
+    from k8s_operator_libs_tpu.chaos.invariants import (
+        CampaignView, RouterStreamIntegrityInvariant)
+    clock = FakeClock()
+    pool, ra, rb = _sim_pair(clock)
+    router = RequestRouter(pool, clock=clock)
+    rid = router.submit([3, 3], 6)
+    for _ in range(6):
+        ra.runtime.step()
+        rb.runtime.step()
+        router.tick()
+    assert router.requests[rid].state == "completed"
+
+    def view():
+        return CampaignView(tick=1, t=15.0, nodes={}, keys=None,
+                            budget=4, fault_notready=set(),
+                            leaders=["op-a"], recorder_events=[],
+                            alert_status={}, router=router)
+
+    inv = RouterStreamIntegrityInvariant()
+    assert inv.check(view()) == []
+    # rogue duplicate append: seq numbers no longer 0..n-1
+    req = router.requests[rid]
+    req.stream_log.append((len(req.stream) - 1, "a"))
+    req.stream.append(req.stream[-1])
+    out = RouterStreamIntegrityInvariant().check(view())
+    assert any("gap or duplicate" in v.detail for v in out)
+    # rogue splice-verification failure surfaces exactly once
+    router.stream_violations.append("request 0: replayed token differs")
+    inv2 = RouterStreamIntegrityInvariant()
+    out = inv2.check(view())
+    assert any("splice verification failed" in v.detail for v in out)
+    assert not any("splice verification" in v.detail
+                   for v in inv2.check(view()))
+
+
+def test_pool_mirrors_kv_payload_version(cluster):
+    pool = ReplicaPool(client=cluster.client, component="libtpu",
+                       clock=FakeClock())
+    cluster.add_node("node-a")
+    pool.register(Replica("a", "node-a", SimReplicaRuntime()))
+    node = cluster.client.direct().get_node("node-a")
+    assert node.metadata.annotations[KV_PAYLOAD_VERSION_ANNOTATION] == \
+        str(SIM_WIRE_VERSION)
+    pool.deregister("a")
+    node = cluster.client.direct().get_node("node-a")
+    assert KV_PAYLOAD_VERSION_ANNOTATION not in node.metadata.annotations
+
+
+# ------------------------------------------------ cmd tier over real HTTP
+
+
+def _load_cmd(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "cmd",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"migr_cli_{name}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cmd_stream_live_migration_invisible_to_client(params):
+    """The flagship cmd-tier contract: a client streaming through
+    cmd/router.py sees ONE gapless token stream with per-token sequence
+    numbers while its serving replica drains mid-generation and the
+    request's KV state live-migrates to a peer over HTTP
+    (/export → /adopt → /stream)."""
+    serve = _load_cmd("serve")
+    routercli = _load_cmd("router")
+    from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+    from k8s_operator_libs_tpu.serving.pool import Replica, ReplicaPool
+
+    servers = []
+    for _ in range(2):
+        rt = serve.ServingRuntime(params, CFG, 2, 64, 8, chunk=1)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    serve.make_handler(rt))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append((rt, httpd,
+                        f"http://127.0.0.1:{httpd.server_address[1]}"))
+    pool = ReplicaPool(component="libtpu")
+    for i, (_rt, _httpd, url) in enumerate(servers):
+        pool.register(Replica(f"r{i}", f"node-{i}",
+                              routercli.HTTPRuntime(url), url=url))
+    front = routercli.RouterFront(pool, metrics=MetricsHub(),
+                                  proxy_timeout=60.0)
+    front.tick()
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        n = 24
+        solo = _solo(params, prompt, n)
+        events = []
+        drained = {}
+
+        def emit(event):
+            events.append(event)
+            if "token" in event and event["seq"] == 2 and not drained:
+                # drain the serving replica the moment the client has
+                # acked a few tokens — mid-generation, mid-stream
+                with front.lock:
+                    sid = max(front._outstanding,
+                              key=lambda k: front._outstanding[k])
+                drained["id"] = sid
+                idx = int(sid[1])
+                urllib.request.urlopen(urllib.request.Request(
+                    servers[idx][2] + "/drain", data=b"{}",
+                    method="POST"), timeout=10).read()
+
+        code = front.generate_stream(prompt, n, emit=emit)
+        assert code == 200
+        toks = [e["token"] for e in events if "token" in e]
+        seqs = [e["seq"] for e in events if "token" in e]
+        done = [e for e in events if e.get("done")]
+        # zero client-visible disconnects: one gapless, duplicate-free
+        # stream, token-identical to the solo decode
+        assert seqs == list(range(n))
+        assert toks == solo[len(prompt):]
+        assert len(done) == 1 and done[0]["tokens"] == solo
+        assert front._migrations == 1
+        assert front._migration_fallbacks == 0
+        assert "id" in drained
+    finally:
+        for rt, httpd, _url in servers:
+            httpd.shutdown()
+            rt.stop()
+
+
+def test_cmd_serve_sse_stream_and_export_endpoints(params):
+    """cmd/serve.py alone: SSE /generate streams gapless seq-numbered
+    tokens ending in done; /export of an unknown rid is a 404; an
+    /adopt of a version-mismatched payload is a 409 rejection."""
+    serve = _load_cmd("serve")
+    rt = serve.ServingRuntime(params, CFG, 2, 64, 8, chunk=2)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), serve.make_handler(rt))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        prompt = [2, 7, 1, 8]
+        solo = _solo(params, prompt, 6)
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": prompt, "max_new": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        events = []
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for raw in resp:
+                line = raw.strip()
+                if line.startswith(b"data: "):
+                    events.append(json.loads(line[6:]))
+        assert "rid" in events[0]
+        toks = [e["token"] for e in events if "token" in e]
+        assert [e["seq"] for e in events if "token" in e] == \
+            list(range(6))
+        assert toks == solo[len(prompt):]
+        assert events[-1] == {"done": True, "tokens": solo}
+
+        # /export of a finished/unknown rid: 404, not a hang
+        req = urllib.request.Request(
+            base + "/export", data=json.dumps({"rid": 999}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+
+        # version-mismatched adoption: 409 rejection, never a crash
+        bad = {"version": 99, "kind": "batcher", "prompt": [1],
+               "max_new": 4, "generated": [], "last_token": 0,
+               "sampler": {"kind": "greedy"},
+               "kv": {"version": 99, "block_size": 8, "start": 0,
+                      "length": 1, "quantized": False,
+                      "dtype": "float32"}}
+        req = urllib.request.Request(
+            base + "/adopt", data=json.dumps(bad).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 409
+    finally:
+        httpd.shutdown()
+        rt.stop()

@@ -198,12 +198,18 @@ def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
     walks ALL THREE serving nodes while the router keeps serving.
 
     Holds at every iteration: admission never lands on a node that is
-    cordoned/quarantined (router invariant vs cluster truth), and the
-    admitting fleet never drops below N - maxUnavailable = 2.
-    Holds at the end: every request completed EXACTLY once, tokens
-    identical to a solo decode no matter which replica (or replica
-    generation) served it, every replica drained BEFORE its node's
-    cordon landed, and the fleet is back to 3 admitting replicas at v2.
+    cordoned/quarantined (router invariant vs cluster truth), the
+    admitting fleet never drops below N - maxUnavailable = 2, and
+    per-request token streams stay gapless and duplicate-free (the
+    stream-integrity half of check_invariants).
+    Holds at the end: ZERO client-visible disconnects — every request
+    completed EXACTLY once with tokens identical to a solo decode no
+    matter which replica (or replica generation) served it, in-flight
+    streamed requests crossed drains via LIVE KV MIGRATION (at least
+    one per rolling upgrade), the forced adoption rejection exercised
+    the degraded re-prefill fallback (slower, never lost), every
+    replica drained BEFORE its node's cordon landed, and the fleet is
+    back to 3 admitting replicas at v2.
     """
     from k8s_operator_libs_tpu.upgrade.util import KeyFactory
     keys = KeyFactory("libtpu")
@@ -251,6 +257,12 @@ def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
     submit(9)
     cluster.bump_daemonset_revision("libtpu", NS, "v2")
 
+    # force the FIRST drain's migration attempts to be rejected by every
+    # peer: the fallback path (degraded re-prefill, never lost) must be
+    # exercised by this e2e, not just the happy splice
+    for replica in pool.replicas.values():
+        replica.runtime.reject_adoptions = 50
+
     exited = set()         # replica ids whose serve pod completed
     min_admitting = len(N_HOSTS)
     done = False
@@ -258,6 +270,10 @@ def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
         operator.reconcile()
         cluster.reconcile_daemonsets()
         router.tick()
+        if router.migration_fallbacks >= 1:
+            # the rejection was exercised — let later drains migrate
+            for replica in pool.replicas.values():
+                replica.runtime.reject_adoptions = 0
 
         # the standing router invariants, against cluster truth, every
         # single iteration
@@ -266,9 +282,10 @@ def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
         assert router.check_invariants(nodes) == []
         min_admitting = min(min_admitting, len(pool.admitting()))
 
-        # keep traffic flowing mid-upgrade
-        if it in (5, 25):
-            submit(3, session=f"s{it}")
+        # keep traffic flowing mid-upgrade: a steady trickle guarantees
+        # in-flight streamed requests exist whenever a drain lands
+        if it % 4 == 1 and len(expected) < 36:
+            submit(1, session=f"s{it % 8}")
 
         for replica in list(pool.replicas.values()):
             if not replica.failed:
@@ -332,6 +349,29 @@ def test_n_replica_rolling_upgrade_zero_loss(cluster, clock, router_fleet):
     # requests were actually served by multiple replicas/generations
     served_by = {rid: router.requests[rid].replica_id for rid in expected}
     assert len(set(served_by.values())) >= 2
+
+    # ZERO CLIENT-VISIBLE DISCONNECTS: in-flight streamed requests
+    # crossed drains via live KV migration (not 503 + re-enter), the
+    # forced rejection exercised the degraded fallback exactly as
+    # designed (never lost), and every spliced stream equals its
+    # delivered result token for token, gapless and duplicate-free
+    assert router.migration_successes >= 1, \
+        "no in-flight request crossed a drain via live KV migration"
+    assert any(r.migrations >= 1 for r in router.requests.values())
+    assert router.migration_fallbacks >= 1, \
+        "the forced adoption rejection never exercised the fallback"
+    fallen_back = [r for r in router.requests.values()
+                   if r.priority == "degraded"]
+    assert fallen_back and all(r.state == "completed"
+                               for r in fallen_back)
+    assert router.stream_violations == []
+    for rid, (prompt, max_new) in expected.items():
+        req = router.requests[rid]
+        assert [seq for seq, _r in req.stream_log] == \
+            list(range(len(req.stream)))
+        if req.stream:
+            assert req.stream == req.tokens[len(prompt):], \
+                f"request {rid} stream diverged from its result"
 
     # no replica changed any request's output: all equal solo decodes
     for rid, (prompt, max_new) in expected.items():
