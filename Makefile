@@ -13,7 +13,7 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
   test-obs-slo test-obs-profile test-chaos test-router test-migration \
-  test-race \
+  test-market test-race \
   health-sim chaos race race-smoke fleetbench fleetbench-smoke lint \
   lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
   dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
@@ -68,12 +68,16 @@ test-router:  ## serving router tier: affinity/backpressure/handoff units, autos
 test-migration:  ## live KV migration: paged export/import parity (bf16 + int8 twins), batcher export_slot/adopt_slot token identity, router live migration + degraded fallback + stream integrity, cmd-tier SSE splice over real HTTP (docs/router.md "Live migration")
 	$(PYTHON) -m pytest tests/test_migration.py -q
 
+test-market:  ## capacity market: QoS lanes (weighted fair queueing + shed order), arbiter exchange-rate/hysteresis/durable-lease units incl. the failover resume, elastic grow round-trip + CPU grow e2e, and the flash-crowd demand e2e (docs/capacity-market.md)
+	$(PYTHON) -m pytest tests/test_market.py tests/test_elastic.py -q
+
 health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 	$(PYTHON) tools/health_sim.py
 
 SEEDS ?= 20
-chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md)
-	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS)
+CHAOS_FLAGS ?=
+chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
+	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS) $(CHAOS_FLAGS)
 
 RACE_SEEDS ?= 40
 race:  ## deterministic schedule exploration of the six real-component harnesses (drain/evict workers, leader renew-vs-demote, informer-vs-reader, uploader, router ticker-vs-proxy) with lockset race detection; failures report seed + shrunk replayable trace (docs/static-analysis.md "Schedule exploration")

@@ -102,6 +102,91 @@ def load_slo(path: str):
     return SLOOptions.from_dict(section)
 
 
+def load_market(path: str):
+    """Optional top-level ``market:`` section (docs/capacity-market.md):
+
+        market:
+          routerUrl: http://router:8300       # lane-demand poll (/lanes)
+          goodputLedger: /ckpt/goodput.jsonl  # marginal-goodput pricing
+          slices:                             # tradeable training slices
+            - id: pool-7
+              nodes: [v5p-7-h0, v5p-7-h1]
+          config: {preempt_rate: 2.0, sustain_ticks: 3}
+
+    Returns the raw dict (the arbiter is built in main once the client
+    and SLO engine exist), or None when absent/disabled."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    section = cfg.get("market")
+    if not section or section.get("enabled") is False:
+        return None
+    if not section.get("slices"):
+        raise ValueError(f"{path}: market: needs at least one slices "
+                         f"entry (id + nodes)")
+    return section
+
+
+class HTTPLaneDemand:
+    """The arbiter's demand adapter over a remote ``cmd/router.py``: one
+    ``/lanes`` fetch per call, errors surface to the arbiter (which
+    prices an unreachable router as zero lane pressure — the SLO burn
+    signal still stands)."""
+
+    def __init__(self, router_url: str, timeout: float = 5.0):
+        self.url = router_url.rstrip("/") + "/lanes"
+        self.timeout = timeout
+        self._last = None
+
+    def _fetch(self):
+        import urllib.request
+        with urllib.request.urlopen(self.url,
+                                    timeout=self.timeout) as resp:
+            self._last = json.loads(resp.read().decode())["data"]
+        return self._last
+
+    def lane_depths(self):
+        data = self._fetch()
+        return {lane: stats.get("queued", 0)
+                for lane, stats in (data.get("lanes") or {}).items()}
+
+    def lane_stats(self):
+        data = self._last or self._fetch()
+        return data.get("lanes")
+
+    def admitting_count(self):
+        data = self._last or self._fetch()
+        return int(data.get("admitting") or 0)
+
+
+def build_market(section, client, slo_engine, hub, recorder, clock):
+    """``market:`` section → a wired CapacityArbiter."""
+    from k8s_operator_libs_tpu.market import (CapacityArbiter,
+                                              ManagedSlice, MarketConfig,
+                                              marginal_goodput)
+    supply = [ManagedSlice(str(s["id"]), [str(n) for n in s["nodes"]])
+              for s in section["slices"]]
+    demand = (HTTPLaneDemand(section["routerUrl"])
+              if section.get("routerUrl") else None)
+    goodput_fn = None
+    ledger_path = section.get("goodputLedger")
+    if ledger_path:
+        from k8s_operator_libs_tpu.obs.goodput import (read_ledger,
+                                                       summarize)
+
+        def goodput_fn():
+            try:
+                return marginal_goodput(summarize(read_ledger(ledger_path)),
+                                        max(1, len(supply)))
+            except Exception:
+                return 0.0
+    return CapacityArbiter(
+        supply, client=client, demand=demand, slo_engine=slo_engine,
+        goodput_fn=goodput_fn, recorder=recorder, metrics=hub,
+        clock=clock,
+        config=MarketConfig.from_dict(section.get("config") or {}))
+
+
 def build_client(args, components):
     """The reference's two-client split (upgrade_state.go:127-135): a
     long-running operator reads through an informer cache (CachedClient)
@@ -142,7 +227,8 @@ class MetricsServer:
 
     def __init__(self, port: int):
         self.snapshot = {"text": "", "healthy": False,
-                         "slo": None, "alerts": None, "profile": None}
+                         "slo": None, "alerts": None, "profile": None,
+                         "market": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -158,12 +244,16 @@ class MetricsServer:
                     body = b"ok" if snapshot["healthy"] else b"not ready"
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
-                elif self.path in ("/slo", "/alerts", "/profile"):
+                elif self.path in ("/slo", "/alerts", "/profile",
+                                   "/market"):
                     payload = snapshot[self.path[1:]]
                     if payload is None:
-                        body = (b'{"error": "profiler disabled"}'
-                                if self.path == "/profile" else
-                                b'{"error": "slo engine disabled"}')
+                        body = {
+                            "/profile": b'{"error": "profiler disabled"}',
+                            "/market":
+                                b'{"error": "market arbiter disabled"}',
+                        }.get(self.path,
+                              b'{"error": "slo engine disabled"}')
                         ctype, code = "application/json", 404
                     else:
                         body = payload.encode()
@@ -288,6 +378,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         components = load_components(args.config)
         health = load_health(args.config)
         slo = load_slo(args.config)
+        market_section = load_market(args.config)
         client, recorder = build_client(args, components)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -331,6 +422,17 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         logger.info("tracing reconcile spans to %s", args.trace_log)
     stop = stop or threads.make_event("operator-stop")
     clock = clock or RealClock()
+    arbiter = None
+    market_hub = None
+    if market_section is not None:
+        market_hub = MetricsHub()
+        arbiter = build_market(market_section, client,
+                               operator.slo_engine, market_hub, recorder,
+                               clock)
+        logger.info("capacity market on (%d managed slices%s)",
+                    len(arbiter.supply),
+                    ", router " + market_section["routerUrl"]
+                    if market_section.get("routerUrl") else "")
     elector = None
     cache_started = not args.leader_elect  # see build_client
     if args.leader_elect and args.once:
@@ -436,6 +538,10 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 # dashboards tell a hot spare from the leader (both
                 # replicas' /metrics used to be indistinguishable)
                 hub.set_gauge("leader", 0.0)
+                if arbiter is not None:
+                    # a promoted standby must resume trades from the
+                    # durable annotations, not this process's stale view
+                    arbiter.standby()
                 if server:
                     server.snapshot["text"] = hub.render()
                     server.snapshot["healthy"] = True
@@ -451,9 +557,20 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
             states = operator.reconcile()
             ticks += 1
             last_ok = all(s is not None for s in states.values())
+            if arbiter is not None:
+                # the market trades under the leader only (standby
+                # replicas resumed from the durable annotations above,
+                # via the elector gate's `continue`)
+                try:
+                    arbiter.tick()
+                except Exception:
+                    logger.exception("market arbiter tick failed; "
+                                     "retrying next tick")
             if server:
-                server.snapshot["text"] = render_metrics(operator, states,
-                                                         hub)
+                text = render_metrics(operator, states, hub)
+                if market_hub is not None:
+                    text += market_hub.render(prefix="tpu_market")
+                server.snapshot["text"] = text
                 # healthy = the last tick reconciled every component; an
                 # apiserver outage flips this off so k8s probes can restart us
                 server.snapshot["healthy"] = last_ok
@@ -463,6 +580,9 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if profiler is not None:
                     server.snapshot["profile"] = json.dumps(
                         {"kind": "profile", "data": profiler.payload()})
+                if arbiter is not None:
+                    server.snapshot["market"] = json.dumps(
+                        {"kind": "market", "data": arbiter.payload()})
             if args.once:
                 break
             remaining = max(0.0, args.interval - (time.monotonic() - t0))

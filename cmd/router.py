@@ -15,9 +15,14 @@ reroute through this router to a peer.
 HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
 ``{"kind", "data"}`` envelope the other cmd binaries use):
 
-- ``POST /generate``  {"tokens": [...], "max_new": N, "session"?: id}
+- ``POST /generate``  {"tokens": [...], "max_new": N, "session"?: id,
+  "lane"?: interactive|batch|best-effort}
   → proxied to the best replica (session + shared-prefix affinity, then
-  weighted least-outstanding-work with queue-depth backpressure); a 503
+  weighted least-outstanding-work with queue-depth backpressure). The
+  QoS ``lane`` prices overload: sheddable lanes admit only while the
+  fleet has headroom at their admit factor and are otherwise dropped
+  with 429 ``{"shed": true}`` — interactive keeps the full budget
+  (docs/capacity-market.md). A 503
   or connection error from a draining/dead replica retries the SAME
   request on the next-best peer (exactly-once holds: a 503 means "not
   served here"). With ``"stream": true`` the response relays the
@@ -33,6 +38,9 @@ HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
   at runtime (the ``--replica`` flag seeds the registry at boot).
 - ``GET  /replicas``  → the registry view ``cmd/status.py --replicas``
   renders.
+- ``GET  /lanes``     → per-lane in-flight/shed/completed counters plus
+  the admitting-replica count — the demand signal the operator's
+  capacity arbiter polls (docs/capacity-market.md).
 - ``GET  /metrics``   → ``tpu_router_*`` families (docs/observability.md).
 - ``GET  /healthz``   → 200 while at least one replica admits, else 503.
 
@@ -146,6 +154,13 @@ class RouterFront:
     surface lets the library Autoscaler drive scale decisions against
     this front unchanged."""
 
+    # proxy-mode overload policy: a lane only admits while the best
+    # replica's scraped queue depth sits under queue_high times its
+    # factor — best-effort backpressures out first, interactive last
+    # (the proxy twin of the library router's shed order)
+    LANE_ADMIT_FACTOR = {"interactive": 1.0, "batch": 0.75,
+                         "best-effort": 0.5}
+
     def __init__(self, pool, metrics=None, clock=None, queue_high=8.0,
                  proxy_timeout=300.0, post_json=None, open_sse=None):
         from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
@@ -166,6 +181,11 @@ class RouterFront:
         self._routed = 0
         self._completed = 0
         self._rerouted = 0
+        from k8s_operator_libs_tpu.serving.router import LANES
+        self._lanes = LANES
+        self._lane_outstanding = {lane: 0 for lane in LANES}
+        self._lane_shed = {lane: 0 for lane in LANES}
+        self._lane_completed = {lane: 0 for lane in LANES}
         self._migrations = 0
         self._migration_attempts = 0
         self._migration_fallbacks = 0
@@ -173,12 +193,14 @@ class RouterFront:
 
     # --------------------------------------------------------- placement
 
-    def _pick(self, session, prefix_key, exclude):
+    def _pick(self, session, prefix_key, exclude, lane="interactive"):
+        high = self.queue_high * self.LANE_ADMIT_FACTOR.get(lane, 1.0)
         with self.lock:
             candidates = [
                 r for r in self.pool.admitting()
                 if r.id not in exclude
-                and (r.stats.stale or r.stats.queue_depth < self.queue_high)]
+                and getattr(r, "lane", None) in (None, lane)
+                and (r.stats.stale or r.stats.queue_depth < high)]
             if not candidates:
                 return None
             by_id = {r.id: r for r in candidates}
@@ -190,15 +212,30 @@ class RouterFront:
                 (self._outstanding.get(r.id, 0) + r.stats.queue_depth)
                 / r.weight))
 
-    def generate(self, tokens, max_new, session=None):
+    def generate(self, tokens, max_new, session=None, lane="interactive"):
         """→ (http status, body dict). Retries distinct peers until one
         serves the request; a replica that refuses (503 = draining) or
-        drops the connection is excluded and the next-best peer tried."""
+        drops the connection is excluded and the next-best peer tried.
+        ``lane`` prices overload: a sheddable lane that no replica has
+        headroom for is DROPPED with a 429 ``{"shed": true}`` while
+        interactive keeps the full backpressure budget — degradation by
+        policy, not by accident."""
+        if lane not in self._lanes:
+            return 400, {"error": f"unknown lane {lane!r} "
+                                  f"(known: {', '.join(self._lanes)})"}
         prefix_key = tuple(tokens[:self._prefix_tokens])
         tried = set()
         while True:
-            replica = self._pick(session, prefix_key, tried)
+            replica = self._pick(session, prefix_key, tried, lane=lane)
             if replica is None:
+                if lane != "interactive" and self.pool.admitting():
+                    # capacity exists but not at this lane's admit
+                    # factor: shed rather than queue behind interactive
+                    with self.lock:
+                        self._lane_shed[lane] += 1
+                    return 429, {"shed": True, "lane": lane,
+                                 "error": "overload: lane shed; retry "
+                                          "with backoff"}
                 return 503, {"error": "no admitting replica; retry later"}
             tried.add(replica.id)
             with self.lock:
@@ -207,6 +244,8 @@ class RouterFront:
                 if session is not None:
                     self._session[session] = replica.id
                 self._prefix[prefix_key] = replica.id
+            with self.lock:
+                self._lane_outstanding[lane] += 1
             try:
                 out = self._post_json(
                     replica.url.rstrip("/") + "/generate",
@@ -215,6 +254,7 @@ class RouterFront:
                 with self.lock:
                     self._routed += 1
                     self._completed += 1
+                    self._lane_completed[lane] += 1
                 return 200, out
             except urllib.error.HTTPError as exc:
                 payload = _safe_json(exc)
@@ -239,6 +279,19 @@ class RouterFront:
                 with self.lock:
                     self._outstanding[replica.id] = max(
                         0, self._outstanding.get(replica.id, 1) - 1)
+                    self._lane_outstanding[lane] = max(
+                        0, self._lane_outstanding[lane] - 1)
+
+    def lane_stats(self):
+        """Per-lane counters for the ``/lanes`` view and the operator's
+        market arbiter (its HTTP demand adapter): the proxy's in-flight
+        count stands in for queue depth (this front holds no queue —
+        backpressure lives at the replicas)."""
+        with self.lock:
+            return {lane: {"queued": self._lane_outstanding[lane],
+                           "shed": self._lane_shed[lane],
+                           "completed": self._lane_completed[lane]}
+                    for lane in self._lanes}
 
     def _outstanding_on(self, replica):
         with self.lock:
@@ -246,7 +299,8 @@ class RouterFront:
 
     # ------------------------------------------------- streaming + splice
 
-    def generate_stream(self, tokens, max_new, session=None, emit=None):
+    def generate_stream(self, tokens, max_new, session=None, emit=None,
+                        lane="interactive"):
         """Relay a streamed generation with GLOBAL per-token sequence
         numbers; ``emit(event)`` writes one SSE event to the client.
         The relay makes upgrades invisible mid-stream: a replica's
@@ -263,7 +317,8 @@ class RouterFront:
         source = None               # (replica, local rid) to reattach
         while True:
             if source is None:
-                replica = self._pick(session, prefix_key, tried)
+                replica = self._pick(session, prefix_key, tried,
+                                     lane=lane)
                 if replica is None:
                     emit({"error": "no admitting replica; retry later"})
                     return 503
@@ -534,6 +589,11 @@ def make_handler(front, pool, hub, autoscaler=None):
                     }),
                 }
                 self._json(200, {"kind": "replicas", "data": data})
+            elif self.path == "/lanes":
+                self._json(200, {"kind": "lanes", "data": {
+                    "lanes": front.lane_stats(),
+                    "admitting": len(pool.admitting()),
+                }})
             elif self.path == "/metrics":
                 body = hub.render(prefix="tpu_router").encode()
                 self.send_response(200)
@@ -574,6 +634,7 @@ def make_handler(front, pool, hub, autoscaler=None):
                 tokens = [int(t) for t in req["tokens"]]
                 max_new = int(req.get("max_new", 32))
                 session = req.get("session")
+                lane = str(req.get("lane", "interactive"))
                 stream = bool(req.get("stream", False))
             except (KeyError, TypeError, ValueError) as exc:
                 self._json(400, {"error": f"bad request: {exc}"})
@@ -592,11 +653,13 @@ def make_handler(front, pool, hub, autoscaler=None):
 
                 try:
                     front.generate_stream(tokens, max_new,
-                                          session=session, emit=emit)
+                                          session=session, emit=emit,
+                                          lane=lane)
                 except (BrokenPipeError, ConnectionResetError):
                     pass    # client went away; nothing left to relay to
                 return
-            code, body = front.generate(tokens, max_new, session=session)
+            code, body = front.generate(tokens, max_new, session=session,
+                                        lane=lane)
             self._json(code, body)
 
     return Handler

@@ -53,15 +53,23 @@ accounting"):
 
     python cmd/status.py --profile --operator-url http://operator:8080
 
+``--market`` renders the CAPACITY ARBITER's view fetched from a running
+operator's ``/market`` endpoint (operator config with a ``market:``
+section): current lane queue depths, the slice ownership table, and the
+last N arbiter decisions with their burn-vs-goodput rationale
+(docs/capacity-market.md):
+
+    python cmd/status.py --market --operator-url http://operator:8080
+
 ``--json`` always emits one ``{"kind": <view>, "data": ...}`` envelope
 (kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``, ``replicas``,
-``profile``).
+``profile``, ``market``).
 
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
 gates and scripts can wait on it. ``--timeline``, ``--goodput``,
-``--slo``, ``--alerts``, ``--replicas``, and ``--profile`` always exit 0
-(except 2 when the endpoint is unreachable).
+``--slo``, ``--alerts``, ``--replicas``, ``--profile``, and
+``--market`` always exit 0 (except 2 when the endpoint is unreachable).
 """
 
 import argparse
@@ -518,6 +526,83 @@ def run_profile_view(args, fetch=fetch_view) -> int:
     return 0
 
 
+def render_market(data) -> str:
+    """The capacity arbiter's view: exchange rate, per-lane queue
+    depths, the slice ownership table, and the last N decisions with
+    their burn-vs-goodput rationale (docs/capacity-market.md)."""
+    lines = [f"exchange rate {data.get('rate', 0)} (serving pressure "
+             f"{data.get('pressure', 0):.2f} / training value "
+             f"{data.get('value', 0):.2f})  "
+             f"trades {data.get('trades', 0)}  "
+             f"returns {data.get('returns', 0)}"]
+    lanes = data.get("lanes")
+    if lanes:
+        headers = ("LANE", "QUEUED", "SHED", "COMPLETED")
+        table = [(lane, str(s.get("queued", 0)), str(s.get("shed", 0)),
+                  str(s.get("completed", 0)))
+                 for lane, s in lanes.items()]
+        widths = [max(len(h), *(len(t[i]) for t in table))
+                  for i, h in enumerate(headers)]
+        lines.append("")
+        lines.append("  ".join(h.ljust(w)
+                               for h, w in zip(headers, widths)))
+        for t in table:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(t, widths)))
+    else:
+        lines.append("(no lane demand wired — router unreachable or "
+                     "market running on SLO burn alone)")
+    ownership = data.get("ownership") or []
+    if ownership:
+        headers = ("SLICE", "OWNER", "PHASE", "NODES", "DECISION")
+        table = [(o["slice"], o["owner"], o.get("phase", "-"),
+                  ",".join(o.get("nodes") or []),
+                  f"#{o.get('decision_id', 0)}"
+                  + ("*" if o.get("stamp_pending") else ""))
+                 for o in ownership]
+        widths = [max(len(h), *(len(t[i]) for t in table))
+                  for i, h in enumerate(headers)]
+        lines.append("")
+        lines.append("  ".join(h.ljust(w)
+                               for h, w in zip(headers, widths)))
+        for t in table:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(t, widths)))
+        if any(o.get("stamp_pending") for o in ownership):
+            lines.append("(* durable stamp pending — retrying)")
+    decisions = data.get("decisions") or []
+    if decisions:
+        lines.append("")
+        lines.append(f"last {len(decisions)} decision(s):")
+        for d in decisions:
+            stamp = datetime.datetime.fromtimestamp(
+                d.get("t", 0), tz=datetime.timezone.utc).strftime(
+                "%Y-%m-%d %H:%M:%S")
+            lines.append(f"  #{d.get('id')} {stamp} {d.get('action')} "
+                         f"{d.get('slice')} rate={d.get('rate')} — "
+                         f"{d.get('reason')}")
+    else:
+        lines.append("")
+        lines.append("no decisions yet (the market holds)")
+    return "\n".join(lines)
+
+
+def run_market_view(args, fetch=fetch_view) -> int:
+    """--market: fetch the operator's /market envelope (exit 2 when the
+    endpoint is unreachable, like --profile)."""
+    try:
+        env = fetch(args.operator_url, "/market")
+    except Exception as exc:
+        print(f"error: cannot read {args.operator_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_market(env.get("data") or {}))
+    return 0
+
+
 def render_replicas(data) -> str:
     """One row per serving replica from the router's /replicas view."""
     replicas = data.get("replicas") or []
@@ -649,6 +734,10 @@ def main(argv=None, client=None, now=None) -> int:
                    help="render the tick flight recorder's last-tick "
                         "decomposition and critical path from a running "
                         "operator's /profile endpoint")
+    p.add_argument("--market", action="store_true",
+                   help="render the capacity arbiter's lane depths, "
+                        "slice ownership and recent decisions from a "
+                        "running operator's /market endpoint")
     p.add_argument("--replicas", action="store_true",
                    help="render the serving router's replica registry "
                         "from a running cmd/router.py")
@@ -662,6 +751,10 @@ def main(argv=None, client=None, now=None) -> int:
         # the replica registry is the router's HTTP view, never the
         # cluster's (the router owns the authoritative in-memory state)
         return run_replicas_view(args)
+    if args.market:
+        # the arbiter lives in the operator process; its ledger is the
+        # authoritative state, so this is an HTTP view like --profile
+        return run_market_view(args)
     if args.profile:
         # the flight recorder lives in the operator process; its ring is
         # the authoritative state, so this is an HTTP view too

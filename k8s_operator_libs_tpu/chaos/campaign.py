@@ -38,13 +38,15 @@ from ..core.leaderelection import LeaderElector
 from ..health.classifier import ClassifierConfig
 from ..health.monitor import HealthOptions
 from ..health.remediation import RemediationPolicy
+from ..market import (SERVING, TRAINING, CapacityArbiter, ManagedSlice,
+                      MarketConfig)
 from ..obs.goodput import GoodputLedger
 from ..obs.metrics import MetricsHub
 from ..obs.profile import TickProfiler, counting_client
 from ..obs.slo import SLOOptions
 from ..obs.trace import Tracer
 from ..serving.pool import DRAIN_STATES, Replica, ReplicaPool
-from ..serving.router import RequestRouter
+from ..serving.router import LANES, RequestRouter
 from ..serving.sim import SimReplicaRuntime, sim_tokens
 from ..tpu.operator import ManagedComponent, TPUOperator
 from ..tpu.topology import (GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL,
@@ -52,7 +54,7 @@ from ..tpu.topology import (GKE_ACCELERATOR_LABEL, GKE_NODEPOOL_LABEL,
 from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import FakeClock
-from ..wire import QUARANTINE_LABEL
+from ..wire import MARKET_OWNER_LABEL, QUARANTINE_LABEL
 from .faults import RECLAIM_TAINT_KEY
 from .injector import ChaosInjector
 from .invariants import (CampaignView, Invariant, Violation,
@@ -161,9 +163,11 @@ def _make_operator(client, recorder, clock, max_unavailable: str,
 class SimJob:
     """The campaign's simulated checkpoint-resume workload, pinned to one
     node: it drain-saves and exits (``preempted=True``) when its node is
-    cordoned OR carries a spot-reclaim taint, and resumes — continuing
-    the SAME ledger file — once the node returns. Its ledger is what the
-    attribution invariant sums against the node's journey."""
+    cordoned, carries a spot-reclaim taint, OR is traded away by the
+    capacity market (its ``tpu.dev/market.owner`` label leaves
+    ``training``), and resumes — continuing the SAME ledger file — once
+    the node returns. Its ledger is what the attribution invariant sums
+    against the node's journey."""
 
     def __init__(self, path: str, node_name: str, clock):
         self.path = path
@@ -181,7 +185,9 @@ class SimJob:
             return
         preempt = (node.spec.unschedulable
                    or any(t.key == RECLAIM_TAINT_KEY
-                          for t in node.spec.taints))
+                          for t in node.spec.taints)
+                   or node.metadata.labels.get(
+                       MARKET_OWNER_LABEL, "training") != "training")
         if self.running and preempt:
             with self.ledger.phase("drain_save"):
                 self.clock.sleep(1.0)
@@ -235,6 +241,10 @@ class ServingTier:
     """
 
     MAX_REQUESTS = 400
+    # separate budget for flash-crowd arrivals so a long spike is
+    # bounded work (the campaign must converge once windows close)
+    MAX_CROWD = 600
+    SHED_HIGH = 48
 
     def __init__(self, cluster: FakeCluster, clock, injector: ChaosInjector,
                  fleet, seed: int):
@@ -247,7 +257,8 @@ class ServingTier:
                                 clock=clock)
         self.pool.scrape_gate = self._scrape_gate
         self.router = RequestRouter(self.pool, metrics=self.metrics,
-                                    clock=clock)
+                                    clock=clock,
+                                    shed_high=self.SHED_HIGH)
         # live-migration transfer gate: the kv-transfer-flake fault
         # fails payload transfers touching its target nodes, driving
         # the router's bounded retry/backoff and the degraded fallback
@@ -257,6 +268,13 @@ class ServingTier:
         self.current: Dict[str, str] = {}
         self._gen = 0
         self.submitted = 0
+        self.crowd_submitted = 0
+        # market-granted burst replica (on the traded training node) and
+        # the CURRENT leader's arbiter (run_scenario refreshes it each
+        # tick — the tier re-grants a killed burst replica only while
+        # the ledger still says the slice is lent)
+        self.burst: Optional[str] = None
+        self.arbiter: Optional[CapacityArbiter] = None
         for node in self.slice_nodes:
             self._spawn(node)
 
@@ -316,6 +334,27 @@ class ServingTier:
                 if replica is not None:
                     self.pool.deregister(replica.id)
                 self._spawn(node)
+        # the kill windows hit the market's burst replica like any other
+        burst = self.pool.replicas.get(self.burst) if self.burst else None
+        if burst is not None and burst.runtime.alive():
+            if burst.node_name in killed or (
+                    burst.node_name in ms_kill
+                    and getattr(burst.runtime, "busy", False)):
+                burst.runtime.fail()
+        # while the ledger still lends the slice, a dead burst replica
+        # respawns as a new generation once its node heals
+        if self.arbiter is not None:
+            for ms in self.arbiter.supply:
+                if ms.phase != SERVING:
+                    continue
+                replica = (self.pool.replicas.get(self.burst)
+                           if self.burst else None)
+                if (replica is None or replica.failed) \
+                        and ms.anchor not in down \
+                        and self._node_clean(ms.anchor):
+                    if replica is not None:
+                        self.pool.deregister(replica.id)
+                    self.grant_burst(ms)
         # pod-side drain backstop BEFORE the router ticks
         for replica in list(self.pool.replicas.values()):
             if replica.failed or replica.draining:
@@ -328,12 +367,64 @@ class ServingTier:
                 prompt = [self.rng.randrange(32000)
                           for _ in range(self.rng.randint(2, 6))]
                 self.router.submit(prompt, self.rng.randint(2, 8),
-                                   session=f"s{self.rng.randrange(8)}")
+                                   session=f"s{self.rng.randrange(8)}",
+                                   lane=self.rng.choice(LANES))
                 self.submitted += 1
+        # flash crowd: the seeded open-loop arrival spike (bounded by
+        # MAX_CROWD so the campaign always converges once windows close)
+        crowd = self.injector.flash_crowd_rate()
+        if crowd and self.pool.admitting():
+            take = min(crowd, self.MAX_CROWD - self.crowd_submitted)
+            for _ in range(max(0, take)):
+                prompt = [self.rng.randrange(32000)
+                          for _ in range(self.rng.randint(2, 6))]
+                self.router.submit(prompt, self.rng.randint(2, 8),
+                                   lane=self.rng.choice(LANES))
+                self.crowd_submitted += 1
         self.router.tick()
         for replica in self.pool.replicas.values():
             if not replica.failed:
                 replica.runtime.step()
+
+    # ------------------------------------------------------ market hooks
+
+    def grant_burst(self, ms) -> None:
+        """Market ``grant`` hook: the traded training slice hosts a
+        serving burst replica (a NEW generation each grant)."""
+        self._gen += 1
+        replica = Replica(f"replica-{ms.anchor}-m{self._gen}", ms.anchor,
+                          SimReplicaRuntime(max_slots=4))
+        self.pool.register(replica)
+        self.burst = replica.id
+
+    def revoke_burst(self, ms) -> bool:
+        """Market ``revoke`` hook: drain the burst replica through the
+        router (zero loss — in-flight work live-migrates to peers);
+        True once the slice is clear of serving."""
+        replica = (self.pool.replicas.get(self.burst)
+                   if self.burst else None)
+        if replica is None:
+            self.burst = None
+            return True
+        if replica.failed:
+            self.pool.deregister(replica.id)
+            self.burst = None
+            return True
+        if not replica.draining:
+            self.router.drain_replica(replica, "market-return")
+        if replica.drained:
+            self.pool.deregister(replica.id)
+            self.burst = None
+            return True
+        return False
+
+    def market_settled(self) -> bool:
+        """Convergence gate: no burst replica left and every managed
+        slice back with training."""
+        if self.burst is not None:
+            return False
+        return self.arbiter is None or all(
+            ms.phase == TRAINING for ms in self.arbiter.supply)
 
     def healthy(self) -> bool:
         """Convergence gate: every slice hosts a live, admitting replica
@@ -406,12 +497,31 @@ def run_scenario(scenario: Scenario, seed: int,
     if workdir is None:
         tmp = tempfile.TemporaryDirectory(prefix="chaos-campaign-")
         workdir = tmp.name
+    # the training job runs on the LAST host of slice 0; the serving
+    # replicas sit on each slice's FIRST host — the capacity market
+    # trades the training node between the two without ever putting both
+    # workloads on one host
     job = SimJob(os.path.join(workdir, "goodput.jsonl"),
-                 scenario.fleet.slice_hosts(0)[0], clock)
+                 scenario.fleet.slice_hosts(0)[-1], clock)
     tier = ServingTier(cluster, clock, injector, scenario.fleet, seed)
     checks = invariants if invariants is not None else default_invariants()
     budget = scaled_int_or_percent(scenario.max_unavailable,
                                    len(fleet_nodes), round_up=True)
+    # one capacity arbiter per candidate, like the operators: only the
+    # leader ticks, standbys resume mid-trade from the durable
+    # tpu.dev/market.* annotations after a failover
+    arbiters: Dict[str, CapacityArbiter] = {}
+    for identity, _elector, _op in candidates:
+        arbiters[identity] = CapacityArbiter(
+            [ManagedSlice("market-train", [job.node_name])],
+            client=injector.client(identity), component=COMPONENT,
+            demand=tier.router, goodput_fn=lambda: 1.0,
+            vacated=lambda ms: not job.running,
+            grant=tier.grant_burst, revoke=tier.revoke_burst,
+            recorder=cluster.recorder, clock=clock,
+            config=MarketConfig(preempt_rate=1.5, return_rate=0.4,
+                                sustain_ticks=3, cooldown_seconds=60.0,
+                                budget=budget))
     violations: List[Violation] = []
     bumped = scenario.upgrade_at is None
     prev_leader: Optional[str] = None
@@ -447,6 +557,17 @@ def run_scenario(scenario: Scenario, seed: int,
             # window closed AND the rollout fired — outstanding work then
             # drains, which the convergence gate requires
             tier.tick(active=not (bumped and injector.quiet()))
+            # the capacity market ticks under the CURRENT leader only;
+            # standbys forget in-memory trade state so a promotion
+            # resumes from the durable annotations mid-trade
+            leader_arbiter = (arbiters.get(leaders[0])
+                             if len(leaders) == 1 else None)
+            for arb in arbiters.values():
+                if arb is leader_arbiter:
+                    tier.arbiter = arb
+                    arb.tick()
+                else:
+                    arb.standby()
             for hook in hooks or []:
                 hook(cluster=cluster, clock=clock, keys=keys, tick=tick)
             nodes = {n.metadata.name: n
@@ -461,7 +582,7 @@ def run_scenario(scenario: Scenario, seed: int,
                               for identity, _, op in candidates},
                 ledger_path=job.path, workload_node=job.node_name,
                 tick_seconds=scenario.tick_seconds,
-                router=tier.router)
+                router=tier.router, market=leader_arbiter)
             for inv in checks:
                 violations.extend(inv.check(view))
             if violations and stop_on_violation:
@@ -470,6 +591,7 @@ def run_scenario(scenario: Scenario, seed: int,
             # or any fault window is still ahead — a healthy t=0 fleet is
             # not a survived scenario
             if bumped and injector.quiet() and tier.healthy() \
+                    and tier.market_settled() \
                     and _converged(
                         cluster, keys, nodes,
                         bumped=scenario.upgrade_at is not None, job=job):
@@ -491,15 +613,18 @@ def run_scenario(scenario: Scenario, seed: int,
         violations=violations, trace=list(injector.trace),
         failovers=failovers,
         router_stats={
-            "submitted": tier.submitted,
+            "submitted": tier.submitted + tier.crowd_submitted,
             "completed": sum(
                 1 for r in tier.router.requests.values()
                 if r.state == "completed"),
+            "shed": sum(tier.router._lane_shed.values()),
             "rerouted": tier.router._rerouted,
             "drains": len(tier.router.drains),
             "generations": tier._gen,
             "migrations": tier.router.migration_successes,
             "migration_fallbacks": tier.router.migration_fallbacks,
+            "market_trades": sum(a.trades for a in arbiters.values()),
+            "market_returns": sum(a.returns for a in arbiters.values()),
         },
         profile_payloads={identity: p.payload()
                           for identity, p in profilers.items()} or None)

@@ -44,6 +44,14 @@ The catalog (docs/chaos.md has the full fault semantics):
                        bounded retry/backoff must absorb the flake or
                        fall back to degraded re-prefill — never a lost
                        or corrupted stream)
+``flash-crowd``        a seeded open-loop arrival-rate spike against the
+                       ServingTier (requests/tick across all QoS lanes
+                       for the window) — overload must degrade by
+                       policy: best-effort lanes shed first, interactive
+                       queue wait stays bounded, and sustained pressure
+                       may drive the capacity arbiter to preempt a
+                       training slice (the market-conservation
+                       invariant holds through the trade)
 """
 
 from __future__ import annotations
@@ -69,6 +77,7 @@ FAULT_TYPES = (
     "metrics-flake",
     "mid-stream-kill",
     "kv-transfer-flake",
+    "flash-crowd",
 )
 
 # Spot/preemption reclaim notice wire contract: the cloud (or the chaos

@@ -192,6 +192,13 @@ class ChaosInjector:
                     return True
         return False
 
+    def flash_crowd_rate(self) -> int:
+        """Extra requests/tick the ServingTier must submit right now —
+        the sum of every active flash-crowd window's arrival spike (the
+        demand side of the capacity market under stress)."""
+        return sum(int(ev.params.get("requests_per_tick", 8))
+                   for ev in self._active("flash-crowd"))
+
     def quiet(self) -> bool:
         """True once every scheduled fault window has closed and every
         heal has run — the campaign requires this before convergence."""

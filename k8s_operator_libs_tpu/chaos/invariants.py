@@ -36,8 +36,10 @@ The catalog (:data:`INVARIANT_NAMES`):
                       their window exactly.
 ``router-exactly-once``  every request submitted to the serving router
                       is always in exactly one of queued / assigned /
-                      completed and is DELIVERED at most once — across
-                      drain handoffs, replica kills, and reroutes.
+                      completed / shed and is DELIVERED at most once —
+                      across drain handoffs, replica kills, and
+                      reroutes; a shed request is terminal (never also
+                      delivered, never from the interactive lane).
 ``router-admission``  the router never places a request on a replica
                       whose node is cordoned, quarantined, or
                       reclaim-tainted (checked against cluster truth at
@@ -49,6 +51,14 @@ The catalog (:data:`INVARIANT_NAMES`):
                       equals its delivered result, and no replayed
                       token ever differed from what the client already
                       saw.
+``market-conservation``  every slice the capacity arbiter manages is
+                      owned by exactly one of training / serving /
+                      draining / quarantined each tick, owner labels on
+                      a slice's members never disagree once stamped, no
+                      node is claimed by two managed slices, and a
+                      trade is never initiated that would push cordoned
+                      + cordon-required nodes past the maxUnavailable
+                      budget (the cordon-required lookahead included).
 
 :data:`FAULT_COVERAGE` maps every fault type to the invariants it
 stresses — CHS001 keeps it closed over ``FAULT_TYPES`` in both
@@ -76,6 +86,7 @@ INVARIANT_NAMES = (
     "attribution",
     "router-exactly-once",
     "router-admission",
+    "market-conservation",
     "router-stream-integrity",
 )
 
@@ -101,6 +112,8 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
                         "router-stream-integrity"),
     "kv-transfer-flake": ("router-stream-integrity",
                           "router-exactly-once"),
+    "flash-crowd": ("market-conservation", "router-exactly-once",
+                    "router-admission"),
 }
 
 # Legal pipeline edges (upgrade_state.py processing order + the failure
@@ -172,6 +185,10 @@ class CampaignView:
     # no serving tier); the router invariants read its bookkeeping —
     # requests, completed_counts, assignments_this_tick
     router: Optional[object] = None
+    # the CURRENT leader's CapacityArbiter (None when no market runs or
+    # no candidate holds the lease this tick); the market-conservation
+    # invariant reads its ownership ledger
+    market: Optional[object] = None
 
 
 class Invariant:
@@ -422,7 +439,8 @@ class RouterExactlyOnceInvariant(Invariant):
         live = {r.id for r in router.pool.replicas.values()
                 if not r.failed}
         for rid, req in router.requests.items():
-            if req.state not in ("queued", "assigned", "completed"):
+            if req.state not in ("queued", "assigned", "completed",
+                                 "shed"):
                 out.append(self._v(
                     view, f"request {rid} in unknown state "
                     f"{req.state!r} (lost)"))
@@ -430,6 +448,17 @@ class RouterExactlyOnceInvariant(Invariant):
                 out.append(self._v(
                     view, f"request {rid} assigned to dead replica "
                     f"{req.replica_id} and never re-placed (lost)"))
+            elif req.state == "shed":
+                # shedding is a terminal, policy-scoped drop: only the
+                # sheddable lanes may shed, and a shed request can never
+                # also have been delivered
+                if getattr(req, "lane", None) == "interactive":
+                    out.append(self._v(
+                        view, f"request {rid} on the protected "
+                        f"interactive lane was shed"))
+                if router.completed_counts.get(rid):
+                    out.append(self._v(
+                        view, f"request {rid} both shed and delivered"))
         return out
 
 
@@ -465,6 +494,95 @@ class RouterAdmissionInvariant(Invariant):
                 out.append(self._v(
                     view, f"request {rid} admitted to reclaim-tainted "
                     f"node {node_name} (replica {replica_id})"))
+        return out
+
+
+class MarketConservationInvariant(Invariant):
+    """Capacity-market conservation over the arbiter's ownership ledger
+    and the ``tpu.dev/market.owner`` labels in cluster truth:
+
+    - every managed slice's owner is exactly one of
+      training/serving/draining/quarantined;
+    - no node belongs to two managed slices;
+    - once a slice's durable stamp has landed (``stamp_pending`` False),
+      its members' owner labels never disagree with each other and
+      never carry an unknown value — a split label is a half-applied
+      trade two readers would interpret differently;
+    - at the tick a trade is INITIATED (a slice enters ``preempting``),
+      the nodes it takes out of training plus the operator's held nodes
+      (cordoned or admitted ``cordon-required``) fit the maxUnavailable
+      budget — the market never overdraws capacity the upgrade pipeline
+      already spoke for.
+
+    Stateful: phase transitions are detected against the previous tick,
+    so the budget clause prices initiation, not steady state (the
+    operator may legitimately cordon more nodes after a trade began —
+    the router then drains the lent replica through the normal path)."""
+
+    name = "market-conservation"
+
+    def __init__(self):
+        self._prev_phase: Dict[str, str] = {}
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        market = view.market
+        if market is None:
+            return []
+        from ..market.arbiter import LEGAL_OWNERS
+        from ..wire import MARKET_OWNER_LABEL
+        out: List[Violation] = []
+        claimed: Dict[str, str] = {}
+        for entry in market.ownership():
+            slice_id = entry["slice"]
+            owner = entry["owner"]
+            phase = entry.get("phase", owner)
+            nodes = entry["nodes"]
+            if owner not in LEGAL_OWNERS:
+                out.append(self._v(
+                    view, f"slice {slice_id} owned by unknown party "
+                    f"{owner!r} (legal: {', '.join(LEGAL_OWNERS)})"))
+            for name in nodes:
+                if name in claimed:
+                    out.append(self._v(
+                        view, f"node {name} claimed by managed slices "
+                        f"{claimed[name]} AND {slice_id}"))
+                claimed[name] = slice_id
+            labels = {}
+            for name in nodes:
+                node = view.nodes.get(name)
+                if node is None:
+                    continue
+                value = node.metadata.labels.get(MARKET_OWNER_LABEL)
+                if value:
+                    labels[name] = value
+                    if value not in LEGAL_OWNERS:
+                        out.append(self._v(
+                            view, f"node {name} carries unknown market "
+                            f"owner label {value!r}"))
+            if not entry.get("stamp_pending") and len(set(
+                    labels.values())) > 1:
+                out.append(self._v(
+                    view, f"slice {slice_id} members disagree on the "
+                    f"market owner label: {labels} (split trade)"))
+            prev = self._prev_phase.get(slice_id)
+            if phase == "preempting" and prev != "preempting":
+                members = set(nodes)
+                held = 0
+                for name, node in view.nodes.items():
+                    if name in members:
+                        continue
+                    state = node.metadata.labels.get(
+                        view.keys.state_label, "")
+                    if (node.spec.unschedulable
+                            or state == UpgradeState.CORDON_REQUIRED):
+                        held += 1
+                if held + len(nodes) > view.budget:
+                    out.append(self._v(
+                        view, f"trade of slice {slice_id} initiated "
+                        f"with {held} nodes already held by the "
+                        f"operator + {len(nodes)} traded > "
+                        f"maxUnavailable budget {view.budget}"))
+            self._prev_phase[slice_id] = phase
         return out
 
 
@@ -536,5 +654,6 @@ def default_invariants() -> List[Invariant]:
         AttributionInvariant(),
         RouterExactlyOnceInvariant(),
         RouterAdmissionInvariant(),
+        MarketConservationInvariant(),
         RouterStreamIntegrityInvariant(),
     ]

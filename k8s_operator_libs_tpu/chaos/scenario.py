@@ -207,6 +207,20 @@ def _parse_mid_stream_kill(entry, fleet) -> FaultEvent:
                       **w)
 
 
+def _parse_flash_crowd(entry, fleet) -> FaultEvent:
+    # pure traffic, no node targets: the ServingTier submits an extra
+    # requestsPerTick requests (seeded lane mix) while the window is open
+    w = _window(entry, 120.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("flash-crowd: duration must be positive")
+    rate = int(entry.get("requestsPerTick", 8))
+    if rate <= 0:
+        raise ScenarioError("flash-crowd: requestsPerTick must be "
+                            "positive")
+    return FaultEvent("flash-crowd", params={"requests_per_tick": rate},
+                      **w)
+
+
 def _parse_kv_transfer_flake(entry, fleet) -> FaultEvent:
     w = _window(entry, 90.0)
     if w["duration"] <= 0:
@@ -232,6 +246,7 @@ FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
     "metrics-flake": _parse_metrics_flake,
     "mid-stream-kill": _parse_mid_stream_kill,
     "kv-transfer-flake": _parse_kv_transfer_flake,
+    "flash-crowd": _parse_flash_crowd,
 }
 
 
@@ -318,6 +333,9 @@ def random_scenario(seed: int) -> Scenario:
                          slices=sorted(rng.sample(
                              range(fleet["slices"]),
                              k=rng.randint(1, fleet["slices"]))))
+        elif ftype == "flash-crowd":
+            entry.update(duration=rng.choice([120.0, 180.0]),
+                         requestsPerTick=rng.choice([6, 10]))
         # leader-loss needs no params: the injector partitions whoever
         # holds the lease when the fault lands
         faults.append(entry)

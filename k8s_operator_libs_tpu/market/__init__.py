@@ -1,0 +1,27 @@
+"""k8s_operator_libs_tpu.market — the training↔serving capacity market.
+
+The arbiter that closes the loop ROADMAP item 5 left open: serving
+traffic peaks preempt training slices (drain-save → elastic shrink,
+priced as ``degraded`` goodput), troughs return them (elastic grow —
+the shrink path in reverse), with the exchange rate set by SLO burn
+rate versus marginal goodput and every decision durable in the
+``tpu.dev/market.*`` wire contract so a leader failover resumes
+mid-trade. See docs/capacity-market.md.
+
+Layering: ``market`` sits above ``serving``/``obs``/``tpu`` (it prices
+the router's lanes and the SLO engine's burn, and guards trades against
+the upgrade pipeline) and below ``chaos`` (the campaign drives it under
+injected faults with the ``market-conservation`` invariant standing).
+"""
+
+from .arbiter import (LEGAL_OWNERS, OWNER_LABELS, PHASES, PREEMPTING,
+                      RETURNING, SERVING, TRAINING, CapacityArbiter,
+                      ManagedSlice, MarketConfig, marginal_goodput)
+from .metrics import MARKET_GAUGE_FAMILIES, MARKET_PREFIX
+
+__all__ = [
+    "CapacityArbiter", "LEGAL_OWNERS", "ManagedSlice",
+    "MARKET_GAUGE_FAMILIES", "MARKET_PREFIX", "MarketConfig",
+    "OWNER_LABELS", "PHASES", "PREEMPTING", "RETURNING", "SERVING",
+    "TRAINING", "marginal_goodput",
+]

@@ -1,0 +1,29 @@
+"""The closed ``tpu_market_*`` metric-family table.
+
+Every family the capacity arbiter emits is declared here as a plain
+string literal, exactly like ``serving/metrics.py``'s router tables: the
+OBS003 lint pass (``tools/lint/obs_check.py``) closes this tuple over
+the shared HELP registry (``obs/metrics.py::HELP_TEXTS``) in both
+directions — an emitted family with no HELP entry fires, and a
+``tpu_market_*`` HELP entry matching no family here is a renamed or
+removed gauge seen from the catalog side.
+
+The arbiter's :class:`~..obs.metrics.MetricsHub` renders under
+:data:`MARKET_PREFIX`, a fourth disjoint namespace next to
+``tpu_operator_*`` / ``tpu_workload_*`` / ``tpu_router_*``.
+"""
+
+from __future__ import annotations
+
+MARKET_PREFIX = "tpu_market"
+
+# gauge families the arbiter emits through the hub (full exposed names;
+# literal — OBS003 closes this over HELP_TEXTS both ways)
+MARKET_GAUGE_FAMILIES = (
+    "tpu_market_exchange_rate",
+    "tpu_market_serving_pressure",
+    "tpu_market_training_value",
+    "tpu_market_trades",
+    "tpu_market_returns",
+    "tpu_market_slices_lent",
+)

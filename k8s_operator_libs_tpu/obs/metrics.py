@@ -292,6 +292,38 @@ HELP_TEXTS: Dict[str, str] = {
         "(per-request migration downtime contribution)",
     "tpu_router_migration_transfer_bytes":
         "Serialized KV payload bytes per successful migration transfer",
+    # per-tenant QoS lane families (serving/router.py weighted fair
+    # queueing + overload shedding — docs/capacity-market.md)
+    "tpu_router_lane_queue_depth":
+        "Requests queued at the router by QoS lane (interactive / "
+        "batch / best-effort)",
+    "tpu_router_lane_shed":
+        "Requests dropped by overload shedding since router start, by "
+        "lane (best-effort sheds first; interactive never sheds)",
+    "tpu_router_lane_completed":
+        "Requests delivered since router start, by QoS lane",
+    "tpu_router_lane_queue_wait_seconds":
+        "Seconds a request waited at the router before its first "
+        "placement, by QoS lane (the per-tenant queueing SLI)",
+    # capacity-market families (market/arbiter.py — the SLO-priced
+    # exchange between training and serving; OBS003 closes these over
+    # the MARKET_GAUGE_FAMILIES table both ways)
+    "tpu_market_exchange_rate":
+        "Serving pressure divided by marginal training value — the "
+        "price at which the arbiter trades slices (docs/"
+        "capacity-market.md)",
+    "tpu_market_serving_pressure":
+        "Demand-side pressure: the worse of the serving SLO burn-rate "
+        "multiple and the lane-weighted router backlog",
+    "tpu_market_training_value":
+        "Supply-side marginal value: normalized goodput one training "
+        "slice contributes (from the goodput ledger)",
+    "tpu_market_trades":
+        "Training slices preempted to serving since arbiter start",
+    "tpu_market_returns":
+        "Traded slices returned to training since arbiter start",
+    "tpu_market_slices_lent":
+        "Managed slices currently owned by serving (lent or mid-trade)",
 }
 
 # ratio-valued histograms (occupancy, utilization) need sub-1.0 buckets —

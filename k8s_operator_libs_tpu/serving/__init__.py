@@ -43,12 +43,15 @@ from .metrics import (ROUTER_GAUGE_FAMILIES, ROUTER_HISTOGRAM_FAMILIES,
                       ROUTER_PREFIX)
 from .pool import (BatcherRuntime, NodeState, Replica, ReplicaPool,
                    parse_gauges)
-from .router import DRAIN_STATES, RequestRouter, RouterRequest
+from .router import (DEFAULT_LANE, DRAIN_STATES, LANE_WEIGHTS, LANES,
+                     SHED_ORDER, RequestRouter, RouterRequest)
 from .sim import SimReplicaRuntime, sim_tokens
 
 __all__ = [
-    "Autoscaler", "AutoscalerConfig", "BatcherRuntime", "DRAIN_STATES",
-    "NodeState", "Replica", "ReplicaPool", "RequestRouter",
+    "Autoscaler", "AutoscalerConfig", "BatcherRuntime", "DEFAULT_LANE",
+    "DRAIN_STATES", "LANES", "LANE_WEIGHTS", "NodeState", "Replica",
+    "ReplicaPool", "RequestRouter",
     "ROUTER_GAUGE_FAMILIES", "ROUTER_HISTOGRAM_FAMILIES", "ROUTER_PREFIX",
-    "RouterRequest", "SimReplicaRuntime", "parse_gauges", "sim_tokens",
+    "RouterRequest", "SHED_ORDER", "SimReplicaRuntime", "parse_gauges",
+    "sim_tokens",
 ]

@@ -86,10 +86,17 @@ class Autoscaler:
                  release: Optional[Callable[[Replica], None]] = None,
                  recorder=None, metrics=None,
                  clock: Optional[Clock] = None,
-                 config: Optional[AutoscalerConfig] = None):
+                 config: Optional[AutoscalerConfig] = None,
+                 market=None):
         self.pool = pool
         self.router = router
         self.slo_engine = slo_engine
+        # the capacity market's supply side (a CapacityArbiter, duck-
+        # typed to ``leased_slice_ids() -> set``): scale-up placement
+        # prefers slices the arbiter traded away from training — the
+        # tpu.dev/market.* lease contract's consumer
+        # (docs/capacity-market.md)
+        self.market = market
         self.scheduler = scheduler
         self.workload_template = workload_template
         self.replica_factory = replica_factory
@@ -195,8 +202,17 @@ class Autoscaler:
             workload = dataclasses.replace(
                 self.workload_template,
                 name=f"{self.workload_template.name}-{self._placements}")
+            leased = set()
+            if self.market is not None:
+                try:
+                    leased = set(self.market.leased_slice_ids())
+                except Exception:
+                    logger.warning("market lease lookup failed; placing "
+                                   "without preference", exc_info=True)
             try:
-                placement = self.scheduler.place(workload)
+                placement = self.scheduler.place(
+                    workload,
+                    prefer=(leased.__contains__ if leased else None))
             except Exception:
                 logger.exception("scale-up slice placement raised")
                 placement = None

@@ -36,6 +36,10 @@ ROUTER_GAUGE_FAMILIES = (
     "tpu_router_migration_attempts",
     "tpu_router_migration_success",
     "tpu_router_migration_fallbacks",
+    # per-tenant QoS lanes (labelled by lane — docs/capacity-market.md)
+    "tpu_router_lane_queue_depth",
+    "tpu_router_lane_shed",
+    "tpu_router_lane_completed",
 )
 
 # histogram families (bucket ladders from obs/metrics.py)
@@ -44,4 +48,5 @@ ROUTER_HISTOGRAM_FAMILIES = (
     "tpu_router_replica_queue_depth",
     "tpu_router_migration_transfer_seconds",
     "tpu_router_migration_transfer_bytes",
+    "tpu_router_lane_queue_wait_seconds",
 )

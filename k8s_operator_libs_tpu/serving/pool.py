@@ -54,9 +54,10 @@ from typing import Callable, Dict, List, Optional
 from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import Clock, RealClock
-from ..wire import (KV_PAYLOAD_VERSION_ANNOTATION, QUARANTINE_LABEL,
-                    RECLAIM_TAINT_KEY, REPLICA_ENDPOINT_ANNOTATION,
-                    REPLICA_ID_LABEL, REPLICA_WEIGHT_LABEL)
+from ..wire import (KV_PAYLOAD_VERSION_ANNOTATION, LANE_LABEL,
+                    QUARANTINE_LABEL, RECLAIM_TAINT_KEY,
+                    REPLICA_ENDPOINT_ANNOTATION, REPLICA_ID_LABEL,
+                    REPLICA_WEIGHT_LABEL)
 
 logger = logging.getLogger(__name__)
 
@@ -133,7 +134,8 @@ class Replica:
     """One registered serving replica: a runtime adapter on a node."""
 
     def __init__(self, replica_id: str, node_name: str, runtime,
-                 url: Optional[str] = None, weight: float = 1.0):
+                 url: Optional[str] = None, weight: float = 1.0,
+                 lane: Optional[str] = None):
         if weight <= 0:
             raise ValueError(f"replica {replica_id}: weight must be "
                              f"positive, got {weight}")
@@ -142,6 +144,10 @@ class Replica:
         self.runtime = runtime
         self.url = url
         self.weight = float(weight)
+        # QoS lane this replica is DEDICATED to (None = serves every
+        # lane); mirrored to the node as the LANE_LABEL so a restarted
+        # router rebuilds lane-reserved capacity from the cluster
+        self.lane = lane
         self.stats = ReplicaStats()
         self.draining = False       # router-side admission stop
         self.drain_reason: Optional[str] = None
@@ -152,7 +158,8 @@ class Replica:
     def describe(self) -> Dict[str, object]:
         return {
             "id": self.id, "node": self.node_name, "url": self.url,
-            "weight": self.weight, "draining": self.draining,
+            "weight": self.weight, "lane": self.lane,
+            "draining": self.draining,
             "drain_reason": self.drain_reason, "failed": self.failed,
             "drained": self.drained,
             "queue_depth": self.stats.queue_depth,
@@ -207,11 +214,13 @@ class ReplicaPool:
                 # wire version this replica speaks, in the cluster
                 annotations[KV_PAYLOAD_VERSION_ANNOTATION] = \
                     str(int(payload_version))
+            labels = {REPLICA_ID_LABEL: replica.id,
+                      REPLICA_WEIGHT_LABEL: f"{replica.weight:g}"}
+            if replica.lane is not None:
+                labels[LANE_LABEL] = replica.lane
             try:
                 self._client.patch_node_metadata(
-                    replica.node_name,
-                    labels={REPLICA_ID_LABEL: replica.id,
-                            REPLICA_WEIGHT_LABEL: f"{replica.weight:g}"},
+                    replica.node_name, labels=labels,
                     annotations=annotations or None)
             except Exception:
                 # in-memory registry stays authoritative; the mirror is
@@ -228,7 +237,8 @@ class ReplicaPool:
                 self._client.patch_node_metadata(
                     replica.node_name,
                     labels={REPLICA_ID_LABEL: None,
-                            REPLICA_WEIGHT_LABEL: None},
+                            REPLICA_WEIGHT_LABEL: None,
+                            LANE_LABEL: None},
                     annotations={REPLICA_ENDPOINT_ANNOTATION: None,
                                  KV_PAYLOAD_VERSION_ANNOTATION: None})
             except Exception:

@@ -46,6 +46,24 @@ logger = logging.getLogger(__name__)
 QUEUED = "queued"
 ASSIGNED = "assigned"
 COMPLETED = "completed"
+# terminal state of a request dropped by overload shedding: never placed
+# on a runtime, never delivered — the exactly-once ledger accounts it in
+# exactly one of {completed, shed}, never both
+SHED = "shed"
+
+# Per-tenant QoS lanes, highest priority first. The SAME table prices
+# both sides of the capacity market: the router's demand-side weighted
+# fair queueing and overload shedding read it, and the arbiter's
+# supply-side exchange rate (market/arbiter.py) weighs lane backlog by
+# it — so a best-effort flood can neither starve interactive traffic nor
+# preempt a training slice the way an interactive burn can.
+LANES = ("interactive", "batch", "best-effort")
+LANE_WEIGHTS = {"interactive": 4.0, "batch": 2.0, "best-effort": 1.0}
+# overload shedding sacrifices lanes in this order; interactive is
+# deliberately absent — it is never shed, it is what the market trades
+# training capacity to protect
+SHED_ORDER = ("best-effort", "batch")
+DEFAULT_LANE = "interactive"
 
 # placement priorities: a request re-prefilling from its prompt after a
 # failed migration runs `degraded` — it yields placement to normal
@@ -75,6 +93,12 @@ class RouterRequest:
     handoffs: int = 0          # times re-placed (drain or crash)
     priority: str = NORMAL     # DEGRADED after a migration fallback
     migrations: int = 0        # successful live KV migrations
+    lane: str = DEFAULT_LANE   # QoS lane (LANES member)
+    shed_t: Optional[float] = None   # when overload shedding dropped it
+    queue_wait_s: Optional[float] = None  # submit -> FIRST placement
+    # weighted-fair-queueing finish tag: requests place in tag order, so
+    # backlogged lanes interleave in proportion to LANE_WEIGHTS
+    wfq_tag: float = 0.0
     # the client-visible token stream: stream[i] is the request's i-th
     # generated token, appended exactly once (gapless, duplicate-free —
     # the router-stream-integrity invariant); stream_log records the
@@ -98,7 +122,8 @@ class RequestRouter:
                  clock: Optional[Clock] = None, queue_high: float = 8.0,
                  transfer_retries: int = 3,
                  transfer_backoff_s: float = 0.25,
-                 transfer_backoff_cap_s: float = 2.0):
+                 transfer_backoff_cap_s: float = 2.0,
+                 shed_high: Optional[float] = None):
         self.pool = pool
         self._metrics = metrics
         self._clock = clock or RealClock()
@@ -113,9 +138,21 @@ class RequestRouter:
         # raising models a failed/flaky payload transfer (the
         # kv-transfer-flake fault plugs in here)
         self.transfer_gate = None
+        # overload shedding: while more than ``shed_high`` requests are
+        # queued after placement, the backlog sheds from the lowest
+        # priority lane up (SHED_ORDER; interactive never sheds). None =
+        # shedding off — requests queue without bound, the pre-lane
+        # behavior
+        self.shed_high = None if shed_high is None else float(shed_high)
         self.requests: Dict[int, RouterRequest] = {}
         self._next_rid = 0
-        self._queue: List[int] = []                 # FIFO of queued rids
+        self._queue: List[int] = []                 # queued rids
+        # weighted fair queueing state: per-lane virtual finish time and
+        # the served virtual clock (advances as queued work places)
+        self._lane_vtime: Dict[str, float] = {lane: 0.0 for lane in LANES}
+        self._vclock = 0.0
+        self._lane_shed: Dict[str, int] = {lane: 0 for lane in LANES}
+        self._lane_completed: Dict[str, int] = {lane: 0 for lane in LANES}
         self._local2global: Dict[Tuple[str, int], int] = {}
         self._session_map: Dict[str, str] = {}      # session -> replica id
         self._prefix_map: Dict[Tuple[int, ...], str] = {}
@@ -139,15 +176,29 @@ class RequestRouter:
     # ------------------------------------------------------------ submit
 
     def submit(self, prompt, max_new: int,
-               session: Optional[str] = None) -> int:
-        """Accept a request; it places immediately when a replica has
-        headroom, otherwise queues until :meth:`tick` finds one."""
+               session: Optional[str] = None,
+               lane: str = DEFAULT_LANE) -> int:
+        """Accept a request on a QoS ``lane``; it places immediately
+        when a replica has headroom, otherwise queues (weighted-fair
+        across lanes) until :meth:`tick` finds one."""
+        if lane not in LANES:
+            raise ValueError(f"unknown QoS lane {lane!r} "
+                             f"(known: {', '.join(LANES)})")
         rid = self._next_rid
         self._next_rid += 1
         req = RouterRequest(rid=rid,
                             prompt=tuple(int(t) for t in prompt),
                             max_new=int(max_new), session=session,
+                            lane=lane,
                             submitted_t=self._clock.now())
+        # classic WFQ finish tag: a lane's next request finishes 1/weight
+        # virtual seconds after the later of its lane's previous finish
+        # and the served virtual clock — backlogged lanes interleave in
+        # weight proportion, an idle lane accumulates no credit
+        tag = max(self._lane_vtime[lane], self._vclock) \
+            + 1.0 / LANE_WEIGHTS[lane]
+        self._lane_vtime[lane] = tag
+        req.wfq_tag = tag
         self.requests[rid] = req
         self._queue.append(rid)
         self._place_queued()
@@ -167,7 +218,30 @@ class RequestRouter:
     @property
     def outstanding(self) -> int:
         return sum(1 for r in self.requests.values()
-                   if r.state != COMPLETED)
+                   if r.state not in (COMPLETED, SHED))
+
+    def lane_depths(self) -> Dict[str, int]:
+        """Currently queued requests per QoS lane — the demand signal
+        the capacity arbiter prices (market/arbiter.py) and the
+        ``status --market`` lane table renders."""
+        out = {lane: 0 for lane in LANES}
+        for rid in self._queue:
+            req = self.requests[rid]
+            if req.state == QUEUED:
+                out[req.lane] += 1
+        return out
+
+    def lane_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-lane {queued, shed, completed} counters for the /lanes
+        and /market views."""
+        depths = self.lane_depths()
+        return {lane: {"queued": depths[lane],
+                       "shed": self._lane_shed[lane],
+                       "completed": self._lane_completed[lane]}
+                for lane in LANES}
+
+    def admitting_count(self) -> int:
+        return len(self.pool.admitting())
 
     # ------------------------------------------------------------- tick
 
@@ -182,6 +256,7 @@ class RequestRouter:
         self._collect_streams()
         self._collect_completions()
         self._place_queued()
+        self._shed_overload()
         self._mark_drained()
         self._update_gauges()
 
@@ -523,6 +598,7 @@ class RequestRouter:
                 req.state = COMPLETED
                 req.tokens = [int(t) for t in tokens]
                 req.completed_t = self._clock.now()
+                self._lane_completed[req.lane] += 1
 
     # --------------------------------------------------------- placement
 
@@ -537,7 +613,11 @@ class RequestRouter:
                    if r.state == ASSIGNED and r.replica_id == replica.id)
 
     def _pick(self, req: RouterRequest) -> Optional[Replica]:
-        candidates = self._candidates()
+        # a lane-dedicated replica (Replica.lane, mirrored to the
+        # cluster as the LANE_LABEL) only serves its own lane — reserved
+        # capacity a flood on the other lanes cannot touch
+        candidates = [r for r in self._candidates()
+                      if getattr(r, "lane", None) in (None, req.lane)]
         if not candidates:
             return None
         by_id = {r.id: r for r in candidates}
@@ -557,9 +637,13 @@ class RequestRouter:
     def _place_queued(self) -> None:
         remaining: List[int] = []
         # degraded requests (migration fallbacks) yield placement to
-        # normal traffic: slower, never lost. Stable within a class.
-        ordered = sorted(self._queue, key=lambda r:
-                         self.requests[r].priority == DEGRADED)
+        # normal traffic: slower, never lost. Within a priority class,
+        # weighted fair queueing across QoS lanes: place in WFQ finish-
+        # tag order (interactive drains ~4x as fast as best-effort when
+        # both are backlogged), ties broken by arrival (the rid).
+        ordered = sorted(self._queue, key=lambda r: (
+            self.requests[r].priority == DEGRADED,
+            self.requests[r].wfq_tag, r))
         for rid in ordered:
             req = self.requests[rid]
             if req.state != QUEUED:
@@ -580,6 +664,7 @@ class RequestRouter:
             req.state = ASSIGNED
             req.replica_id = target.id
             req.local_rid = local
+            self._vclock = max(self._vclock, req.wfq_tag)
             self._local2global[(target.id, local)] = rid
             self.assignments_this_tick.append(
                 (rid, target.id, target.node_name))
@@ -588,7 +673,49 @@ class RequestRouter:
             self._prefix_map[req.prefix_key] = target.id
             if req.handoffs == 0:
                 self._routed += 1
+                req.queue_wait_s = max(
+                    0.0, self._clock.now() - req.submitted_t)
+                if self._metrics is not None:
+                    self._metrics.observe("lane_queue_wait_seconds",
+                                          req.queue_wait_s,
+                                          labels={"lane": req.lane})
         self._queue = remaining
+
+    # ---------------------------------------------------------- shedding
+
+    def _shed_overload(self) -> None:
+        """Overload degrades by policy, not by accident: while more than
+        ``shed_high`` requests remain queued after placement, drop the
+        excess from the LOWEST priority lane up (``SHED_ORDER`` —
+        best-effort first, then batch; interactive is never shed).
+        Within a lane the newest requests shed first: the oldest have
+        waited longest and are next in line for a slot. A shed request
+        is terminal — never placed, never delivered — and is reported to
+        its submitter through :meth:`result` raising/None semantics plus
+        the per-lane shed counters."""
+        if self.shed_high is None:
+            return
+        excess = len(self._queue) - int(self.shed_high)
+        if excess <= 0:
+            return
+        for lane in SHED_ORDER:
+            if excess <= 0:
+                break
+            victims = [rid for rid in self._queue
+                       if self.requests[rid].state == QUEUED
+                       and self.requests[rid].lane == lane]
+            for rid in reversed(victims):      # newest first
+                if excess <= 0:
+                    break
+                req = self.requests[rid]
+                req.state = SHED
+                req.shed_t = self._clock.now()
+                self._queue.remove(rid)
+                self._lane_shed[lane] += 1
+                excess -= 1
+                logger.warning("overload: shed request %d (lane %s, "
+                               "%d queued > shed_high %g)", rid, lane,
+                               len(self._queue) + 1, self.shed_high)
 
     # ------------------------------------------------------------ gauges
 
@@ -618,6 +745,16 @@ class RequestRouter:
                                 self.migration_successes)
         self._metrics.set_gauge("migration_fallbacks",
                                 self.migration_fallbacks)
+        depths = self.lane_depths()
+        for lane in LANES:
+            labels = {"lane": lane}
+            self._metrics.set_gauge("lane_queue_depth", depths[lane],
+                                    labels=labels)
+            self._metrics.set_gauge("lane_shed", self._lane_shed[lane],
+                                    labels=labels)
+            self._metrics.set_gauge("lane_completed",
+                                    self._lane_completed[lane],
+                                    labels=labels)
 
     # --------------------------------------------------------- invariants
 
@@ -646,9 +783,17 @@ class RequestRouter:
                                f"delivered result after "
                                f"{req.migrations} migration(s)")
         for rid, req in self.requests.items():
-            if req.state not in (QUEUED, ASSIGNED, COMPLETED):
+            if req.state not in (QUEUED, ASSIGNED, COMPLETED, SHED):
                 out.append(f"request {rid} in unknown state {req.state!r}"
                            f" (lost)")
+            if req.state == SHED:
+                if req.lane not in SHED_ORDER:
+                    out.append(f"request {rid} on protected lane "
+                               f"{req.lane!r} was shed (policy: only "
+                               f"{', '.join(SHED_ORDER)} shed)")
+                if self.completed_counts.get(rid):
+                    out.append(f"request {rid} both shed and delivered "
+                               f"({self.completed_counts[rid]}x)")
             if req.state == ASSIGNED:
                 replica = self.pool.replicas.get(req.replica_id)
                 if replica is None or replica.failed:

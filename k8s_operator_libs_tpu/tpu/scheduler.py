@@ -118,17 +118,25 @@ class SliceScheduler:
 
     # -- placement ----------------------------------------------------------
 
-    def place(self, workload: TPUWorkload) -> Optional[Placement]:
+    def place(self, workload: TPUWorkload,
+              prefer: Optional[Callable[[str], bool]] = None
+              ) -> Optional[Placement]:
         """Bind the workload to the first ``num_slices`` eligible slices —
         all-or-nothing (a multislice job without all its slices would wedge
         at MEGASCALE init); returns None when not enough slices fit (caller
         requeues — same contract as a reconcile that cannot progress).
 
+        ``prefer(slice_id) -> bool`` (optional) biases the otherwise
+        name-ordered slice choice: preferred slices bind first. The
+        serving autoscaler passes the capacity market's leased slices
+        here, so traded training capacity is consumed before any other
+        free slice (docs/capacity-market.md).
+
         Single-slice pods get the JAX distributed-init env; multislice pods
         additionally get the MEGASCALE variables JAX's multislice runtime
         reads (slices talk over DCN; slice 0's worker 0 coordinates)."""
         t0 = self._clock.now()
-        placement = self._place(workload)
+        placement = self._place(workload, prefer=prefer)
         if placement is not None and self._metrics is not None:
             # latency of a SUCCESSFUL bind (inventory LISTs + pod creates);
             # a pass that finds no free slice is a cheap no-op, not latency
@@ -138,7 +146,9 @@ class SliceScheduler:
                 labels={"accelerator": workload.accelerator})
         return placement
 
-    def _place(self, workload: TPUWorkload) -> Optional[Placement]:
+    def _place(self, workload: TPUWorkload,
+               prefer: Optional[Callable[[str], bool]] = None
+               ) -> Optional[Placement]:
         if workload.num_slices < 1:
             raise ValueError(f"workload {workload.name}: num_slices must be "
                              f">= 1, got {workload.num_slices}")
@@ -180,7 +190,10 @@ class SliceScheduler:
                         "have %d", workload.num_slices, workload.accelerator,
                         workload.topology, workload.name, len(slices))
             return None
-        chosen = sorted(slices.items())[:workload.num_slices]
+        chosen = sorted(
+            slices.items(),
+            key=lambda kv: (0 if prefer is not None and prefer(kv[0])
+                            else 1, kv[0]))[:workload.num_slices]
         multi = workload.num_slices > 1
         per_host = chips_per_host(workload.accelerator)
         # worker-0-of-slice-0 coordinates; a slice's pods are named

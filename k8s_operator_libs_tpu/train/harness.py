@@ -66,6 +66,21 @@ class ReclaimNotice:
     deadline_s: float = 120.0
 
 
+@dataclasses.dataclass
+class GrowNotice:
+    """The reverse of a :class:`ReclaimNotice`: returned capacity. The
+    platform (the capacity arbiter ending a trade — market/arbiter.py —
+    or a spot pool refilling) hands back chips; ``devices`` is the FULL
+    device set the job may now run on (a superset of the current mesh).
+    An elastic trainer flushes its window, drain-saves, re-derives the
+    larger mesh, reshard-restores the checkpoint onto it and resumes —
+    one continuous run, the shrink path in reverse. Grow/shrink
+    hysteresis is the ARBITER's job, not the trainer's: a notice is an
+    order, not a suggestion."""
+
+    devices: Sequence[Any]
+
+
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     """Point JAX's persistent compilation cache at a host-local directory.
 
@@ -246,7 +261,9 @@ class CheckpointingTrainer:
             on_step: Optional[Callable[[int, dict], None]] = None,
             sync_every: Optional[int] = None,
             reclaim_signal: Optional[
-                Callable[[], Optional[ReclaimNotice]]] = None
+                Callable[[], Optional[ReclaimNotice]]] = None,
+            grow_signal: Optional[
+                Callable[[], Optional[GrowNotice]]] = None
             ) -> TrainResult:
         """Train until num_steps more steps are done or a drain is signalled.
 
@@ -264,6 +281,14 @@ class CheckpointingTrainer:
         re-derives a smaller mesh, reshards the checkpoint onto it, and
         RESUMES — no stall, no run boundary; the ledger records the
         shrink window as a priced ``degraded`` phase.
+
+        ``grow_signal()`` returning a :class:`GrowNotice` is the reverse
+        path — capacity RETURNED by the arbiter (or a refilled spot
+        pool). An elastic trainer flushes the open goodput window,
+        drain-saves, re-derives the larger mesh over ``notice.devices``,
+        reshard-restores and resumes — the same continuous run; the
+        ledger's open ``degraded`` window closes (or re-prices, when the
+        grow is partial). Non-elastic trainers ignore grow notices.
 
         ``on_step(step, metrics)`` receives the HOST-side step counter and
         the raw (possibly still in-flight) device metrics — the loop no
@@ -284,7 +309,22 @@ class CheckpointingTrainer:
         done = 0
         preempted = False
         reshards = 0
-        degraded_open = None  # (start wall, devices before, devices after)
+        # the capacity the degraded price is charged against: the device
+        # count at run start, raised if a grow ever exceeds it — so a
+        # shrink chain (8 -> 4 -> 2) prices every window against the full
+        # 8, and a partial grow (2 -> 6) re-prices, not closes, the loss
+        baseline = self._device_count
+        # the open degraded window: (start wall, baseline at open,
+        # devices now), or None while running at full capacity
+        degraded = {"open": None}
+
+        def _close_degraded():
+            if degraded["open"] is not None and ledger is not None:
+                s0, b0, a0 = degraded["open"]
+                ledger.degraded(s0, max(0.0, ledger.clock.wall() - s0),
+                                b0, a0)
+            degraded["open"] = None
+
         win_t0 = now()       # start of the current unsynced step window
         win_steps = 0
         win_tokens = 0
@@ -320,22 +360,43 @@ class CheckpointingTrainer:
                         last_ckpt = self.save(state, wait=True)
                     preempted = True
                     break
-                before = self._device_count
-                if degraded_open is not None and ledger is not None:
-                    b0, a0, s0 = degraded_open[1], degraded_open[2], \
-                        degraded_open[0]
-                    ledger.degraded(s0, max(0.0, ledger.clock.wall() - s0),
-                                    b0, a0)
-                    degraded_open = None
-                state, last_ckpt = self._shrink(state, survivors, ledger)
+                _close_degraded()
+                state, last_ckpt = self._resize(state, survivors, ledger,
+                                                kind="shrink")
                 reshards += 1
-                if ledger is not None:
-                    degraded_open = (ledger.clock.wall(), before,
-                                     len(survivors))
+                if ledger is not None and len(survivors) < baseline:
+                    degraded["open"] = (ledger.clock.wall(), baseline,
+                                        len(survivors))
+                baseline = max(baseline, len(survivors))
                 win_t0 = now()
                 win_steps = 0
                 win_tokens = 0
                 continue
+            growth = grow_signal() if grow_signal is not None else None
+            if growth is not None:
+                devices = list(growth.devices or [])
+                if not self.elastic:
+                    logger.info("grow notice ignored: trainer is not "
+                                "elastic")
+                elif len(devices) > self._device_count:
+                    if ledger is not None and win_steps > 0:
+                        ledger.steps(start_step + done, win_steps,
+                                     max(0.0, now() - win_t0), win_tokens)
+                        win_steps = win_tokens = 0
+                    _close_degraded()
+                    state, last_ckpt = self._resize(state, devices,
+                                                    ledger, kind="grow")
+                    reshards += 1
+                    if ledger is not None and len(devices) < baseline:
+                        # a partial grow: still short of the pre-shrink
+                        # capacity — the loss re-prices, it doesn't end
+                        degraded["open"] = (ledger.clock.wall(), baseline,
+                                            len(devices))
+                    baseline = max(baseline, len(devices))
+                    win_t0 = now()
+                    win_steps = 0
+                    win_tokens = 0
+                    continue
             batch = next(data)
             state, metrics = self._step_fn(state, batch)
             done += 1
@@ -366,12 +427,8 @@ class CheckpointingTrainer:
                         last_ckpt = self.save(state)  # async dispatch
                 else:
                     last_ckpt = self.save(state)  # async
+        _close_degraded()
         if ledger is not None:
-            if degraded_open is not None:
-                start_wall, before, after = degraded_open
-                ledger.degraded(start_wall,
-                                max(0.0, ledger.clock.wall() - start_wall),
-                                before, after)
             ledger.run_ended(start_step + done, preempted)
         return TrainResult(state=state, steps_done=done, preempted=preempted,
                            last_checkpoint_step=last_ckpt,
@@ -379,19 +436,23 @@ class CheckpointingTrainer:
                            reshards=reshards,
                            device_count=self._device_count)
 
-    def _shrink(self, state: TrainState, survivors: List[Any],
-                ledger) -> "tuple[TrainState, int]":
-        """Elastic shrink: synchronous drain-save, re-derive the mesh
-        over the surviving devices, rebuild step/init for it, and restore
-        the checkpoint re-sharded onto the shrunk mesh. Returns (restored
-        state, checkpoint step). The restore rides init_or_resume, so the
-        ledger books it as a ``ckpt_restore`` phase like any resume."""
+    def _resize(self, state: TrainState, devices: List[Any],
+                ledger, kind: str = "shrink") -> "tuple[TrainState, int]":
+        """Elastic resize — one code path for both directions:
+        synchronous drain-save (flush), re-derive the mesh over
+        ``devices`` (fewer on a shrink, more on a grow), rebuild
+        step/init for it, and restore the checkpoint re-sharded onto the
+        new mesh. Returns (restored state, checkpoint step). The restore
+        rides init_or_resume, so the ledger books it as a
+        ``ckpt_restore`` phase like any resume; the save books as
+        ``drain_save`` — inside a continuous run neither opens an
+        unavailability window."""
         if ledger is not None:
             with ledger.phase("drain_save"):
                 ckpt_step = self.save(state, wait=True)
         else:
             ckpt_step = self.save(state, wait=True)
-        new_mesh = self._mesh_factory(survivors)
+        new_mesh = self._mesh_factory(devices)
         self.mesh = new_mesh
         if self._step_factory is not None:
             self._step_fn = self._step_factory(new_mesh)
@@ -407,7 +468,7 @@ class CheckpointingTrainer:
         rng = (self._resume_rng if self._resume_rng is not None
                else jax.random.PRNGKey(0))
         restored = self.init_or_resume(rng)
-        self._device_count = len(survivors)
-        logger.info("elastic shrink: resumed at step %d on %d devices",
-                    int(restored.step), len(survivors))
+        self._device_count = len(devices)
+        logger.info("elastic %s: resumed at step %d on %d devices",
+                    kind, int(restored.step), len(devices))
         return restored, ckpt_step

@@ -95,3 +95,31 @@ MIGRATION_INTENT_ANNOTATION = "tpu.dev/serving.migration-intent"
 # and a version skew during a rolling binary upgrade is visible in the
 # cluster instead of as a rejected transfer at drain time.
 KV_PAYLOAD_VERSION_ANNOTATION = "tpu.dev/serving.kv-payload-version"
+# Per-tenant QoS lane a replica is DEDICATED to (absent = serves every
+# lane). Mirrored from ``Replica.lane`` at registration so a restarted
+# or failed-over router rebuilds lane-reserved capacity from the
+# cluster, not from process memory (docs/capacity-market.md).
+LANE_LABEL = "tpu.dev/serving.lane"
+
+# --------------------------------------------------------------- market
+# The capacity-market lease contract between the training harness and
+# the serving tier (docs/capacity-market.md). The arbiter
+# (``market/arbiter.py``) is the ONLY writer; the training job and the
+# serving autoscaler are the readers.
+#
+# Current market owner of every node of a managed slice:
+# ``training`` | ``serving`` | ``draining`` (a trade in flight, either
+# direction). A training job watching its nodes drain-saves and vacates
+# the moment the label leaves ``training``; the serving autoscaler
+# prefers placing onto slices labelled ``serving``.
+MARKET_OWNER_LABEL = "tpu.dev/market.owner"
+# The lease record on the slice's ANCHOR node (its first member):
+# "<phase>:<decision id>@<wall secs>" with phase one of
+# training/preempting/serving/returning — durable, so a failed-over
+# arbiter resumes the trade mid-flight instead of re-deciding it.
+MARKET_LEASE_ANNOTATION = "tpu.dev/market.lease"
+# The arbiter's last decision for this slice as compact JSON (id,
+# action, exchange rate, serving pressure, training value, wall time) —
+# the burn-vs-goodput rationale `status --market` renders, durable
+# across leader failover.
+MARKET_DECISION_ANNOTATION = "tpu.dev/market.decision"

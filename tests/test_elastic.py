@@ -25,7 +25,7 @@ from k8s_operator_libs_tpu.obs.goodput import (GoodputLedger, read_ledger,
                                                split_runs, summarize,
                                                unavailability_windows)
 from k8s_operator_libs_tpu.train.harness import (CheckpointingTrainer,
-                                                 ReclaimNotice)
+                                                 GrowNotice, ReclaimNotice)
 from k8s_operator_libs_tpu.utils.clock import FakeClock
 
 
@@ -148,6 +148,198 @@ def test_elastic_shrink_keeps_one_run_and_prices_degraded(tmp_path):
     # already booked their wall time as goodput)
     assert s["badput_s"]["degraded"] == pytest.approx(d["seconds_lost"])
     assert s["runs"] == 1
+
+
+def test_elastic_shrink_grow_round_trip_one_ledger(tmp_path):
+    """Satellite (ISSUE 13): shrink -> grow under ONE ledger. The grow
+    (the shrink path in reverse — returned capacity) closes the open
+    degraded window, the run never breaks, and there are ZERO
+    unavailability windows: a round-tripped trade costs priced
+    capacity, never downtime."""
+    clock = FakeClock(5000.0)
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    trainer = _stub_trainer(tmp_path, clock, led, elastic=True)
+    restored = types.SimpleNamespace(step=4)
+    trainer.init_or_resume = lambda rng: restored
+    trainer._device_count = 8
+    notices = iter([None] * 3
+                   + [ReclaimNotice(surviving_devices=list("abcd"))])
+    seen_steps = []
+    grown = {"done": False}
+
+    def grow():
+        # capacity returns once the job ran a while on 4 devices
+        if (trainer._device_count == 4 and len(seen_steps) >= 6
+                and not grown["done"]):
+            grown["done"] = True
+            return GrowNotice(devices=list("abcdefgh"))
+        return None
+
+    result = trainer.run(types.SimpleNamespace(step=0),
+                         iter(lambda: object(), None), num_steps=12,
+                         on_step=lambda s, m: seen_steps.append(s),
+                         reclaim_signal=lambda: next(notices, None),
+                         grow_signal=grow)
+    led.close()
+    assert not result.preempted
+    assert result.reshards == 2 and result.device_count == 8
+    assert result.steps_done == 12
+    # both resizes drain-saved synchronously
+    assert sum(1 for _, wait in trainer._saves if wait) == 2
+
+    records = read_ledger(led.path)
+    assert len(split_runs(records)) == 1, "a trade is NOT a run boundary"
+    assert unavailability_windows(records) == []
+    degraded = [r for r in records if r.get("phase") == "degraded"]
+    assert len(degraded) == 1, "the grow must CLOSE the degraded window"
+    d = degraded[0]
+    assert d["devices_before"] == 8 and d["devices_after"] == 4
+    assert d["seconds_lost"] == pytest.approx(d["duration_s"] * 0.5)
+    s = summarize(records)
+    assert s["badput_s"]["degraded"] == pytest.approx(d["seconds_lost"])
+    assert s["runs"] == 1
+
+
+def test_elastic_partial_grow_reprices_against_baseline(tmp_path):
+    """8 -> 2 -> 4: the partial grow closes the first window and opens
+    a second priced against the ORIGINAL 8-device baseline (75% then
+    50% capacity lost), not against the shrunken mesh."""
+    clock = FakeClock(9000.0)
+    led = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+    trainer = _stub_trainer(tmp_path, clock, led, elastic=True)
+    trainer.init_or_resume = lambda rng: types.SimpleNamespace(step=0)
+    trainer._device_count = 8
+    notices = iter([None] * 2
+                   + [ReclaimNotice(surviving_devices=list("ab"))])
+    seen = []
+    grown = {"done": False}
+
+    def grow():
+        if (trainer._device_count == 2 and len(seen) >= 5
+                and not grown["done"]):
+            grown["done"] = True
+            return GrowNotice(devices=list("abcd"))
+        return None
+
+    result = trainer.run(types.SimpleNamespace(step=0),
+                         iter(lambda: object(), None), num_steps=10,
+                         on_step=lambda s, m: seen.append(s),
+                         reclaim_signal=lambda: next(notices, None),
+                         grow_signal=grow)
+    led.close()
+    assert result.reshards == 2 and result.device_count == 4
+    degraded = [r for r in read_ledger(led.path)
+                if r.get("phase") == "degraded"]
+    assert [(d["devices_before"], d["devices_after"])
+            for d in degraded] == [(8, 2), (8, 4)]
+    assert degraded[0]["seconds_lost"] == pytest.approx(
+        degraded[0]["duration_s"] * 0.75)
+    assert degraded[1]["seconds_lost"] == pytest.approx(
+        degraded[1]["duration_s"] * 0.5)
+
+
+def test_grow_ignored_by_inelastic_trainer(tmp_path):
+    clock = FakeClock()
+    trainer = _stub_trainer(tmp_path, clock, None, elastic=False)
+    result = trainer.run(
+        types.SimpleNamespace(step=0), iter(lambda: object(), None),
+        num_steps=3,
+        grow_signal=lambda: GrowNotice(devices=list("abcdefgh")))
+    assert result.reshards == 0 and result.steps_done == 3
+
+
+def test_elastic_grow_e2e_matches_cold_start(tmp_path):
+    """The CPU grow e2e (ISSUE 13, the PR 7 shrink pin's mirror): 3
+    steps on a 4-device mesh, capacity returns, the run GROWS to the
+    8-device mesh and continues — step/loss continuity, one continuous
+    run, and the post-grow steps numerically identical to an 8-device
+    cold start restoring the same checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_operator_libs_tpu.models.llama import LlamaConfig
+    from k8s_operator_libs_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest pins the 8-device virtual mesh"
+    cfg = LlamaConfig.tiny()
+
+    def batch(i):
+        return jax.random.randint(jax.random.PRNGKey(2000 + i), (8, 17),
+                                  0, cfg.vocab_size, dtype=jnp.int32)
+
+    def batches():
+        i = 0
+        while True:
+            i += 1
+            yield batch(i)
+
+    ckpt = str(tmp_path / "ckpt")
+    led = GoodputLedger(os.path.join(ckpt, "goodput.jsonl"))
+    trainer = CheckpointingTrainer(
+        cfg, ckpt, mesh=make_mesh(devices=devices[:4]),
+        checkpoint_interval=100, ledger=led, metrics_sync_every=2,
+        elastic=True)
+    state = trainer.init_or_resume(jax.random.PRNGKey(0))
+
+    calls = {"n": 0}
+
+    def grow():
+        calls["n"] += 1
+        if calls["n"] == 4:  # after 3 completed steps
+            return GrowNotice(devices=devices)
+        return None
+
+    losses = []
+    result = trainer.run(
+        state, batches(), num_steps=6, grow_signal=grow,
+        on_step=lambda s, m: losses.append((s, float(m["loss"]))))
+    trainer.close()
+    led.close()
+
+    assert not result.preempted
+    assert result.reshards == 1 and result.device_count == 8
+    assert result.steps_done == 6
+    assert int(result.state.step) == 6
+    assert [s for s, _ in losses] == [1, 2, 3, 4, 5, 6], \
+        "step continuity across the grow"
+    records = read_ledger(led.path)
+    assert len(split_runs(records)) == 1
+    assert unavailability_windows(records) == []
+    # growing above the run's 4-device baseline prices NO degraded loss
+    assert [r for r in records if r.get("phase") == "degraded"] == []
+    assert any(r.get("phase") == "ckpt_restore" for r in records)
+
+    # cold start: restore the SAME checkpoint (step 3, the grow save)
+    # on a fresh 8-device trainer and consume the same batches 4..6
+    trainer2 = CheckpointingTrainer(cfg, ckpt,
+                                    mesh=make_mesh(devices=devices),
+                                    checkpoint_interval=100)
+    state2 = trainer2.init_or_resume(jax.random.PRNGKey(9))
+    assert int(state2.step) == 3
+
+    def batches_from(start):
+        i = start
+        while True:
+            yield batch(i)
+            i += 1
+
+    cold_losses = []
+    result2 = trainer2.run(
+        state2, batches_from(4), num_steps=3,
+        on_step=lambda s, m: cold_losses.append((s, float(m["loss"]))))
+    trainer2.close()
+    assert result2.steps_done == 3
+
+    grown_tail = dict(losses)[4], dict(losses)[5], dict(losses)[6]
+    cold_tail = dict(cold_losses)[4], dict(cold_losses)[5], \
+        dict(cold_losses)[6]
+    assert grown_tail == pytest.approx(cold_tail, rel=1e-5)
+    final_a = jax.tree_util.tree_leaves(result.state.params)
+    final_b = jax.tree_util.tree_leaves(result2.state.params)
+    for a, b in zip(final_a, final_b):
+        assert jnp.allclose(a, b, rtol=1e-5, atol=1e-6), \
+            "elastic grow diverged from the from-checkpoint cold start"
 
 
 def test_elastic_reshard_e2e_matches_cold_start(tmp_path):

@@ -595,7 +595,7 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
               obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH,
-              obs_check.PROFILE_PATH]
+              obs_check.PROFILE_PATH, obs_check.MARKET_METRICS_PATH]
 
 
 def _obs3_root(tmp_path, mutate=None, skip=()):
@@ -720,6 +720,42 @@ def test_obs003_no_serving_package_skips_router_closure(tmp_path):
     of older passes, a stripped deployment) must not fire on its
     tpu_router_* HELP entries — the closure needs both sides present."""
     root = _obs3_root(tmp_path, skip={obs_check.ROUTER_METRICS_PATH})
+    assert obs_check.run_slo(root) == []
+
+
+def test_obs003_market_family_without_help_fails(tmp_path):
+    """A new market family in market/metrics.py with no HELP_TEXTS
+    entry would render with the underscores-to-spaces fallback."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.MARKET_METRICS_PATH: lambda s: s.replace(
+            '    "tpu_market_exchange_rate",',
+            '    "tpu_market_exchange_rate",\n'
+            '    "tpu_market_phantom_gauge",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "tpu_market_phantom_gauge" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_stale_market_help_entry_fails(tmp_path):
+    """A tpu_market_* HELP entry nothing emits is a renamed or removed
+    market metric seen from the catalog side."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_market_exchange_rate":',
+            '    "tpu_market_ghost": "phantom market gauge",\n'
+            '    "tpu_market_exchange_rate":')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_market_ghost" in msgs
+    assert "no emitted" in msgs and "MARKET_GAUGE_FAMILIES" in msgs
+
+
+def test_obs003_no_market_package_skips_market_closure(tmp_path):
+    """Without market/metrics.py the market closure is skipped entirely
+    (like the router closure without a serving package)."""
+    root = _obs3_root(tmp_path, skip={obs_check.MARKET_METRICS_PATH})
     assert obs_check.run_slo(root) == []
 
 

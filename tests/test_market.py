@@ -1,0 +1,752 @@
+"""The capacity market: QoS lanes, the arbiter, and the demand e2e.
+
+The acceptance bars pinned here (ISSUE 13, docs/capacity-market.md):
+
+- weighted fair queueing interleaves backlogged lanes in LANE_WEIGHTS
+  proportion; overload shedding drops best-effort first and never
+  touches interactive; a shed request is terminal (never also served);
+- the arbiter's exchange rate prices serving pressure (SLO burn OR
+  lane-weighted backlog) against marginal training goodput, with
+  sustain + cooldown hysteresis owned by the arbiter;
+- a trade is durable before it is acted on: the ``tpu.dev/market.*``
+  labels/annotations land first, a failed stamp retries, and a second
+  arbiter RESUMES mid-trade from the cluster (the leader-failover
+  path);
+- trades defer while the slice is dirty (cordoned / quarantined /
+  reclaimed member) or the upgrade budget is spent;
+- the demand e2e: a flash-crowd arrival sweep over serving/sim.py —
+  interactive queue wait stays bounded while best-effort sheds first,
+  the arbiter preempts the training slice at the peak and returns it
+  after the trough, and the trainer's ledger shows ONE continuous run
+  with the preemption priced as ``degraded``, never downtime.
+"""
+
+import json
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.market import (MARKET_GAUGE_FAMILIES,
+                                          PREEMPTING, SERVING, TRAINING,
+                                          CapacityArbiter, ManagedSlice,
+                                          MarketConfig, marginal_goodput)
+from k8s_operator_libs_tpu.obs.goodput import (GoodputLedger, read_ledger,
+                                               split_runs, summarize,
+                                               unavailability_windows)
+from k8s_operator_libs_tpu.obs.metrics import HELP_TEXTS, MetricsHub
+from k8s_operator_libs_tpu.serving import (LANES, Replica, ReplicaPool,
+                                           RequestRouter,
+                                           SimReplicaRuntime, sim_tokens)
+from k8s_operator_libs_tpu.serving.metrics import (
+    ROUTER_GAUGE_FAMILIES, ROUTER_HISTOGRAM_FAMILIES)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+from k8s_operator_libs_tpu.wire import (LANE_LABEL,
+                                        MARKET_DECISION_ANNOTATION,
+                                        MARKET_LEASE_ANNOTATION,
+                                        MARKET_OWNER_LABEL)
+
+
+def _pool_with(n=2, max_slots=4, tokens_per_step=4, clock=None,
+               client=None):
+    pool = ReplicaPool(component="libtpu", clock=clock, client=client)
+    for i in range(n):
+        pool.register(Replica(f"srv-{i}", f"n-s{i}",
+                              SimReplicaRuntime(
+                                  max_slots=max_slots,
+                                  tokens_per_step=tokens_per_step)))
+    return pool
+
+
+def _drain_all(router, pool, ticks=50):
+    for _ in range(ticks):
+        router.tick()
+        for r in pool.replicas.values():
+            if not r.failed:
+                r.runtime.step()
+        if router.outstanding == 0:
+            return
+    raise AssertionError(f"router never drained: {router.outstanding} "
+                         f"outstanding")
+
+
+# ----------------------------------------------------------- closures
+
+
+def test_market_families_registered_runtime_mirror():
+    """Runtime mirror of the OBS003 market closure: every emitted
+    family (market + the new lane-labelled router families) has a HELP
+    entry, and no tpu_market_* HELP entry is stale."""
+    for family in MARKET_GAUGE_FAMILIES:
+        assert family in HELP_TEXTS, family
+    for family in ROUTER_GAUGE_FAMILIES + ROUTER_HISTOGRAM_FAMILIES:
+        assert family in HELP_TEXTS, family
+    stale = [k for k in HELP_TEXTS
+             if k.startswith("tpu_market_")
+             and k not in MARKET_GAUGE_FAMILIES]
+    assert stale == []
+
+
+# ---------------------------------------------------------- QoS lanes
+
+
+def test_wfq_interleaves_lanes_by_weight():
+    """With every lane backlogged, placement order follows the WFQ
+    finish tags: ~4:2:1 weight proportion — a best-effort flood cannot
+    starve interactive."""
+    clock = FakeClock(100.0)
+    pool = _pool_with(n=1, max_slots=1, clock=clock)
+    replica = pool.replicas["srv-0"]
+    # backpressure the replica OUT of placement first (scraped queue
+    # depth >= queue_high), so arrivals queue at the router
+    replica.runtime.submit([1, 2], 64)
+    router = RequestRouter(pool, clock=clock, queue_high=1.0)
+    router.tick()       # scrape: queue_depth 1 >= 1 -> backpressured
+    assert replica.stats.queue_depth >= 1.0
+    for i in range(21):
+        router.submit([10 + i], 1, lane=LANES[i % 3])
+    assert len(router._queue) == 21, "nothing should have placed yet"
+    # free the replica (its queued request starts running) and let ONE
+    # placement wave drain the router queue in WFQ order
+    replica.runtime.step()
+    router.tick()
+    order = [router.requests[rid].lane
+             for rid, _replica, _node in router.assignments_this_tick]
+    assert len(order) == 21
+    head = order[:7]
+    assert head.count("interactive") == 4
+    assert head.count("batch") == 2
+    assert head.count("best-effort") == 1
+    # and the full drain keeps the proportion: every prefix serves
+    # interactive at least as often as best-effort
+    for n in range(1, 22):
+        assert order[:n].count("interactive") >= \
+            order[:n].count("best-effort")
+    _drain_all(router, pool, ticks=300)
+
+
+def test_overload_sheds_best_effort_first_never_interactive():
+    clock = FakeClock(50.0)
+    pool = _pool_with(n=1, max_slots=1, clock=clock)
+    # backpressure the replica so arrivals queue at the router
+    pool.replicas["srv-0"].runtime.submit([1], 64)
+    router = RequestRouter(pool, clock=clock, queue_high=1.0,
+                           shed_high=4)
+    router.tick()
+    rids = {}
+    for lane in LANES:
+        rids[lane] = [router.submit([2, i], 2, lane=lane)
+                      for i in range(4)]
+    assert len(router._queue) == 12
+    router.tick()
+    # 12 queued > 4: the excess sheds, best-effort first (all 4), then
+    # batch (4), leaving the 4 interactive queued
+    assert router._lane_shed["best-effort"] == 4
+    assert router._lane_shed["batch"] == 4
+    assert router._lane_shed["interactive"] == 0
+    for rid in rids["best-effort"] + rids["batch"]:
+        assert router.requests[rid].state == "shed"
+        assert router.requests[rid].shed_t is not None
+    for rid in rids["interactive"]:
+        assert router.requests[rid].state in ("queued", "assigned")
+    # shed is terminal and outside `outstanding`; exactly-once holds
+    assert router.check_invariants() == []
+    _drain_all(router, pool, ticks=400)
+    for rid in rids["best-effort"]:
+        assert router.requests[rid].state == "shed"
+        assert router.requests[rid].tokens is None
+
+
+def test_unknown_lane_rejected():
+    router = RequestRouter(_pool_with(n=1), clock=FakeClock())
+    with pytest.raises(ValueError, match="unknown QoS lane"):
+        router.submit([1], 2, lane="platinum")
+
+
+def test_lane_dedicated_replica_serves_only_its_lane():
+    """A Replica(lane=...) is reserved capacity: other lanes never place
+    there, and the LANE_LABEL mirrors to the node (cleared again on
+    deregister)."""
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n-s0")
+    cluster.add_node("n-vip")
+    pool = ReplicaPool(component="libtpu", clock=clock,
+                       client=cluster.client)
+    pool.register(Replica("srv-0", "n-s0", SimReplicaRuntime()))
+    pool.register(Replica("vip", "n-vip", SimReplicaRuntime(),
+                          lane="interactive"))
+    node = cluster.client.direct().get_node("n-vip")
+    assert node.metadata.labels[LANE_LABEL] == "interactive"
+    router = RequestRouter(pool, clock=clock)
+    placements = {}
+    for i, lane in enumerate(LANES * 4):
+        rid = router.submit([i, i + 1], 1, lane=lane)
+        placements[rid] = lane
+    router.tick()
+    for rid, req in router.requests.items():
+        if req.state == "assigned" and req.replica_id == "vip":
+            assert req.lane == "interactive"
+    pool.deregister("vip")
+    node = cluster.client.direct().get_node("n-vip")
+    assert LANE_LABEL not in node.metadata.labels
+
+
+def test_lane_gauges_and_wait_histogram_emitted():
+    clock = FakeClock()
+    hub = MetricsHub()
+    pool = _pool_with(n=1, clock=clock)
+    router = RequestRouter(pool, metrics=hub, clock=clock, shed_high=1)
+    router.submit([1], 2, lane="interactive")
+    for _ in range(3):
+        router.submit([2], 2, lane="best-effort")
+    router.tick()
+    text = hub.render(prefix="tpu_router")
+    assert "tpu_router_lane_queue_depth" in text
+    assert 'tpu_router_lane_shed{lane="best-effort"}' in text
+    assert "tpu_router_lane_queue_wait_seconds_bucket" in text
+
+
+# ------------------------------------------------------- arbiter units
+
+
+class _Demand:
+    """Stub demand: scripted lane depths."""
+
+    def __init__(self):
+        self.depths = {lane: 0 for lane in LANES}
+        self.admitting = 2
+
+    def lane_depths(self):
+        return dict(self.depths)
+
+    def lane_stats(self):
+        return {lane: {"queued": d, "shed": 0, "completed": 0}
+                for lane, d in self.depths.items()}
+
+    def admitting_count(self):
+        return self.admitting
+
+
+def test_exchange_rate_prices_lane_pressure_and_burn():
+    clock = FakeClock()
+    demand = _Demand()
+    arb = CapacityArbiter([ManagedSlice("s0", ["n-t0"])], demand=demand,
+                          clock=clock,
+                          config=MarketConfig(queue_high=4.0))
+    assert arb.exchange_rate() == 0.0
+    # 8 interactive queued on 2 admitting replicas: weighted 32 over
+    # capacity 2*4*4=32 -> pressure 1.0; value defaults to 1.0
+    demand.depths["interactive"] = 8
+    assert arb.exchange_rate() == pytest.approx(1.0)
+    # the same backlog on best-effort prices 4x cheaper
+    demand.depths = {"interactive": 0, "batch": 0, "best-effort": 8}
+    assert arb.exchange_rate() == pytest.approx(0.25)
+    # burn signal: a triggered page pair at 28.8x/14.4x = multiple 2.0
+    engine = types.SimpleNamespace(last={"serving-ttft-p99": {
+        "burn": [{"triggered": True, "severity": "page",
+                  "long_rate": 28.8, "factor": 14.4}]}})
+    arb.slo_engine = engine
+    assert arb.exchange_rate() == pytest.approx(2.0)
+    # goodput in the denominator: a slice worth 2.0 halves the rate
+    arb.goodput_fn = lambda: 2.0
+    assert arb.exchange_rate() == pytest.approx(1.0)
+
+
+def test_marginal_goodput_splits_summary_across_slices():
+    assert marginal_goodput({"tokens_per_s": 9000.0}, 3) == 3000.0
+    assert marginal_goodput({"tokens_per_s": None}, 3) == 0.0
+
+
+def test_arbiter_hysteresis_sustain_and_cooldown():
+    """One hot tick never trades; sustain_ticks consecutive hot ticks
+    do; the cooldown then blocks the immediate return decision."""
+    clock = FakeClock(1000.0)
+    demand = _Demand()
+    events = []
+    arb = CapacityArbiter(
+        [ManagedSlice("s0", ["n-t0"])], demand=demand, clock=clock,
+        vacated=lambda ms: True,
+        grant=lambda ms: events.append("grant"),
+        revoke=lambda ms: True,
+        returned=lambda ms: events.append("returned"),
+        config=MarketConfig(preempt_rate=1.5, return_rate=0.4,
+                            sustain_ticks=3, cooldown_seconds=120.0))
+    ms = arb.supply[0]
+    demand.depths["interactive"] = 20        # pressure >> 1.5
+    arb.tick()
+    assert ms.phase == TRAINING              # 1 hot tick: no trade
+    clock.advance(15.0)
+    arb.tick()
+    assert ms.phase == TRAINING
+    clock.advance(15.0)
+    arb.tick()                               # 3rd hot tick: preempt
+    assert ms.phase == PREEMPTING
+    clock.advance(15.0)
+    arb.tick()                               # vacated -> granted
+    assert ms.phase == SERVING and events == ["grant"]
+    demand.depths["interactive"] = 0         # instant trough
+    for _ in range(3):
+        clock.advance(15.0)
+        arb.tick()
+    # low_ticks sustained but cooldown (120s) not yet elapsed since the
+    # grant decision
+    assert ms.phase == SERVING
+    clock.advance(120.0)
+    arb.tick()
+    assert ms.phase == "returning"
+    clock.advance(15.0)
+    arb.tick()                               # revoke True -> returned
+    assert ms.phase == TRAINING and events == ["grant", "returned"]
+    assert arb.trades == 1 and arb.returns == 1
+    actions = [d["action"] for d in arb.decisions]
+    assert actions == ["preempt", "grant", "return", "returned"]
+
+
+def _hot_arbiter(cluster, clock, nodes, budget=None, **cfg):
+    demand = _Demand()
+    demand.depths["interactive"] = 20
+    arb = CapacityArbiter(
+        [ManagedSlice("s0", nodes)], client=cluster.client,
+        demand=demand, clock=clock, vacated=lambda ms: True,
+        config=MarketConfig(preempt_rate=1.5, sustain_ticks=1,
+                            cooldown_seconds=0.0, budget=budget, **cfg))
+    return arb
+
+
+def test_trade_defers_on_dirty_slice_and_spent_budget():
+    clock = FakeClock(100.0)
+    cluster = FakeCluster(clock=clock)
+    for name in ("n-t0", "n-x0", "n-x1"):
+        cluster.add_node(name)
+    # dirty member: cordoned training node defers the trade
+    arb = _hot_arbiter(cluster, clock, ["n-t0"])
+    cluster.client.direct().patch_node_unschedulable("n-t0", True)
+    arb.tick()
+    assert arb.supply[0].phase == TRAINING
+    cluster.client.direct().patch_node_unschedulable("n-t0", False)
+    arb.tick()
+    assert arb.supply[0].phase != TRAINING   # clean again: trades
+    # budget: 2 held nodes + 1 traded > budget 2 defers (fresh cluster —
+    # no durable lease to resume from)
+    clock2 = FakeClock(100.0)
+    cluster2 = FakeCluster(clock=clock2)
+    for name in ("n-t0", "n-x0", "n-x1"):
+        cluster2.add_node(name)
+    arb2 = _hot_arbiter(cluster2, clock2, ["n-t0"], budget=2)
+    cluster2.client.direct().patch_node_unschedulable("n-x0", True)
+    cluster2.client.direct().patch_node_unschedulable("n-x1", True)
+    arb2.tick()
+    assert arb2.supply[0].phase == TRAINING
+    cluster2.client.direct().patch_node_unschedulable("n-x1", False)
+    arb2.tick()
+    assert arb2.supply[0].phase != TRAINING  # 1 held + 1 traded <= 2
+
+
+def test_trade_stamps_wire_contract_and_resumes_after_failover():
+    """ACCEPTANCE: the decision is durable before it is acted on — the
+    owner label lands on every member, the lease + rationale on the
+    anchor — and a SECOND arbiter (the promoted standby) resumes the
+    trade mid-flight from the cluster."""
+    clock = FakeClock(500.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n-t0")
+    cluster.add_node("n-t1")
+    demand = _Demand()
+    demand.depths["interactive"] = 20
+    arb = CapacityArbiter(
+        [ManagedSlice("s0", ["n-t0", "n-t1"])], client=cluster.client,
+        demand=demand, clock=clock, vacated=lambda ms: False,
+        config=MarketConfig(preempt_rate=1.5, sustain_ticks=1,
+                            cooldown_seconds=0.0))
+    arb.tick()
+    assert arb.supply[0].phase == PREEMPTING
+    direct = cluster.client.direct()
+    for name in ("n-t0", "n-t1"):
+        node = direct.get_node(name)
+        assert node.metadata.labels[MARKET_OWNER_LABEL] == "draining"
+    anchor = direct.get_node("n-t0")
+    lease = anchor.metadata.annotations[MARKET_LEASE_ANNOTATION]
+    assert lease.startswith("preempting:1@")
+    rationale = json.loads(
+        anchor.metadata.annotations[MARKET_DECISION_ANNOTATION])
+    assert rationale["action"] == "preempt"
+    assert "pressure" in rationale and "value" in rationale
+    # failover: a fresh arbiter resumes PREEMPTING from the annotations
+    arb2 = CapacityArbiter(
+        [ManagedSlice("s0", ["n-t0", "n-t1"])], client=cluster.client,
+        demand=demand, clock=clock, vacated=lambda ms: True,
+        config=MarketConfig(preempt_rate=1.5, sustain_ticks=1,
+                            cooldown_seconds=0.0))
+    arb2.tick()
+    # resumed mid-trade (not re-decided): training had vacated, so the
+    # new leader moves the SAME trade on to serving
+    assert arb2.supply[0].phase == SERVING
+    assert arb2.supply[0].decision_id >= 2
+    assert direct.get_node("n-t0").metadata.labels[
+        MARKET_OWNER_LABEL] == "serving"
+
+
+class _FlakyClient:
+    """Wraps a FakeCluster client; patch calls fail until armed."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.fail_patches = 0
+
+    def direct(self):
+        return self._inner.direct()
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name.startswith("patch_") and callable(attr):
+            def call(*a, **kw):
+                if self.fail_patches > 0:
+                    self.fail_patches -= 1
+                    raise RuntimeError("injected patch failure")
+                return attr(*a, **kw)
+            return call
+        return attr
+
+
+def test_failed_stamp_retries_until_it_lands():
+    clock = FakeClock(10.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.add_node("n-t0")
+    client = _FlakyClient(cluster.client)
+    demand = _Demand()
+    demand.depths["interactive"] = 20
+    arb = CapacityArbiter(
+        [ManagedSlice("s0", ["n-t0"])], client=client, demand=demand,
+        clock=clock, vacated=lambda ms: False,
+        config=MarketConfig(preempt_rate=1.5, sustain_ticks=1,
+                            cooldown_seconds=0.0))
+    client.fail_patches = 2
+    arb.tick()
+    ms = arb.supply[0]
+    assert ms.phase == PREEMPTING and ms.stamp_pending
+    node = cluster.client.direct().get_node("n-t0")
+    assert MARKET_OWNER_LABEL not in node.metadata.labels
+    arb.tick()      # retry (one more injected failure burns here)
+    arb.tick()      # lands
+    assert not ms.stamp_pending
+    node = cluster.client.direct().get_node("n-t0")
+    assert node.metadata.labels[MARKET_OWNER_LABEL] == "draining"
+
+
+def test_leased_slices_prefer_in_autoscaler_placement():
+    """The lease contract's consumer: Autoscaler passes the arbiter's
+    lent slices as the scheduler's placement preference."""
+    from k8s_operator_libs_tpu.serving.autoscaler import (Autoscaler,
+                                                          AutoscalerConfig)
+    clock = FakeClock()
+    pool = _pool_with(n=1, max_slots=1, clock=clock)
+    router = RequestRouter(pool, clock=clock)
+    arb = CapacityArbiter([ManagedSlice("pool-9", ["n-t0"])], clock=clock)
+    arb.supply[0].phase = SERVING
+    seen = {}
+
+    class _Sched:
+        def place(self, workload, prefer=None):
+            seen["prefer"] = prefer
+            return None
+
+    from k8s_operator_libs_tpu.tpu.scheduler import TPUWorkload
+    scaler = Autoscaler(
+        pool, router, scheduler=_Sched(),
+        workload_template=TPUWorkload(
+            name="serve", accelerator="tpu-v5-lite-podslice",
+            topology="2x4"),
+        clock=clock, market=arb,
+        config=AutoscalerConfig(queue_high=1.0, cooldown_seconds=0.0))
+    # queue pressure (scraped replica backlog) forces a scale-up
+    # attempt through the scheduler
+    for i in range(6):
+        router.submit([1, i], 64)
+    pool.scrape()
+    scaler.tick()
+    assert seen["prefer"] is not None and seen["prefer"]("pool-9")
+    assert not seen["prefer"]("pool-other")
+
+
+def test_scheduler_prefer_orders_leased_slice_first():
+    from k8s_operator_libs_tpu.tpu.scheduler import (SliceScheduler,
+                                                     TPUWorkload)
+    from k8s_operator_libs_tpu.tpu.topology import (GKE_ACCELERATOR_LABEL,
+                                                    GKE_NODEPOOL_LABEL,
+                                                    GKE_TOPOLOGY_LABEL)
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    for pool_name in ("pool-a", "pool-b"):
+        labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                  GKE_TOPOLOGY_LABEL: "2x4",
+                  GKE_NODEPOOL_LABEL: pool_name}
+        for i in range(2):
+            cluster.add_node(f"{pool_name}-h{i}", labels=labels)
+    sched = SliceScheduler(cluster.client, clock=clock)
+    wl = TPUWorkload(name="serve", accelerator="tpu-v5-lite-podslice",
+                     topology="2x4")
+    placement = sched.place(wl, prefer=lambda sid: sid == "pool-b")
+    assert placement is not None
+    assert placement.slice_id == "pool-b"
+
+
+# --------------------------------------------- /market + status views
+
+
+def test_status_market_renders_and_exit_2(capsys):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "cmd_status_market",
+        os.path.join(os.path.dirname(__file__), "..", "cmd", "status.py"))
+    status = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(status)
+    payload = {"kind": "market", "data": {
+        "rate": 2.5, "pressure": 2.5, "value": 1.0,
+        "trades": 1, "returns": 0,
+        "lanes": {"interactive": {"queued": 3, "shed": 0,
+                                  "completed": 40},
+                  "best-effort": {"queued": 9, "shed": 12,
+                                  "completed": 5}},
+        "ownership": [{"slice": "pool-0", "owner": "serving",
+                       "phase": "serving", "nodes": ["n-t0"],
+                       "decision_id": 2, "stamp_pending": False}],
+        "decisions": [{"id": 1, "t": 1000.0, "action": "preempt",
+                       "slice": "pool-0", "rate": 2.5,
+                       "reason": "serving pressure 2.50 vs marginal "
+                                 "goodput 1.00"}],
+    }}
+    ns = type("A", (), {"operator_url": "http://op:8080",
+                        "as_json": False})()
+    rc = status.run_market_view(ns, fetch=lambda url, path: payload)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "LANE" in out and "best-effort" in out and "12" in out
+    assert "SLICE" in out and "serving" in out
+    assert "#1" in out and "preempt" in out and "goodput" in out
+    ns.as_json = True
+    rc = status.run_market_view(ns, fetch=lambda url, path: payload)
+    assert json.loads(capsys.readouterr().out)["kind"] == "market"
+
+    def boom(url, path):
+        raise OSError("connection refused")
+    ns.as_json = False
+    assert status.run_market_view(ns, fetch=boom) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_metrics_server_market_endpoint_envelope():
+    """The operator's metrics server serves /market as a {kind, data}
+    envelope (404 with the market off, like /profile)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "cmd_operator_market",
+        os.path.join(os.path.dirname(__file__), "..", "cmd",
+                     "operator.py"))
+    operator_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(operator_mod)
+    server = operator_mod.MetricsServer(0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/market", timeout=5)
+        assert err.value.code == 404
+        arb = CapacityArbiter([ManagedSlice("s0", ["n-t0"])],
+                              clock=FakeClock())
+        arb.tick()
+        server.snapshot["market"] = json.dumps(
+            {"kind": "market", "data": arb.payload()})
+        with urllib.request.urlopen(f"{base}/market", timeout=5) as resp:
+            env = json.loads(resp.read().decode())
+        assert env["kind"] == "market"
+        assert env["data"]["ownership"][0]["owner"] == "training"
+    finally:
+        server.stop()
+
+
+def test_market_gauges_emitted():
+    clock = FakeClock()
+    hub = MetricsHub()
+    arb = CapacityArbiter([ManagedSlice("s0", ["n-t0"])], metrics=hub,
+                          clock=clock)
+    arb.tick()
+    text = hub.render(prefix="tpu_market")
+    for family in MARKET_GAUGE_FAMILIES:
+        assert family in text, family
+
+
+# -------------------------------------------------------- demand e2e
+
+
+def test_flash_crowd_demand_e2e(tmp_path):
+    """ACCEPTANCE (ISSUE 13): a flash-crowd arrival sweep over
+    serving/sim.py. Interactive p99 queue wait stays bounded while
+    best-effort sheds first; at the sustained peak the arbiter preempts
+    the training slice (the elastic trainer SHRINKS, pricing the window
+    as degraded), a burst replica on the traded slice absorbs the
+    crowd, and after the trough the arbiter returns the slice — the
+    trainer GROWS back and its ledger shows one continuous run with
+    zero unavailability windows."""
+    clock = FakeClock(10_000.0)
+    ledger = GoodputLedger(str(tmp_path / "goodput.jsonl"), clock=clock)
+
+    pool = _pool_with(n=2, max_slots=2, tokens_per_step=4, clock=clock)
+    router = RequestRouter(pool, clock=clock, shed_high=24)
+
+    devices = [f"chip{i}" for i in range(8)]
+    burst = {"id": None, "n": 0}
+
+    def grant(ms):
+        burst["n"] += 1
+        replica = Replica(f"burst-{burst['n']}", ms.anchor,
+                          SimReplicaRuntime(max_slots=2,
+                                            tokens_per_step=4))
+        pool.register(replica)
+        burst["id"] = replica.id
+
+    def revoke(ms):
+        replica = pool.replicas.get(burst["id"]) if burst["id"] else None
+        if replica is None:
+            return True
+        if not replica.draining:
+            router.drain_replica(replica, "market-return")
+        if replica.drained:
+            pool.deregister(replica.id)
+            burst["id"] = None
+            return True
+        return False
+
+    flags = {"preempted": False, "returned": False}
+    arb = CapacityArbiter(
+        [ManagedSlice("train-0", ["n-t0"])], demand=router,
+        goodput_fn=lambda: 1.0,
+        preempt=lambda ms: flags.__setitem__("preempted", True),
+        vacated=lambda ms: trainer._device_count == 4,
+        grant=grant, revoke=revoke,
+        returned=lambda ms: flags.__setitem__("returned", True),
+        clock=clock,
+        config=MarketConfig(preempt_rate=1.2, return_rate=0.3,
+                            sustain_ticks=2, cooldown_seconds=25.0,
+                            queue_high=4.0))
+
+    step_i = {"n": 0}
+    rid_count = {"n": 0}
+
+    def world_tick():
+        """One modelled second: arrivals (the flash-crowd sweep between
+        steps 10 and 30), router + arbiter + replica steps."""
+        i = step_i["n"]
+        arrivals = 16 if 10 <= i < 30 else 1
+        for k in range(arrivals):
+            lane = LANES[rid_count["n"] % 3]
+            router.submit([rid_count["n"] % 311, 7], 4, lane=lane)
+            rid_count["n"] += 1
+        router.tick()
+        arb.tick()
+        for r in pool.replicas.values():
+            if not r.failed:
+                r.runtime.step()
+
+    def step_factory(mesh):
+        def step_fn(state, batch):
+            clock.advance(1.0)
+            step_i["n"] += 1
+            world_tick()
+            return types.SimpleNamespace(step=state.step + 1), {}
+        return step_fn
+
+    from k8s_operator_libs_tpu.train.harness import (CheckpointingTrainer,
+                                                     GrowNotice,
+                                                     ReclaimNotice)
+    trainer = CheckpointingTrainer(
+        None, str(tmp_path / "ckpt"),
+        step_fn=step_factory(None),
+        init_fn=lambda rng: types.SimpleNamespace(step=0),
+        step_factory=step_factory,
+        init_factory=lambda mesh: (lambda rng: types.SimpleNamespace(
+            step=0)),
+        mesh_factory=lambda devs: ("mesh", len(devs)),
+        checkpoint_interval=10_000, ledger=ledger, elastic=True)
+    trainer.save = lambda state, wait=False: int(state.step)
+    restored = types.SimpleNamespace(step=0)
+    trainer.init_or_resume = lambda rng: types.SimpleNamespace(
+        step=restored.step)
+    trainer._device_count = 8
+
+    def reclaim_signal():
+        # consume-once: the notice is an order, delivered exactly once
+        if flags["preempted"] and trainer._device_count == 8:
+            flags["preempted"] = False
+            restored.step = step_i["n"]
+            return ReclaimNotice(surviving_devices=devices[:4])
+        return None
+
+    def grow_signal():
+        if flags["returned"] and trainer._device_count == 4:
+            flags["returned"] = False
+            restored.step = step_i["n"]
+            return GrowNotice(devices=devices)
+        return None
+
+    result = trainer.run(types.SimpleNamespace(step=0),
+                         iter(lambda: object(), None), num_steps=90,
+                         reclaim_signal=reclaim_signal,
+                         grow_signal=grow_signal)
+    ledger.close()
+
+    # drain whatever is still queued after the run ends
+    for _ in range(100):
+        router.tick()
+        arb.tick()
+        for r in pool.replicas.values():
+            if not r.failed:
+                r.runtime.step()
+        clock.advance(1.0)
+        if router.outstanding == 0 and burst["id"] is None:
+            break
+
+    # --- the market traded and returned
+    assert arb.trades == 1, arb.decisions
+    assert arb.returns == 1, arb.decisions
+    assert result.reshards == 2           # shrink + grow
+    assert result.device_count == 8       # grown back
+    assert not result.preempted
+
+    # --- one continuous run priced as degraded, never downtime
+    records = read_ledger(ledger.path)
+    assert len(split_runs(records)) == 1
+    assert unavailability_windows(records) == []
+    degraded = [r for r in records if r.get("phase") == "degraded"]
+    assert len(degraded) == 1
+    assert degraded[0]["devices_before"] == 8
+    assert degraded[0]["devices_after"] == 4
+    assert degraded[0]["seconds_lost"] == pytest.approx(
+        degraded[0]["duration_s"] * 0.5)
+    s = summarize(records)
+    assert s["badput_s"]["degraded"] == pytest.approx(
+        degraded[0]["seconds_lost"])
+
+    # --- demand-side QoS: best-effort shed first, interactive never,
+    # and the interactive p99 queue wait stays bounded through the spike
+    assert router._lane_shed["interactive"] == 0
+    assert router._lane_shed["best-effort"] >= 1
+    assert router._lane_shed["best-effort"] >= \
+        router._lane_shed["batch"]
+    waits = sorted(r.queue_wait_s for r in router.requests.values()
+                   if r.lane == "interactive"
+                   and r.queue_wait_s is not None)
+    assert waits, "no interactive request was ever placed"
+    p99 = waits[min(len(waits) - 1, int(len(waits) * 0.99))]
+    assert p99 <= 10.0, f"interactive p99 queue wait {p99}s unbounded"
+
+    # --- exactly-once through the whole sweep: everything accepted is
+    # either delivered (token-identical) or explicitly shed
+    assert router.check_invariants() == []
+    for rid, req in router.requests.items():
+        assert req.state in ("completed", "shed"), (rid, req.state)
+        if req.state == "completed":
+            assert req.tokens == sim_tokens(req.prompt, req.max_new)

@@ -44,6 +44,11 @@ def main(argv=None) -> int:
                         "of the seeded-random generator")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable per-seed results")
+    p.add_argument("--require-market-trade", action="store_true",
+                   help="fail unless at least one scenario exercised a "
+                        "capacity-market trade (the CI smoke's guarantee "
+                        "that the flash-crowd/arbiter path runs, not "
+                        "just converges — docs/capacity-market.md)")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="per-scenario fault schedules even on PASS")
     args = p.parse_args(argv)
@@ -80,6 +85,14 @@ def main(argv=None) -> int:
               f"{len(failed)} failed, {total_ticks} ticks, "
               f"{total_failover} failovers, "
               f"{time.time() - t0:.1f}s wall")
+    trades = sum((r.router_stats or {}).get("market_trades", 0)
+                 for r in results)
+    if not args.as_json:
+        print(f"capacity-market trades across the run: {trades}")
+    if args.require_market_trade and trades == 0:
+        print("FAIL: --require-market-trade set but no scenario "
+              "exercised a capacity-market trade", file=sys.stderr)
+        return 1
     return 1 if failed else 0
 
 

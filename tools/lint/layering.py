@@ -60,11 +60,17 @@ LAYERS: Dict[str, Set[str]] = {
     "tpu": {"core", "utils", "api", "upgrade", "crdutil", "health", "obs",
             "wire"},
     # chaos sits at the TOP of the operator spine: it drives the whole
-    # stack (operator, electors, health, SLO, the serving router tier)
-    # under injected faults and asserts cross-layer invariants — nothing
-    # below may import it back
+    # stack (operator, electors, health, SLO, the serving router tier,
+    # the capacity market) under injected faults and asserts cross-layer
+    # invariants — nothing below may import it back
     "chaos": {"core", "utils", "api", "upgrade", "health", "tpu", "obs",
-              "wire", "serving"},
+              "wire", "serving", "market"},
+    # market arbitrates between the serving tier and the training
+    # harness: it reads the router's lanes, the SLO engine's burn, and
+    # the upgrade pipeline's budget — only chaos sits above it, and the
+    # trainer side is reached through injected signals, never an import
+    "market": {"core", "utils", "api", "obs", "serving", "tpu",
+               "upgrade", "wire"},
     "data": {"utils"},
     "ops": {"utils"},
     # obs sits below BOTH spines: the workload side (goodput ledger,

@@ -311,10 +311,11 @@ register(Check(name="obs-attribution", codes=ATTRIBUTION_CODES,
 # ------------------------------------------------ OBS003 (SLO/alerting)
 
 SLO_CODES = {
-    "OBS003": "SLO/alerting/router/flight-recorder metric drift: an SLO "
-              "spec references an unregistered metric family, an emitted "
-              "slo/alert/router/profile family has no HELP_TEXTS entry, "
-              "or a tpu_operator_slo_*/tpu_operator_alert_*/tpu_router_*/"
+    "OBS003": "SLO/alerting/router/market/flight-recorder metric drift: "
+              "an SLO spec references an unregistered metric family, an "
+              "emitted slo/alert/router/market/profile family has no "
+              "HELP_TEXTS entry, or a tpu_operator_slo_*/"
+              "tpu_operator_alert_*/tpu_router_*/tpu_market_*/"
               "tpu_operator_apiserver_*/tpu_operator_tsdb_*/"
               "tpu_operator_obs_scrape_* HELP entry matches no emitted "
               "family",
@@ -332,10 +333,14 @@ ROUTER_METRICS_PATH = "k8s_operator_libs_tpu/serving/metrics.py"
 # apiserver-call accounting + scrape self-metrics); same absent-package
 # skip rule
 PROFILE_PATH = "k8s_operator_libs_tpu/obs/profile.py"
+# the capacity arbiter's emitted-family table (MARKET_GAUGE_FAMILIES);
+# same absent-package skip rule as the router closure
+MARKET_METRICS_PATH = "k8s_operator_libs_tpu/market/metrics.py"
 # HELP entries under these prefixes must correspond to families the
 # engine/alert manager actually emits (no stale catalog entries)
 SLO_FAMILY_PREFIXES = ("tpu_operator_slo_", "tpu_operator_alert_")
 ROUTER_FAMILY_PREFIX = "tpu_router_"
+MARKET_FAMILY_PREFIX = "tpu_market_"
 PROFILE_FAMILY_PREFIXES = ("tpu_operator_apiserver_",
                            "tpu_operator_tsdb_",
                            "tpu_operator_obs_scrape_")
@@ -506,6 +511,33 @@ def run_slo(root) -> List[Finding]:
                      f"family in ROUTER_GAUGE_FAMILIES or "
                      f"ROUTER_HISTOGRAM_FAMILIES ({ROUTER_METRICS_PATH})"
                      f" (renamed or removed router metric?)"))
+
+    # capacity market: the market/metrics.py emitted-family table closes
+    # over HELP_TEXTS both ways like the router tables (skipped when the
+    # checkout carries no market package)
+    if index.exists(MARKET_METRICS_PATH):
+        market_tree = index.tree(MARKET_METRICS_PATH)
+        market_emitted, market_line = _string_tuple(
+            market_tree, "MARKET_GAUGE_FAMILIES")
+        if market_line == 0:
+            findings.append(
+                (MARKET_METRICS_PATH, 1, "OBS003",
+                 "MARKET_GAUGE_FAMILIES table not found (parse drift?)"))
+        for family, lineno in sorted(market_emitted.items()):
+            if family not in help_keys:
+                findings.append(
+                    (MARKET_METRICS_PATH, lineno, "OBS003",
+                     f"emitted market family {family!r} has no "
+                     f"HELP_TEXTS entry ({METRICS_PATH})"))
+        for key, lineno in sorted(help_keys.items()):
+            if (key.startswith(MARKET_FAMILY_PREFIX)
+                    and key not in market_emitted):
+                findings.append(
+                    (METRICS_PATH, lineno, "OBS003",
+                     f"HELP_TEXTS entry {key!r} matches no emitted "
+                     f"family in MARKET_GAUGE_FAMILIES "
+                     f"({MARKET_METRICS_PATH}) (renamed or removed "
+                     f"market metric?)"))
 
     # flight recorder: the obs/profile.py emitted-family tables close
     # over HELP_TEXTS both ways too (skipped when the checkout carries
