@@ -12,9 +12,10 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-obs-profile test-chaos test-router test-migration \
-  test-market test-race \
-  health-sim chaos race race-smoke fleetbench fleetbench-smoke lint \
+  test-obs-slo test-obs-profile test-delta test-chaos test-router \
+  test-migration test-market test-race \
+  health-sim chaos chaos-market-smoke race race-smoke fleetbench \
+  fleetbench-smoke lint \
   lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
   dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
@@ -50,14 +51,21 @@ test-obs-profile:  ## tick flight recorder: CountingClient accounting, profile d
 FLEET_NODES ?= 10000
 FLEET_SLICES ?= 1000
 FLEET_TICKS ?= 12
-fleetbench:  ## control-plane scale baseline: ~10k-node/~1k-slice fakecluster through upgrade+health+SLO ticks with the profiler on; writes FLEET_r01.json (reconcile-tick p99, apiserver calls by verb, tsdb + journey integrity at scale) — the number the ROADMAP item-2 sharded reconcile must beat
-	$(PYTHON) tools/fleetbench.py --nodes $(FLEET_NODES) --slices $(FLEET_SLICES) --ticks $(FLEET_TICKS)
+FLEET_SHARDS ?= 8
+fleetbench:  ## control-plane scale benchmark: ~10k-node/~1k-slice fakecluster through upgrade+health+SLO ticks with the profiler on; writes FLEET_r02.json on the informer-cached, delta-driven, sharded read path (PR 14) and asserts the checked-in call budget. `--uncached --shards 0 --round r01` reproduces the FLEET_r01 baseline it beats
+	$(PYTHON) tools/fleetbench.py --nodes $(FLEET_NODES) --slices $(FLEET_SLICES) \
+	  --ticks $(FLEET_TICKS) --shards $(FLEET_SHARDS) \
+	  --budget tools/fleetbench_budget.json
 
 FLEET_SMOKE_BUDGET ?= 300
-fleetbench-smoke:  ## budgeted CI gate (like lint-smoke): the same harness at ~500 nodes must finish inside FLEET_SMOKE_BUDGET seconds with every assertion holding
+fleetbench-smoke:  ## budgeted CI gate (like lint-smoke): the same harness at ~500 nodes must finish inside FLEET_SMOKE_BUDGET seconds with every assertion holding — including the apiserver-call budget (tools/fleetbench_budget.json: calls/node/tick + per-verb ceilings, unbudgeted verbs fail) and the incremental-vs-rebuild equivalence oracle every tick
 	timeout $(FLEET_SMOKE_BUDGET) $(PYTHON) tools/fleetbench.py \
 	  --nodes 500 --slices 50 --ticks 6 --warmup 2 \
+	  --verify-incremental --budget tools/fleetbench_budget.json \
 	  --out /tmp/fleet_smoke.json
+
+test-delta:  ## PR 14 delta-driven reconcile: dirty-set drain vs snapshot equivalence under randomized mutations (incl. watch-lag + re-list gap), incremental BuildState oracle, no-op patch dedupe call-count pins, shard runner / budget accountant, parallel-vs-serial rollout equivalence, quiet-tick near-zero-calls pin, cached+sharded chaos seed
+	$(PYTHON) -m pytest tests/test_deltacache.py -q
 
 test-chaos:  ## chaos harness + elastic training suites (docs/chaos.md)
 	$(PYTHON) -m pytest tests/test_chaos.py tests/test_elastic.py -q
@@ -76,15 +84,19 @@ health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 
 SEEDS ?= 20
 CHAOS_FLAGS ?=
-chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
-	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS) $(CHAOS_FLAGS)
+chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). Runs with the informer-cached read path and the sharded reconcile ON (deterministic serial shard execution — real interleavings are `make race`'s job). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
+	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS) --cached-reads \
+	  --shard-workers 2 $(CHAOS_FLAGS)
+
+chaos-market-smoke:  ## the PR 13 arbiter-path guarantee on the legacy read path: seed 1's flash crowd must execute a capacity-market trade. (On the PR 14 cached path the fleet recovers fast enough during these seeds' crowds that the arbiter correctly declines to trade — deterministic trade coverage lives in test_market + the pinned test_chaos composite; this smoke keeps the uncached trade e2e exercised end to end.)
+	$(PYTHON) tools/chaos_campaign.py --seeds 3 --require-market-trade
 
 RACE_SEEDS ?= 40
-race:  ## deterministic schedule exploration of the six real-component harnesses (drain/evict workers, leader renew-vs-demote, informer-vs-reader, uploader, router ticker-vs-proxy) with lockset race detection; failures report seed + shrunk replayable trace (docs/static-analysis.md "Schedule exploration")
+race:  ## deterministic schedule exploration of the seven real-component harnesses (drain/evict workers, leader renew-vs-demote, informer-vs-reader, uploader, router ticker-vs-proxy, sharded reconcile + budget accountant + dirty-set drain) with lockset race detection; failures report seed + shrunk replayable trace (docs/static-analysis.md "Schedule exploration")
 	$(PYTHON) -m tools.race --seeds $(RACE_SEEDS)
 
 RACE_BUDGET ?= 120
-race-smoke:  ## fixed seeds under a wall-clock budget (the CI gate, like lint-smoke): planted-bug self-test first — the detector must still detect — then the six harnesses on a few seeds
+race-smoke:  ## fixed seeds under a wall-clock budget (the CI gate, like lint-smoke): planted-bug self-test first — the detector must still detect — then the seven harnesses on a few seeds
 	$(PYTHON) -m tools.race --self-test
 	$(PYTHON) -m tools.race --smoke --budget $(RACE_BUDGET)
 

@@ -102,6 +102,24 @@ def load_slo(path: str):
     return SLOOptions.from_dict(section)
 
 
+def load_reconcile(path: str) -> dict:
+    """Optional top-level ``reconcile:`` section — the PR 14 scale knobs:
+
+        reconcile:
+          shardWorkers: 8          # per-slice-group workers (0 = serial)
+          verifyIncremental: false # incremental-vs-rebuild oracle per tick
+
+    Defaults keep the serial, oracle-off behavior."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    section = cfg.get("reconcile") or {}
+    return {
+        "shard_workers": int(section.get("shardWorkers", 0)),
+        "verify_incremental": bool(section.get("verifyIncremental", False)),
+    }
+
+
 def load_market(path: str):
     """Optional top-level ``market:`` section (docs/capacity-market.md):
 
@@ -379,6 +397,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         health = load_health(args.config)
         slo = load_slo(args.config)
         market_section = load_market(args.config)
+        reconcile_opts = load_reconcile(args.config)
         client, recorder = build_client(args, components)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -411,7 +430,13 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
     hub.set_gauge("leader", 0.0 if args.leader_elect else 1.0)
     operator = TPUOperator(client, components, recorder=recorder,
                            health=health, tracer=tracer, metrics=hub,
-                           slo=slo)
+                           slo=slo,
+                           shard_workers=reconcile_opts["shard_workers"],
+                           verify_incremental=reconcile_opts[
+                               "verify_incremental"])
+    if reconcile_opts["shard_workers"] > 1:
+        logger.info("sharded reconcile on (%d per-slice-group workers)",
+                    reconcile_opts["shard_workers"])
     if health is not None:
         logger.info("fleet health monitoring on (repair component %s)",
                     operator.health_component)

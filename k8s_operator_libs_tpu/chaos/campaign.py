@@ -138,7 +138,7 @@ def build_fleet(cluster: FakeCluster, fleet) -> List[str]:
 
 
 def _make_operator(client, recorder, clock, max_unavailable: str,
-                   tracer=None) -> TPUOperator:
+                   tracer=None, shard_workers: int = 0) -> TPUOperator:
     return TPUOperator(
         client,
         components=[ManagedComponent(
@@ -157,7 +157,16 @@ def _make_operator(client, recorder, clock, max_unavailable: str,
             policy=RemediationPolicy(recovery_seconds=45.0,
                                      backoff_base_seconds=60.0,
                                      max_unavailable=max_unavailable)),
-        slo=SLOOptions.from_dict({}), tracer=tracer)
+        slo=SLOOptions.from_dict({}), tracer=tracer,
+        # sharded reconcile under chaos runs the shard machinery
+        # DETERMINISTICALLY (serial shard order, shared budget
+        # accountant) so seed replay stays byte-identical; the real
+        # interleavings are explored under `make race`
+        shard_workers=shard_workers, shard_parallel=False,
+        # every campaign tick double-checks the incremental BuildState
+        # against a full rebuild — divergence fails the component's
+        # reconcile, which the convergence gate turns into a red run
+        verify_incremental=True)
 
 
 class SimJob:
@@ -459,7 +468,9 @@ def run_scenario(scenario: Scenario, seed: int,
                  invariants: Optional[List[Invariant]] = None,
                  hooks: Optional[List[Callable]] = None,
                  stop_on_violation: bool = True,
-                 profile: bool = False) -> CampaignResult:
+                 profile: bool = False,
+                 cached_reads: bool = False,
+                 shard_workers: int = 0) -> CampaignResult:
     """Run one scenario under one seed to convergence (or violation /
     tick exhaustion). ``hooks`` run each tick after the reconcile and
     before the invariant pass — tests inject rogue out-of-band writes
@@ -469,7 +480,15 @@ def run_scenario(scenario: Scenario, seed: int,
     (Tracer + TickProfiler + CountingClient between operator and chaos
     client) — pure accounting, so every invariant outcome, journey
     annotation, and router stat must be IDENTICAL to a profile=False run
-    of the same seed; tests/test_obs_profile.py pins exactly that."""
+    of the same seed; tests/test_obs_profile.py pins exactly that.
+
+    ``cached_reads=True`` gives each candidate the PR 14 informer read
+    path: a pumped (synchronous, deterministic) CachedClient stacked on
+    its chaos client, so list/watch traffic passes the fault gate while
+    operator reads come from the informer stores, and BuildState runs
+    incrementally from drained deltas with the equivalence oracle ON.
+    ``shard_workers`` additionally runs the sharded reconcile in its
+    deterministic serial mode. `make chaos` runs with both on."""
     clock = FakeClock(10_000.0)
     cluster = FakeCluster(clock=clock, cache_lag=0.5)
     fleet_nodes = build_fleet(cluster, scenario.fleet)
@@ -486,11 +505,22 @@ def run_scenario(scenario: Scenario, seed: int,
             profilers[identity] = TickProfiler()
             tracer = Tracer(sink=profilers[identity], clock=clock)
             client = counting_client(client, tracer=tracer, clock=clock)
-        elector = LeaderElector(client, LEASE_NAME, LEASE_NS, identity,
+        elector_client = client
+        if cached_reads:
+            from ..core.cachedclient import CachedClient
+            # pumped informers per candidate over ITS chaos client: the
+            # fault gate taxes the list/watch traffic, reads are local.
+            # Leases bypass the cache by design, so the elector sees the
+            # exact same fault surface either way.
+            client = CachedClient(client, namespaces=[NS], pumped=True,
+                                  clock=clock).start(sync_timeout=120.0)
+        elector = LeaderElector(elector_client, LEASE_NAME, LEASE_NS,
+                                identity,
                                 lease_duration_s=LEASE_DURATION_S,
                                 retry_period_s=LEASE_RETRY_S, clock=clock)
         op = _make_operator(client, cluster.recorder, clock,
-                            scenario.max_unavailable, tracer=tracer)
+                            scenario.max_unavailable, tracer=tracer,
+                            shard_workers=shard_workers)
         candidates.append((identity, elector, op))
 
     tmp = None
@@ -682,19 +712,22 @@ def shrink_failure(scenario: Scenario, seed: int,
 
 
 def run_campaign(seeds: int, base_seed: int = 0,
-                 scenario_fn=None) -> List[CampaignResult]:
+                 scenario_fn=None, **kwargs) -> List[CampaignResult]:
     """N seeded scenarios (``scenario_fn(seed) -> Scenario``, default
     :func:`~.scenario.random_scenario`); every result returned, failures
-    already shrunk."""
+    already shrunk. Extra kwargs (``cached_reads``, ``shard_workers``,
+    ``profile``) pass through to every :func:`run_scenario` — including
+    the shrink reruns, so a reproducer shrinks under the exact
+    configuration that failed."""
     from .scenario import random_scenario
     scenario_fn = scenario_fn or random_scenario
     results: List[CampaignResult] = []
     for i in range(seeds):
         seed = base_seed + i
         scenario = scenario_fn(seed)
-        result = run_scenario(scenario, seed)
+        result = run_scenario(scenario, seed, **kwargs)
         if result.failed:
-            minimal = shrink_failure(scenario, seed)
+            minimal = shrink_failure(scenario, seed, **kwargs)
             result.trace.append(
                 "shrunk reproducer:\n" + minimal.describe())
         results.append(result)

@@ -30,13 +30,42 @@ the missing half:
   two-client split for the drain helper and pod listing
   (upgrade_state.go:132-135).
 
-ControllerRevisions and Jobs pass through uncached: both are low-frequency
-point reads on the build-state path, and an uncached read is never *staler*
-than a cached one, so correctness is unaffected.
+ControllerRevisions are informer-cached too when the live client supports
+watching them (FakeCluster's client does; they are on the per-node
+"is the driver up to date" path, which made them an O(fleet) LIST source
+before PR 14) and pass through uncached otherwise. Jobs always pass
+through: genuinely low-frequency point reads.
 
 ``cache_lag`` injects an artificial delay before each watch event is applied
 to the store — the live-transport analog of FakeCluster's ``cache_lag``,
 used by tests to prove the barrier genuinely polls more than once.
+
+Two additions make the cache a *delta source* (ROADMAP item 2 — tick cost
+O(changed), not O(fleet)):
+
+- **Dirty sets.** Every informer accumulates the keys touched since the
+  consumer last drained them, with the terminal event kind per key.
+  :meth:`CachedClient.drain_deltas` hands them out per Kubernetes kind and
+  clears them; a ``resynced`` flag marks that a re-list happened (the
+  consumer's incremental view must full-rebuild — see
+  ``upgrade/upgrade_state.py:IncrementalStateBuilder``).
+- **Pumped mode** (``pumped=True``). Instead of background watch threads,
+  the informers advance only when :meth:`CachedClient.pump` is called —
+  one non-blocking watch poll per informer, applied on the CALLING
+  thread. The reconcile loop pumps at tick start and the provider's
+  cache-sync barrier pumps between polls, so the whole read path is
+  synchronous and byte-for-byte deterministic — which is what lets the
+  chaos campaign and fleetbench run the informer read path under a fake
+  clock. Production keeps the threaded mode.
+
+  Pacing caveat: watch delivery lags writes by the server-side
+  ``cache_lag``, measured on the injected clock. A consumer that ticks
+  in a tight loop without advancing time can therefore pump forever
+  without seeing un-barriered writes (pod deletes/creates) — tick on an
+  interval greater than the lag, as every in-repo consumer does
+  (``cmd/operator.py --interval``, fleetbench's modelled 30 s, the
+  campaign's 15 s fake-clock ticks). Provider-barriered writes are
+  immune: the barrier itself sleeps the clock past the lag.
 """
 
 from __future__ import annotations
@@ -76,6 +105,25 @@ def _match_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+class KindDelta:
+    """What changed for one kind since the consumer last drained:
+    ``changed`` maps (namespace, name) → the LAST event kind observed
+    ("ADDED"/"MODIFIED"/"DELETED"); ``resynced`` means a full re-list
+    replaced the store (initial sync, 410 Gone, or transport failure) —
+    per-key deltas are meaningless across it and consumers must rebuild."""
+
+    __slots__ = ("kind", "changed", "resynced")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.changed: Dict[_Key, str] = {}
+        self.resynced = False
+
+    def __repr__(self) -> str:
+        return (f"<KindDelta {self.kind} changed={len(self.changed)} "
+                f"resynced={self.resynced}>")
+
+
 class _Informer:
     """List-then-watch loop for one kind, feeding a keyed store."""
 
@@ -99,8 +147,16 @@ class _Informer:
         self._rv: Optional[str] = None  # watch resume point; None → re-list
         self._resume_ok = False         # baseline RV came from the LIST
         self._supports_resume = True    # cleared on first TypeError
+        # delta surface: keys touched since the last drain (terminal event
+        # kind per key) + whether a re-list replaced the store wholesale —
+        # both read/written ONLY under the store lock
+        self._dirty: Dict[_Key, str] = {}
+        self._resynced = False
         name = f"informer-{kind.lower()}"
         self._lock = threads.make_lock(f"{name}-store")
+        # serializes pump_once() callers (the reconcile tick and barrier
+        # polls may pump from shard workers concurrently)
+        self._pump_lock = threads.make_lock(f"{name}-pump")
         self._synced = threads.make_event(f"{name}-synced")
         self._stop = threads.make_event(f"{name}-stop")
         self._thread = threads.spawn(name, self._run, start=False)
@@ -133,12 +189,94 @@ class _Informer:
         with self._lock:
             return [copy.deepcopy(o) for o in self._store.values()]
 
+    # --------------------------------------------------------------- deltas
+
+    def drain(self) -> Tuple[Dict[_Key, str], bool]:
+        """Hand out and clear the accumulated (dirty keys, resynced) pair."""
+        with self._lock:
+            dirty, self._dirty = self._dirty, {}
+            resynced, self._resynced = self._resynced, False
+            return dirty, resynced
+
+    # ----------------------------------------------------- resume point
+    #
+    # (_rv, _resume_ok) live under the STORE lock: the threaded loop is
+    # their sole writer in threaded mode, but pumped mode drives the same
+    # informer from whichever thread pumps (the reconcile tick, a barrier
+    # poll inside a shard worker), so every access goes through these.
+
+    def _resume_point(self):
+        with self._lock:
+            return self._rv, self._resume_ok
+
+    def _set_resume_point(self, rv, resume_ok=None) -> None:
+        with self._lock:
+            self._rv = rv
+            if resume_ok is not None:
+                self._resume_ok = resume_ok
+
+    def _advance_resume_point(self, event_rv) -> None:
+        """Adopt an event/bookmark RV as the resume point ONLY when the
+        baseline came from a LIST that reported one — otherwise events in
+        the LIST→watch-open gap were never covered and resuming would
+        skip them forever."""
+        with self._lock:
+            if self._resume_ok and event_rv:
+                self._rv = event_rv
+
+    # ---------------------------------------------------------------- pump
+
+    def pump_once(self) -> None:
+        """One synchronous list-or-watch step (pumped mode): re-list when
+        the resume point is lost, otherwise apply every watch event
+        available NOW. Transport failures leave the store stale (and the
+        resume point intact where possible) for the next pump — the
+        pump-mode analog of the thread loop's retry. ``_pump_lock``
+        serializes concurrent pump callers."""
+        with self._pump_lock:
+            rv, _ = self._resume_point()
+            if rv is None:
+                try:
+                    self._relist()
+                    self._synced.set()
+                except Exception as exc:
+                    logger.warning("informer %s: pump re-list failed: %s "
+                                   "(stale until next pump)", self.kind, exc)
+                return
+            try:
+                events = self._watch_fn(timeout_seconds=0.0,
+                                        resource_version=rv,
+                                        allow_bookmarks=True)
+            except WatchError as exc:
+                logger.info("informer %s: watch expired (%s); re-listing",
+                            self.kind, exc)
+                try:
+                    self._relist()
+                except Exception as exc2:
+                    self._set_resume_point(None)
+                    logger.warning("informer %s: pump re-list failed: %s "
+                                   "(stale until next pump)", self.kind, exc2)
+                return
+            except Exception as exc:
+                logger.warning("informer %s: pump watch failed: %s "
+                               "(stale until next pump)", self.kind, exc)
+                return
+            for etype, obj in events:
+                if etype == "BOOKMARK":
+                    self._advance_resume_point(obj.metadata.resource_version)
+                    continue
+                self._apply(etype, obj)
+                self._advance_resume_point(obj.metadata.resource_version)
+                if self.event_hook is not None:
+                    self.event_hook(self.kind, etype, obj)
+
     # ---------------------------------------------------------------- loop
 
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                if self._rv is None:
+                rv, _ = self._resume_point()
+                if rv is None:
                     self._relist()
                     self._synced.set()
                 stream = self._open_watch()
@@ -147,19 +285,13 @@ class _Informer:
                         return
                     if etype == "BOOKMARK":
                         # no object change — just a fresher resume point
-                        if self._resume_ok:
-                            self._rv = (obj.metadata.resource_version
-                                        or self._rv)
+                        self._advance_resume_point(
+                            obj.metadata.resource_version)
                         continue
                     if self._cache_lag:
                         self._clock.sleep(self._cache_lag)
                     self._apply(etype, obj)
-                    # adopt event RVs as resume points ONLY when the
-                    # baseline came from a LIST that reported one —
-                    # otherwise events in the LIST→watch-open gap were
-                    # never covered and resuming would skip them forever
-                    if self._resume_ok and obj.metadata.resource_version:
-                        self._rv = obj.metadata.resource_version
+                    self._advance_resume_point(obj.metadata.resource_version)
                     if self.event_hook is not None:
                         # post-apply: a reader woken by the hook sees the
                         # event already reflected in the store
@@ -169,13 +301,13 @@ class _Informer:
             except WatchError as exc:
                 logger.info("informer %s: watch expired (%s); re-listing",
                             self.kind, exc)
-                self._rv = None
+                self._set_resume_point(None)
             except Exception as exc:
                 if self._stop.is_set():
                     return
                 logger.warning("informer %s: %s; re-listing in 1s",
                                self.kind, exc)
-                self._rv = None
+                self._set_resume_point(None)
                 self._stop.wait(1.0)
 
     def _open_watch(self):
@@ -183,8 +315,9 @@ class _Informer:
         window preceded by a re-list, the pre-resume behavior) otherwise."""
         if self._supports_resume:
             try:
+                rv, _ = self._resume_point()
                 return self._watch_fn(timeout_seconds=self._window,
-                                      resource_version=self._rv,
+                                      resource_version=rv,
                                       allow_bookmarks=True)
             except TypeError:
                 self._supports_resume = False
@@ -193,8 +326,7 @@ class _Informer:
         # without resume, the next window must re-list — and event RVs must
         # not be adopted as resume points in the meantime (they would stop
         # the re-list while the watch has no replay to cover window gaps)
-        self._rv = None
-        self._resume_ok = False
+        self._set_resume_point(None, resume_ok=False)
         return self._watch_fn(timeout_seconds=self._window)
 
     def _relist(self) -> None:
@@ -202,23 +334,29 @@ class _Informer:
         # list fns may return (items, collection_rv) — the resume point —
         # or bare items (no resume support)
         items, rv = (result if isinstance(result, tuple) else (result, None))
-        with self._lock:
-            self._store = {_key(o): o for o in items}
         # RV "0" means "any version" to the server (no replay) — not a
         # usable resume point; treat like absent so the next window re-lists
-        self._rv = rv if rv and rv != "0" else None
-        self._resume_ok = self._rv is not None
+        resume = rv if rv and rv != "0" else None
+        with self._lock:
+            self._store = {_key(o): o for o in items}
+            # per-key deltas are void across a wholesale replace
+            self._dirty = {}
+            self._resynced = True
+            self._rv = resume
+            self._resume_ok = resume is not None
 
     def _apply(self, etype: str, obj) -> None:
         key = _key(obj)
         with self._lock:
             if etype == "DELETED":
                 self._store.pop(key, None)
+                self._dirty[key] = "DELETED"
                 return
             cached = self._store.get(key)
             if cached is None or _not_older(obj.metadata.resource_version,
                                             cached.metadata.resource_version):
                 self._store[key] = obj
+                self._dirty[key] = etype
 
 
 class CachedClient(Client):
@@ -231,13 +369,18 @@ class CachedClient(Client):
                  namespaces: Optional[List[str]] = None,
                  watch_window_seconds: float = 30.0,
                  cache_lag: float = 0.0,
-                 clock: Optional[Clock] = None):
-        """``namespaces`` scopes the Pod and DaemonSet informers: one
-        informer pair per namespace, so a shared cluster's unrelated pods
-        never enter the store (the reference consumer scopes its cache the
-        same way via manager.Options.Namespace). None = cluster-wide."""
+                 clock: Optional[Clock] = None,
+                 pumped: bool = False):
+        """``namespaces`` scopes the Pod / DaemonSet / ControllerRevision
+        informers: one informer set per namespace, so a shared cluster's
+        unrelated pods never enter the store (the reference consumer
+        scopes its cache the same way via manager.Options.Namespace).
+        None = cluster-wide. ``pumped=True`` runs every informer
+        synchronously on the caller's thread via :meth:`pump` — see the
+        module docstring."""
         self._live = live
         self._started = False
+        self._pumped = pumped
         self._clock = clock or RealClock()
         self._namespaces = sorted(set(namespaces)) if namespaces else [None]
         # prefer the *_with_rv list forms: they return the collection
@@ -251,6 +394,12 @@ class CachedClient(Client):
             _Informer("Node", list_nodes, live.watch_nodes,
                       watch_window_seconds, cache_lag,
                       clock=self._clock)]
+        # ControllerRevisions join the cache only when the live client can
+        # watch them (the fake apiserver can; a client that can't keeps
+        # the old uncached passthrough)
+        self._cr_cached = hasattr(live, "watch_controller_revisions")
+        list_cr = getattr(live, "list_controller_revisions_with_rv",
+                          live.list_controller_revisions)
         for ns in self._namespaces:
             self._informers.append(_Informer(
                 "Pod",
@@ -263,6 +412,13 @@ class CachedClient(Client):
                 lambda ns=ns, **kw: live.watch_daemonsets(namespace=ns,
                                                           **kw),
                 watch_window_seconds, cache_lag, clock=self._clock))
+            if self._cr_cached:
+                self._informers.append(_Informer(
+                    "ControllerRevision",
+                    lambda ns=ns: list_cr(namespace=ns),
+                    lambda ns=ns, **kw: live.watch_controller_revisions(
+                        namespace=ns, **kw),
+                    watch_window_seconds, cache_lag, clock=self._clock))
 
     def set_event_hook(self, hook: Optional[Callable]) -> None:
         """``hook(kind, etype, obj)`` fires after each watch event lands in
@@ -275,7 +431,23 @@ class CachedClient(Client):
 
     def start(self, sync_timeout: float = 30.0) -> "CachedClient":
         """Start informers and block until every cache has listed once
-        (mgr.GetCache().WaitForCacheSync analog)."""
+        (mgr.GetCache().WaitForCacheSync analog). In pumped mode the
+        initial lists run inline, retried on transient failure until the
+        (injected-clock) deadline."""
+        if self._pumped:
+            deadline = self._clock.now() + sync_timeout
+            for inf in self._informers:
+                while not inf.wait_synced(0.0):
+                    inf.pump_once()
+                    if inf.wait_synced(0.0):
+                        break
+                    if self._clock.now() >= deadline:
+                        raise TimeoutError(
+                            f"informer {inf.kind} failed to sync "
+                            f"within {sync_timeout}s")
+                    self._clock.sleep(0.5)
+            self._started = True
+            return self
         for inf in self._informers:
             inf.start()
         deadline = self._clock.now() + sync_timeout
@@ -290,10 +462,37 @@ class CachedClient(Client):
         return self
 
     def stop(self) -> None:
+        if self._pumped:
+            return  # no threads to stop
         for inf in self._informers:
             inf.stop()
         for inf in self._informers:
             inf.join(timeout=0.1)  # daemon threads; exit by next window
+
+    # ------------------------------------------------------ delta surface
+
+    def pump(self, kinds: Optional[Tuple[str, ...]] = None) -> None:
+        """Advance every (or the named kinds') informer by one synchronous
+        list-or-watch step. Pumped mode only (threaded informers advance
+        themselves); safe from concurrent threads."""
+        if not self._pumped:
+            return
+        for inf in self._informers:
+            if kinds is None or inf.kind in kinds:
+                inf.pump_once()
+
+    def drain_deltas(self) -> Dict[str, KindDelta]:
+        """The per-kind dirty sets accumulated since the last drain,
+        merged across namespace-scoped informers of the same kind, and
+        cleared. Consumers drain once per reconcile tick and patch their
+        incremental views from the result."""
+        out: Dict[str, KindDelta] = {}
+        for inf in self._informers:
+            changed, resynced = inf.drain()
+            delta = out.setdefault(inf.kind, KindDelta(inf.kind))
+            delta.changed.update(changed)
+            delta.resynced = delta.resynced or resynced
+        return out
 
     def __enter__(self) -> "CachedClient":
         return self.start()
@@ -302,7 +501,7 @@ class CachedClient(Client):
         self.stop()
 
     def _caches(self, kind: str) -> List[_Informer]:
-        if not self._started:
+        if not self._started:  # thr: allow — write-once in start() before any reader thread exists; GIL-atomic bool read
             raise RuntimeError("CachedClient.start() not called")
         return [inf for inf in self._informers if inf.kind == kind]
 
@@ -344,6 +543,12 @@ class CachedClient(Client):
 
     def list_controller_revisions(self, namespace=None, label_selector=None
                                   ) -> List[ControllerRevision]:
+        if self._cr_cached:
+            crs = [c for inf in self._caches("ControllerRevision")
+                   for c in inf.snapshot()]
+            if namespace:
+                crs = [c for c in crs if c.metadata.namespace == namespace]
+            return [c for c in crs if _match_labels(c, label_selector)]
         return self._live.list_controller_revisions(namespace, label_selector)
 
     def get_job(self, namespace: str, name: str) -> Job:
@@ -395,6 +600,9 @@ class CachedClient(Client):
 
     def watch_daemonsets(self, *a, **kw):
         return self._live.watch_daemonsets(*a, **kw)
+
+    def watch_controller_revisions(self, *a, **kw):
+        return self._live.watch_controller_revisions(*a, **kw)
 
     def direct(self) -> Client:
         """The uncached client (kubernetes.Interface analog) — the drain

@@ -68,9 +68,16 @@ class Helper:
 
     # ----------------------------------------------------------------- cordon
 
-    def run_cordon_or_uncordon(self, node_name: str, desired: bool) -> None:
+    def run_cordon_or_uncordon(self, node_name: str, desired: bool,
+                               node=None) -> None:
         """drain.RunCordonOrUncordon (used at drain_manager.go:111 and
-        cordon_manager.go:39-48). Idempotent."""
+        cordon_manager.go:39-48). Idempotent — and when the caller hands
+        the Node OBJECT it already holds, a node already at the desired
+        schedulability is skipped without a patch (the drain path used to
+        re-cordon every already-cordoned node, a guaranteed no-op
+        ``patch Node`` per drain at fleet scale)."""
+        if node is not None and bool(node.spec.unschedulable) == desired:
+            return
         self.client.patch_node_unschedulable(node_name, desired)
 
     # ------------------------------------------------------------------ drain

@@ -58,6 +58,15 @@ def _match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) ->
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+class _Bookmark:
+    """A watch BOOKMARK: no object change, just a fresher resume point
+    (metadata.resource_version is all a consumer may read)."""
+
+    def __init__(self, rv: str):
+        self.metadata = ObjectMeta(name="", namespace="",
+                                   resource_version=rv)
+
+
 class FakeRecorder(EventRecorder):
     """Captures events for assertion; drained between tests like the
     reference's FakeRecorder channel (upgrade_suit_test.go:176-199)."""
@@ -98,11 +107,16 @@ class FakeCluster:
         self._cache: Dict[Key, object] = {}
         self._crds: Dict[str, dict] = {}
         self._watchers: List["queue.Queue"] = []
-        # watch replay: bounded event history (rv, etype, kind, obj) so a
+        # watch replay: bounded event history (rv, etype, kind, obj, t) so a
         # client can resume from a resourceVersion instead of re-listing
         # (controller-runtime informer protocol); RVs at/below
-        # _history_floor have been compacted away → 410 Gone on resume
-        self._history: List[Tuple[int, str, str, object]] = []
+        # _history_floor have been compacted away → 410 Gone on resume.
+        # ``t`` is the clock time the write landed: the non-blocking
+        # watch poll (:meth:`watch_events`) delays delivery by
+        # ``cache_lag`` from it, so informer staleness — and the chaos
+        # ``watch-lag`` fault that widens it — is modelled at the watch
+        # stream, exactly where a real informer's lag lives.
+        self._history: List[Tuple[int, str, str, object, float]] = []
         self._history_floor = 0
         self._history_limit = 4096
         self._last_rv = 0
@@ -132,7 +146,8 @@ class FakeCluster:
             rv = int(obj.metadata.resource_version)
         except (TypeError, ValueError):
             rv = self._last_rv
-        self._history.append((rv, event_type, kind, deep_copy(obj)))
+        self._history.append((rv, event_type, kind, deep_copy(obj),
+                              self.clock.now()))
         if len(self._history) > self._history_limit:
             dropped = self._history[:-self._history_limit]
             self._history = self._history[-self._history_limit:]
@@ -160,8 +175,50 @@ class FakeCluster:
                     f"too old resource version: {floor} "
                     f"({self._history_floor})")
             return [(etype, kind, deep_copy(obj))
-                    for erv, etype, kind, obj in self._history
+                    for erv, etype, kind, obj, _t in self._history
                     if erv > floor]
+
+    def watch_events(self, kind: str, resource_version,
+                     namespace: Optional[str] = None,
+                     allow_bookmarks: bool = False) -> List[Tuple[str, object]]:
+        """Non-blocking watch poll for ONE kind: every event with
+        resourceVersion strictly greater than ``resource_version`` whose
+        cache-lag due time (write time + ``cache_lag``) has arrived, as
+        ``(etype, obj)`` pairs in commit order. Events not yet due are
+        withheld — and so is everything after them, preserving order — so
+        a pump-mode informer resumes exactly at the gap next poll. With
+        ``allow_bookmarks``, a trailing BOOKMARK carrying the collection
+        resourceVersion is appended when nothing was withheld, letting the
+        consumer's resume point pass kinds/namespaces it filtered out.
+        Raises :class:`ExpiredError` (410 Gone) past the history window."""
+        try:
+            floor = int(resource_version)
+        except (TypeError, ValueError):
+            raise ExpiredError(f"invalid resourceVersion {resource_version!r}")
+        with self._lock:
+            if floor < self._history_floor:
+                raise ExpiredError(
+                    f"too old resource version: {floor} "
+                    f"({self._history_floor})")
+            now = self.clock.now()
+            lag = self.cache_lag
+            out: List[Tuple[str, object]] = []
+            withheld = False
+            for erv, etype, k, obj, t in self._history:
+                if erv <= floor:
+                    continue
+                if t + lag > now:
+                    withheld = True
+                    break  # order-preserving: deliver a due prefix only
+                if k != kind:
+                    continue
+                if (namespace is not None
+                        and (obj.metadata.namespace or "") != namespace):
+                    continue
+                out.append((etype, deep_copy(obj)))
+            if allow_bookmarks and not withheld:
+                out.append(("BOOKMARK", _Bookmark(str(self._last_rv))))
+            return out
 
     # ------------------------------------------------------------------ store
 
@@ -546,6 +603,55 @@ class _FakeClient(Client):
 
     def get_job(self, namespace: str, name: str) -> Job:
         return self._c.get("Job", namespace, name, cached=self._cached)
+
+    # -- informer protocol --------------------------------------------------
+    #
+    # LIST-with-rv and non-blocking watch polls always serve STORE truth
+    # (an informer's LIST/WATCH is apiserver traffic, never its own
+    # cache), on both client views. Watch delivery lags writes by the
+    # cluster's ``cache_lag`` — see FakeCluster.watch_events — which is
+    # what a pump-mode CachedClient's staleness window is made of.
+
+    def list_nodes_with_rv(self, label_selector=None):
+        return self._c.list_with_rv("Node", namespace=None,
+                                    label_selector=label_selector)
+
+    def list_pods_with_rv(self, namespace=None, label_selector=None):
+        return self._c.list_with_rv("Pod", namespace=namespace,
+                                    label_selector=label_selector)
+
+    def list_daemonsets_with_rv(self, namespace=None, label_selector=None):
+        return self._c.list_with_rv("DaemonSet", namespace=namespace,
+                                    label_selector=label_selector)
+
+    def list_controller_revisions_with_rv(self, namespace=None,
+                                          label_selector=None):
+        return self._c.list_with_rv("ControllerRevision", namespace=namespace,
+                                    label_selector=label_selector)
+
+    def watch_nodes(self, timeout_seconds=None, resource_version=None,
+                    allow_bookmarks=False):
+        return self._c.watch_events("Node", resource_version,
+                                    allow_bookmarks=allow_bookmarks)
+
+    def watch_pods(self, namespace=None, timeout_seconds=None,
+                   resource_version=None, allow_bookmarks=False):
+        return self._c.watch_events("Pod", resource_version,
+                                    namespace=namespace,
+                                    allow_bookmarks=allow_bookmarks)
+
+    def watch_daemonsets(self, namespace=None, timeout_seconds=None,
+                         resource_version=None, allow_bookmarks=False):
+        return self._c.watch_events("DaemonSet", resource_version,
+                                    namespace=namespace,
+                                    allow_bookmarks=allow_bookmarks)
+
+    def watch_controller_revisions(self, namespace=None, timeout_seconds=None,
+                                   resource_version=None,
+                                   allow_bookmarks=False):
+        return self._c.watch_events("ControllerRevision", resource_version,
+                                    namespace=namespace,
+                                    allow_bookmarks=allow_bookmarks)
 
     # -- writes -------------------------------------------------------------
 

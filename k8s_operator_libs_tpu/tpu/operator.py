@@ -64,7 +64,9 @@ class TPUOperator:
                  health: Optional[HealthOptions] = None,
                  tracer=None, metrics=None,
                  stuck_thresholds: Optional[Dict[str, float]] = None,
-                 slo: Optional[SLOOptions] = None):
+                 slo: Optional[SLOOptions] = None,
+                 shard_workers: int = 0, shard_parallel: bool = True,
+                 verify_incremental: bool = False):
         self.client = client
         self.components = components
         self.clock = clock or RealClock()
@@ -96,7 +98,9 @@ class TPUOperator:
                 group_policy=group_policy, synchronous=synchronous,
                 sibling_keys=[k for name, k in all_keys.items()
                               if name != comp.name],
-                metrics=metrics, tracer=tracer)
+                metrics=metrics, tracer=tracer,
+                shard_workers=shard_workers, shard_parallel=shard_parallel)
+            mgr.verify_incremental = verify_incremental
             if comp.policy.pod_deletion is not None:
                 # delete exactly the pods holding TPU chips before drain
                 mgr.with_pod_deletion_enabled(tpu_workload_deletion_filter)
@@ -185,12 +189,25 @@ class TPUOperator:
         t0 = self.clock.now()
         states: Dict[str, Optional[object]] = {}
         with self._span("reconcile-tick", components=len(self.components)):
+            # informer-backed read path (core/cachedclient.py): advance the
+            # pumped caches once, then drain the per-kind dirty sets that
+            # feed each component's incremental BuildState — the tick's
+            # work becomes proportional to what changed, not to fleet size
+            deltas = None
+            pump = getattr(self.client, "pump", None)
+            drain_deltas = getattr(self.client, "drain_deltas", None)
+            if pump is not None:
+                with self._span("cache-pump"):
+                    pump()
+            if drain_deltas is not None:
+                deltas = drain_deltas()
             for comp in self.components:
                 mgr = self.managers[comp.name]
                 with self._span("apply_state", component=comp.name):
                     try:
                         state = mgr.build_state(comp.namespace,
-                                                comp.driver_labels)
+                                                comp.driver_labels,
+                                                deltas=deltas)
                         mgr.apply_state(state, comp.policy)
                         states[comp.name] = state
                     except Exception:

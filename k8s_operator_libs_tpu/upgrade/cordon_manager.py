@@ -20,9 +20,13 @@ class CordonManager:
         self._client = client
 
     def cordon(self, node: Node) -> None:
-        Helper(client=self._client).run_cordon_or_uncordon(node.metadata.name, True)
+        Helper(client=self._client).run_cordon_or_uncordon(
+            node.metadata.name, True, node=node)
+        node.spec.unschedulable = True
         logger.info("cordoned node %s", node.metadata.name)
 
     def uncordon(self, node: Node) -> None:
-        Helper(client=self._client).run_cordon_or_uncordon(node.metadata.name, False)
+        Helper(client=self._client).run_cordon_or_uncordon(
+            node.metadata.name, False, node=node)
+        node.spec.unschedulable = False
         logger.info("uncordoned node %s", node.metadata.name)

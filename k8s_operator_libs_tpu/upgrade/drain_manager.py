@@ -107,7 +107,7 @@ class DrainManager:
         name = node.metadata.name
         try:
             try:
-                helper.run_cordon_or_uncordon(name, True)
+                helper.run_cordon_or_uncordon(name, True, node=node)
             except Exception as exc:  # cordon failure → upgrade-failed (:112-118)
                 logger.error("failed to cordon node %s: %s", name, exc)
                 self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)

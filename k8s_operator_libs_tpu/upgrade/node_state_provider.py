@@ -123,6 +123,7 @@ class NodeUpgradeStateProvider:
         # and label flaps never reset time-in-state.
         per_node_annos = {}
         rv_floor = {}
+        skipped: set = set()
         for node in nodes:
             annos = dict(patched_annos)
             if labels is not None:
@@ -131,12 +132,33 @@ class NodeUpgradeStateProvider:
                 if old != new:
                     annos.update(self._journey.record(node, old, new))
             per_node_annos[node.metadata.name] = annos
+            # No-op dedupe: when the caller's view already shows every
+            # value this write would set AND the cached object agrees, the
+            # patch is pure churn (idempotent re-application) — skip patch,
+            # barrier, and event trail for this node. Both views must
+            # agree: a caller merely AHEAD of the cache still patches (the
+            # durable write is what matters), and a stale caller patches
+            # too (harmless re-assert).
+            if self._values_current(node, labels, label_value, annos):
+                cached = None
+                try:
+                    cached = self._client.get_node(node.metadata.name)
+                except Exception:
+                    pass
+                if cached is not None and self._values_current(
+                        cached, labels, label_value, annos):
+                    skipped.add(node.metadata.name)
+                    continue
             with self._mutex.lock(node.metadata.name):
                 patched = self._client.patch_node_metadata(
                     node.metadata.name, labels=labels,
                     annotations=annos or None)
             rv_floor[node.metadata.name] = getattr(
                 patched.metadata, "resource_version", "") if patched else ""
+        if skipped:
+            nodes = [n for n in nodes if n.metadata.name not in skipped]
+            if not nodes:
+                return
 
         def synced(n: Node) -> bool:
             if labels is not None and (
@@ -178,6 +200,24 @@ class NodeUpgradeStateProvider:
                               self._keys.event_reason,
                               f"Node annotation {k} {verb}")
 
+    def _values_current(self, node: Node, labels, label_value,
+                        annos: dict) -> bool:
+        """True when ``node`` already shows the state label (if being
+        written) and every annotation value (None = absent) this write
+        would set."""
+        if labels is not None:
+            if (node.metadata.labels.get(self._keys.state_label)
+                    != label_value):
+                return False
+        for k, v in annos.items():
+            current = node.metadata.annotations.get(k)
+            if v is None:
+                if k in node.metadata.annotations:
+                    return False
+            elif current != v:
+                return False
+        return True
+
     # --------------------------------------------------------------- barrier
 
     def _wait_synced_many(self, names, pred, rv_floor=None) -> None:
@@ -205,7 +245,15 @@ class NodeUpgradeStateProvider:
         rv_floor = rv_floor or {}
         deadline = self._clock.now() + self._sync_timeout
         poll = self._sync_poll / 20.0
+        # a pump-mode informer cache advances only when pumped — the
+        # barrier IS its poll loop, so drive the Node informer here
+        pump = getattr(self._client, "pump", None)
         while pending:
+            if pump is not None:
+                try:
+                    pump(kinds=("Node",))
+                except Exception:
+                    logger.debug("barrier pump failed; polling stale cache")
             for name in list(pending):
                 try:
                     n = self._client.get_node(name)

@@ -81,6 +81,13 @@ class PodManager:
         self._in_progress = StringSet()
         self._synchronous = synchronous
         self._threads: List[object] = []
+        # per-tick DaemonSet-revision-hash memo: resolving "is this driver
+        # up to date" used to LIST ControllerRevisions once per NODE per
+        # tick (O(fleet) — FLEET_r01 measured ~2.6k/tick at 10k nodes);
+        # the hash is a per-DaemonSet fact, so the state manager clears
+        # this at every BuildState and each DS resolves exactly once
+        self._rev_hash_memo: dict = {}
+        self._rev_hash_lock = threads.make_lock("pod-manager-rev-memo")
 
     # ----------------------------------------------------- revision hashes
 
@@ -93,10 +100,29 @@ class PodManager:
             raise ValueError(
                 f"pod {pod.metadata.name} has no {REVISION_HASH_LABEL} label")
 
+    def reset_revision_cache(self) -> None:
+        """Invalidate the per-tick DS-revision memo (called at every
+        BuildState, so a revision bump is seen next tick at the latest —
+        the same freshness an informer-cached read gives)."""
+        with self._rev_hash_lock:
+            self._rev_hash_memo = {}
+
     def get_daemonset_controller_revision_hash(self, ds: DaemonSet) -> str:
         """Latest template hash = hash label of the owned ControllerRevision
-        with the highest revision (pod_manager.go:95-121)."""
-        return daemonset_revision_hash(self._client.direct(), ds)
+        with the highest revision (pod_manager.go:95-121); memoized per
+        tick per DaemonSet. The ControllerRevision read prefers the cached
+        client (informer-backed since PR 14) over ``direct()`` — a stale
+        hash costs one extra reconcile, an O(fleet) LIST storm cost 2.6k
+        apiserver calls per tick."""
+        uid = ds.metadata.uid
+        with self._rev_hash_lock:
+            cached = self._rev_hash_memo.get(uid)
+        if cached is not None:
+            return cached
+        value = daemonset_revision_hash(self._client, ds)
+        with self._rev_hash_lock:
+            self._rev_hash_memo[uid] = value
+        return value
 
     # ------------------------------------------------------------ eviction
 

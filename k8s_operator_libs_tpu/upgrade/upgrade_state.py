@@ -31,7 +31,7 @@ from ..api.v1alpha1 import (
     DriverUpgradePolicySpec,
     scaled_int_or_percent,
 )
-from ..core.client import Client, EventRecorder
+from ..core.client import Client, EventRecorder, NotFoundError
 from ..core.objects import DaemonSet, Node, Pod
 from ..utils.clock import Clock, RealClock
 from . import consts
@@ -50,6 +50,7 @@ from .groups import (
 from .node_state_provider import NULL, NodeUpgradeStateProvider
 from .pod_manager import PodDeletionFilter, PodManager, PodManagerConfig
 from .safe_driver_load_manager import SafeDriverLoadManager
+from .sharding import BudgetAccountant, ShardRunner
 from .util import KeyFactory, log_event
 from .validation_manager import ValidationManager
 
@@ -96,6 +97,155 @@ class BuildStateError(RuntimeError):
     DaemonSet with unscheduled pods (reference upgrade_state.go:241-248)."""
 
 
+def state_fingerprint(state: ClusterUpgradeState) -> Dict[str, list]:
+    """Canonical, order-insensitive form of a ClusterUpgradeState for the
+    incremental-vs-rebuild equivalence oracle: per bucket, the sorted
+    (node, node RV, pod, pod RV, owner-DS uid) tuples. Resource versions
+    are included so a stale object — not just a missing one — fails the
+    comparison."""
+    out: Dict[str, list] = {}
+    for bucket, entries in state.node_states.items():
+        if not entries:
+            continue
+        out[bucket] = sorted(
+            (ns.node.metadata.name, ns.node.metadata.resource_version,
+             ns.driver_pod.metadata.namespace, ns.driver_pod.metadata.name,
+             ns.driver_pod.metadata.resource_version,
+             ns.driver_daemonset.metadata.uid
+             if ns.driver_daemonset is not None else None)
+            for ns in entries)
+    return out
+
+
+def _match_labels(labels: Dict[str, str],
+                  selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class IncrementalStateBuilder:
+    """BuildState that persists across ticks and is PATCHED from informer
+    deltas instead of re-joining the world (ROADMAP item 2, layer 2).
+
+    Holds the driver-pod / node / DaemonSet maps the join is made of;
+    :meth:`refresh` applies one tick's drained deltas (or full-rebuilds on
+    the first tick, on an informer re-list, or when no deltas are
+    available), and :meth:`assemble` re-buckets in memory — O(pods) python
+    work, zero apiserver calls. Node objects are refreshed only when their
+    key appears in a delta, which is sound because every state-machine
+    write is barriered into the cache before ApplyState returns, so the
+    next tick's drain is guaranteed to carry it."""
+
+    def __init__(self, manager: "ClusterUpgradeStateManager",
+                 namespace: str, driver_labels: Dict[str, str]):
+        self._mgr = manager
+        self._ns = namespace
+        self._labels = dict(driver_labels)
+        self._pods: Dict[tuple, Pod] = {}       # (ns, name) -> Pod
+        self._nodes: Dict[str, Node] = {}       # referenced nodes only
+        self._dss: Dict[str, DaemonSet] = {}    # uid -> DaemonSet
+        self._primed = False
+        self.rebuilds = 0                        # full rebuilds performed
+
+    def matches(self, namespace: str, driver_labels: Dict[str, str]) -> bool:
+        return self._ns == namespace and self._labels == dict(driver_labels)
+
+    # ------------------------------------------------------------ refresh
+
+    def refresh(self, deltas: Optional[dict]) -> None:
+        client = self._mgr.client
+        if (not self._primed or deltas is None
+                or any(d.resynced for d in deltas.values())):
+            self._rebuild()
+            return
+        ds_delta = deltas.get("DaemonSet")
+        if ds_delta is not None and any(ns == self._ns
+                                        for ns, _ in ds_delta.changed):
+            self._dss = {ds.metadata.uid: ds for ds in client.list_daemonsets(
+                namespace=self._ns, label_selector=self._labels)}
+        pod_delta = deltas.get("Pod")
+        if pod_delta is not None:
+            for (ns, name), etype in pod_delta.changed.items():
+                if ns != self._ns:
+                    continue
+                if etype == "DELETED":
+                    self._pods.pop((ns, name), None)
+                    continue
+                try:
+                    pod = client.get_pod(ns, name)
+                except NotFoundError:
+                    self._pods.pop((ns, name), None)
+                    continue
+                if _match_labels(pod.metadata.labels, self._labels):
+                    self._pods[(ns, name)] = pod
+                else:
+                    self._pods.pop((ns, name), None)
+        node_delta = deltas.get("Node")
+        if node_delta is not None:
+            for (_ns, name), etype in node_delta.changed.items():
+                if name not in self._nodes:
+                    continue  # unreferenced; fetched lazily if ever joined
+                if etype == "DELETED":
+                    self._nodes.pop(name, None)
+                    continue
+                try:
+                    self._nodes[name] = client.get_node(name)
+                except NotFoundError:
+                    self._nodes.pop(name, None)
+
+    def _rebuild(self) -> None:
+        client = self._mgr.client
+        self._dss = {ds.metadata.uid: ds for ds in client.list_daemonsets(
+            namespace=self._ns, label_selector=self._labels)}
+        self._pods = {(p.metadata.namespace, p.metadata.name): p
+                      for p in client.list_pods(
+                          namespace=self._ns, label_selector=self._labels)}
+        self._nodes = {}
+        self._primed = True
+        self.rebuilds += 1
+
+    # ----------------------------------------------------------- assemble
+
+    def assemble(self) -> ClusterUpgradeState:
+        """Re-bucket the index into a ClusterUpgradeState with EXACTLY the
+        full BuildState's semantics: DS-scheduled-count validation, the
+        Pending-unscheduled skip, orphan inclusion, foreign-owner
+        exclusion (upgrade_state.go:214-279)."""
+        counts: Dict[str, int] = {}
+        for pod in self._pods.values():
+            owners = pod.metadata.owner_references
+            if owners and owners[0].uid in self._dss:
+                counts[owners[0].uid] = counts.get(owners[0].uid, 0) + 1
+        for uid, ds in self._dss.items():
+            if ds.status.desired_number_scheduled != counts.get(uid, 0):
+                raise BuildStateError(
+                    f"driver DaemonSet {ds.metadata.name} should not have "
+                    f"Unscheduled pods (desired "
+                    f"{ds.status.desired_number_scheduled}, "
+                    f"got {counts.get(uid, 0)})")
+        state = ClusterUpgradeState()
+        provider = self._mgr.node_upgrade_state_provider
+        for key in sorted(self._pods):
+            pod = self._pods[key]
+            owners = pod.metadata.owner_references
+            owner = self._dss.get(owners[0].uid) if owners else None
+            if owners and owner is None:
+                continue  # owned by a controller we don't manage
+            if pod.spec.node_name == "" and pod.status.phase == "Pending":
+                logger.info("driver pod %s has no NodeName, skipping",
+                            pod.metadata.name)
+                continue
+            node = self._nodes.get(pod.spec.node_name)
+            if node is None:
+                node = provider.get_node(pod.spec.node_name)
+                self._nodes[pod.spec.node_name] = node
+            entry = NodeUpgradeState(node=node, driver_pod=pod,
+                                     driver_daemonset=owner)
+            label = node.metadata.labels.get(self._mgr.keys.state_label,
+                                             UpgradeState.UNKNOWN)
+            state.node_states.setdefault(label, []).append(entry)
+        return state
+
+
 class ClusterUpgradeStateManager:
     """Reference ClusterUpgradeStateManagerImpl (:104-151) with its five
     injected action managers, builder options WithPodDeletionEnabled /
@@ -114,13 +264,27 @@ class ClusterUpgradeStateManager:
                  validation_manager: Optional[ValidationManager] = None,
                  safe_load_manager: Optional[SafeDriverLoadManager] = None,
                  sibling_keys: Optional[List[KeyFactory]] = None,
-                 metrics=None, tracer=None):
+                 metrics=None, tracer=None,
+                 shard_workers: int = 0, shard_parallel: bool = True):
         self.client = client
         self.keys = keys
         self.recorder = recorder
         self.clock = clock or RealClock()
         self.grouper = grouper or SingleNodeGrouper()
         self.group_policy = group_policy or GroupPolicy()
+        # sharded reconcile (ROADMAP item 2 layer 3): per-slice-group
+        # workers for the per-node handler work; workers<=1 keeps the
+        # serial code path byte-identical, shard_parallel=False runs the
+        # shard machinery deterministically in order (chaos-campaign mode)
+        self._sharder = ShardRunner(workers=shard_workers,
+                                    parallel=shard_parallel,
+                                    name=f"reconcile-{keys.component}")
+        # incremental BuildState (layer 2): persists across ticks when the
+        # caller hands informer deltas; verify_incremental asserts the
+        # patched state equals a full rebuild every tick (the equivalence
+        # oracle — tests and `fleetbench --verify-incremental` turn it on)
+        self._inc: Optional[IncrementalStateBuilder] = None
+        self.verify_incremental = False
         # observability (obs/): ``metrics`` (a MetricsHub) feeds the
         # phase-duration and drain-duration histograms through the provider
         # choke point and the drain manager; ``tracer`` wraps each
@@ -184,13 +348,47 @@ class ClusterUpgradeStateManager:
 
     # ----------------------------------------------------------- BuildState
 
-    def build_state(self, namespace: str,
-                    driver_labels: Dict[str, str]) -> ClusterUpgradeState:
-        """BuildState (:214-279): point-in-time snapshot. Finds driver
-        DaemonSets + pods by label, joins each pod with its node, buckets by
-        the node's current state label. Orphaned pods (no owner DaemonSet)
-        are collected too (:250-251). Errors out if a DaemonSet has
-        unscheduled pods (:241-248)."""
+    def build_state(self, namespace: str, driver_labels: Dict[str, str],
+                    deltas: Optional[dict] = None) -> ClusterUpgradeState:
+        """BuildState (:214-279): the cluster joined into per-state buckets.
+
+        Without ``deltas`` (the default, and every direct test caller):
+        a stateless point-in-time full rebuild, exactly the reference.
+        With ``deltas`` (a ``CachedClient.drain_deltas()`` result, handed
+        down by the reconcile loop): the state PERSISTS across ticks and
+        is patched from what actually changed — a full rebuild happens
+        only on the first tick, after an informer re-list/resync, or when
+        the scope changed. Either way every read is a cached-store lookup
+        when the client is informer-backed; ``deltas`` additionally makes
+        the per-tick python work O(changed)+O(pods-rebucket) instead of
+        O(fleet) joins."""
+        self.pod_manager.reset_revision_cache()
+        if deltas is None:
+            self._inc = None
+            return self._build_state_full(namespace, driver_labels)
+        if self._inc is None or not self._inc.matches(namespace,
+                                                      driver_labels):
+            self._inc = IncrementalStateBuilder(self, namespace,
+                                                driver_labels)
+        self._inc.refresh(deltas)
+        state = self._inc.assemble()
+        if self.verify_incremental:
+            full = self._build_state_full(namespace, driver_labels)
+            if state_fingerprint(full) != state_fingerprint(state):
+                self._inc = None  # resync from scratch next tick
+                raise BuildStateError(
+                    "incremental BuildState diverged from full rebuild "
+                    "(equivalence oracle)")
+        return state
+
+    def _build_state_full(self, namespace: str,
+                          driver_labels: Dict[str, str]
+                          ) -> ClusterUpgradeState:
+        """The reference full rebuild: finds driver DaemonSets + pods by
+        label, joins each pod with its node, buckets by the node's current
+        state label. Orphaned pods (no owner DaemonSet) are collected too
+        (:250-251). Errors out if a DaemonSet has unscheduled pods
+        (:241-248)."""
         state = ClusterUpgradeState()
         daemonsets = {ds.metadata.uid: ds for ds in self.client.list_daemonsets(
             namespace=namespace, label_selector=driver_labels)}
@@ -291,30 +489,49 @@ class ClusterUpgradeStateManager:
                                       bucket_name: str) -> None:
         """ProcessDoneOrUnknownNodes (:488-550): decide upgrade-required vs
         done per node, from pod-vs-DS revision hash, the upgrade-requested
-        annotation, or the safe-load handshake."""
+        annotation, or the safe-load handshake. The per-node decisions are
+        pure reads — sharded across slice-group workers; the transitions
+        stay batched on the calling thread."""
+
+        def decide(items: List[NodeUpgradeState]):
+            plain: List[Node] = []
+            cordoned: List[Node] = []
+            done: List[Node] = []
+            for ns in items:
+                is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+                is_requested = self._is_upgrade_requested(ns.node)
+                waiting_safe_load = (
+                    self.safe_driver_load_manager
+                    .is_waiting_for_safe_driver_load(ns.node))
+                if ((not is_synced and not is_orphaned)
+                        or waiting_safe_load or is_requested):
+                    # Remember pre-upgrade unschedulable state so uncordon
+                    # can be skipped at the end (:512-523); batched with the
+                    # state label into one patch + one cache barrier. A
+                    # cordon attributable to a sibling component's in-flight
+                    # upgrade is TRANSIENT — recording it would make this
+                    # component skip uncordon too (mutual-skip deadlock when
+                    # both see each other's cordon).
+                    if (ns.node.spec.unschedulable
+                            and not self._sibling_caused_cordon(ns.node)):
+                        cordoned.append(ns.node)
+                    else:
+                        plain.append(ns.node)
+                    continue
+                if bucket_name == UpgradeState.UNKNOWN:
+                    done.append(ns.node)
+            return plain, cordoned, done
+
         require_plain: List[Node] = []
         require_cordoned: List[Node] = []
         to_done: List[Node] = []
-        for ns in state.bucket(bucket_name):
-            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
-            is_requested = self._is_upgrade_requested(ns.node)
-            waiting_safe_load = (
-                self.safe_driver_load_manager.is_waiting_for_safe_driver_load(ns.node))
-            if (not is_synced and not is_orphaned) or waiting_safe_load or is_requested:
-                # Remember pre-upgrade unschedulable state so uncordon can be
-                # skipped at the end (:512-523); batched with the state label
-                # into one patch + one cache barrier. A cordon attributable
-                # to a sibling component's in-flight upgrade is TRANSIENT —
-                # recording it would make this component skip uncordon too
-                # (mutual-skip deadlock when both see each other's cordon).
-                if (ns.node.spec.unschedulable
-                        and not self._sibling_caused_cordon(ns.node)):
-                    require_cordoned.append(ns.node)
-                else:
-                    require_plain.append(ns.node)
-                continue
-            if bucket_name == UpgradeState.UNKNOWN:
-                to_done.append(ns.node)
+        for plain, cordoned, done in self._sharder.run(
+                state.bucket(bucket_name),
+                key_fn=lambda ns: self.grouper.group_key(ns.node),
+                work_fn=decide):
+            require_plain.extend(plain)
+            require_cordoned.extend(cordoned)
+            to_done.extend(done)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
             require_plain, UpgradeState.UPGRADE_REQUIRED)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
@@ -342,104 +559,133 @@ class ClusterUpgradeStateManager:
         bucket = state.bucket(UpgradeState.UPGRADE_REQUIRED)
         in_progress = self.get_upgrades_in_progress(state)
         unavailable = self.get_current_unavailable_nodes(state)
-        admitted_this_pass = False
-        processed: set = set()
-        for ns in bucket:
-            if self._is_upgrade_requested(ns.node):
-                self.node_upgrade_state_provider.change_node_upgrade_annotation(
-                    ns.node, self.keys.upgrade_requested_annotation, NULL)
-            key = self.grouper.group_key(ns.node)
-            if key in processed:
-                continue
-            processed.add(key)
-            group = groups[key]
-            # The skip check is group-scoped, not node-scoped: checking only
-            # the per-node label would let admission triggered by a sibling
-            # member cordon the skipped host anyway (the group collects
-            # members by state label alone below).
-            skip_nodes = [m.node.metadata.name for m in group.members
-                          if self._skip_node_upgrade(m.node)]
-            if skip_nodes:
-                if group.size == 1:
-                    logger.info("node %s is marked for skipping upgrades",
-                                ns.node.metadata.name)
-                else:
+        # admission decisions fan out across slice-group shards; the
+        # maxUnavailable budget stays ONE locked accountant so concurrent
+        # shards can never over-admit (upgrade/sharding.py)
+        accountant = BudgetAccountant(upgrades_available)
+
+        def admit_groups(items: List[NodeUpgradeState]) -> List[Node]:
+            admitted: List[Node] = []
+            processed: set = set()
+            for ns in items:
+                if self._is_upgrade_requested(ns.node):
+                    self.node_upgrade_state_provider.change_node_upgrade_annotation(
+                        ns.node, self.keys.upgrade_requested_annotation, NULL)
+                key = self.grouper.group_key(ns.node)
+                if key in processed:
+                    continue
+                processed.add(key)
+                group = groups[key]
+                # The skip check is group-scoped, not node-scoped: checking
+                # only the per-node label would let admission triggered by a
+                # sibling member cordon the skipped host anyway (the group
+                # collects members by state label alone below).
+                skip_nodes = [m.node.metadata.name for m in group.members
+                              if self._skip_node_upgrade(m.node)]
+                if skip_nodes:
+                    if group.size == 1:
+                        logger.info("node %s is marked for skipping upgrades",
+                                    ns.node.metadata.name)
+                    else:
+                        logger.warning(
+                            "group %s held in upgrade-required: member "
+                            "node(s) %s carry the %s=true skip label and a "
+                            "multi-host slice upgrades atomically",
+                            group.key, ",".join(skip_nodes),
+                            self.keys.skip_node_label)
+                        log_event(
+                            self.recorder, ns.node, "Warning",
+                            self.keys.event_reason,
+                            f"Holding upgrade of group {group.key}: node(s) "
+                            f"{','.join(skip_nodes)} carry the "
+                            f"{self.keys.skip_node_label}=true label; a "
+                            f"multi-host slice cannot upgrade around one "
+                            f"host — remove the label to resume")
+                    continue
+                # Slice atomicity: a group may start only when every
+                # member's intent is known — members are upgrade-required
+                # themselves, already current (done: they'll wait at the
+                # group barriers), or already in progress (group already
+                # started; let stragglers join so it converges). Any member
+                # still unknown blocks the group for this pass.
+                if group.any_in((UpgradeState.UNKNOWN,)):
+                    continue
+                # Slice completeness (SURVEY §7.4): when the grouper knows
+                # the group's true size from topology metadata, refuse to
+                # admit a partial view — the unseen hosts would be restarted
+                # later, breaking atomicity. The group stays in
+                # upgrade-required until every host is visible.
+                expected = self.grouper.expected_group_size(ns.node)
+                if expected is not None and group.size != expected:
                     logger.warning(
-                        "group %s held in upgrade-required: member node(s) %s "
-                        "carry the %s=true skip label and a multi-host slice "
-                        "upgrades atomically",
-                        group.key, ",".join(skip_nodes),
-                        self.keys.skip_node_label)
+                        "group %s: observed %d member nodes but topology "
+                        "implies %d hosts — refusing to admit a partial "
+                        "slice view", group.key, group.size, expected)
                     log_event(
                         self.recorder, ns.node, "Warning",
                         self.keys.event_reason,
-                        f"Holding upgrade of group {group.key}: node(s) "
-                        f"{','.join(skip_nodes)} carry the "
-                        f"{self.keys.skip_node_label}=true label; a "
-                        f"multi-host slice cannot upgrade around one host — "
-                        f"remove the label to resume")
-                continue
-            # Slice atomicity: a group may start only when every member's
-            # intent is known — members are upgrade-required themselves,
-            # already current (done: they'll wait at the group barriers), or
-            # already in progress (group already started; let stragglers
-            # join so it converges). Any member still unknown blocks the
-            # group for this pass.
-            if group.any_in((UpgradeState.UNKNOWN,)):
-                continue
-            # Slice completeness (SURVEY §7.4): when the grouper knows the
-            # group's true size from topology metadata, refuse to admit a
-            # partial view — the unseen hosts would be restarted later,
-            # breaking atomicity. The group stays in upgrade-required until
-            # every host is visible.
-            expected = self.grouper.expected_group_size(ns.node)
-            if expected is not None and group.size != expected:
-                logger.warning(
-                    "group %s: observed %d member nodes but topology implies "
-                    "%d hosts — refusing to admit a partial slice view",
-                    group.key, group.size, expected)
-                log_event(
-                    self.recorder, ns.node, "Warning", self.keys.event_reason,
-                    f"Refusing to start upgrade of group {group.key}: only "
-                    f"{group.size} of {expected} member hosts are visible")
-                continue
-            members = [m for m, s in zip(group.members, group.member_states)
-                       if s == UpgradeState.UPGRADE_REQUIRED]
-            if not members:
-                continue
-            all_cordoned = all(m.node.spec.unschedulable for m in members)
-            # Budget is charged per node admitted, cordoned or not (the
-            # reference decrements upgradesAvailable for every node it moves
-            # to cordon-required, :621-624).
-            admit = len(members) <= upgrades_available
-            if not admit and all_cordoned:
-                # already-cordoned nodes progress even with no slots
-                # (reference :606-616); for an atomic group this bypass
-                # applies only when *all* pending members are cordoned.
-                admit = True
-            if (not admit and len(members) > 1
-                    and self.group_policy.allow_oversized_group):
-                # Deadlock breaker (SURVEY §7.4): a multi-node group that can
-                # never fit the budget (e.g. a v5e-16 slice vs maxParallel=1,
-                # or vs maxUnavailable=25% of a small pool) may start when the
-                # cluster is otherwise quiet — nothing in progress, nothing
-                # unavailable beyond this group's own pre-cordoned members,
-                # and nothing else admitted this pass.
-                cordoned = sum(1 for m in members if m.node.spec.unschedulable)
-                admit = (not admitted_this_pass and in_progress == 0
-                         and unavailable - cordoned == 0)
-            if admit:
-                self.node_upgrade_state_provider.change_nodes_state_and_annotations(
-                    [m.node for m in members], UpgradeState.CORDON_REQUIRED)
-                upgrades_available -= len(members)
-                admitted_this_pass = True
+                        f"Refusing to start upgrade of group {group.key}: "
+                        f"only {group.size} of {expected} member hosts are "
+                        f"visible")
+                    continue
+                members = [m for m, s in zip(group.members,
+                                             group.member_states)
+                           if s == UpgradeState.UPGRADE_REQUIRED]
+                if not members:
+                    continue
+                all_cordoned = all(m.node.spec.unschedulable
+                                   for m in members)
+                # Budget is charged per node admitted, cordoned or not (the
+                # reference decrements upgradesAvailable for every node it
+                # moves to cordon-required, :621-624).
+                admit = accountant.try_reserve(len(members))
+                if not admit and all_cordoned:
+                    # already-cordoned nodes progress even with no slots
+                    # (reference :606-616); for an atomic group this bypass
+                    # applies only when *all* pending members are cordoned —
+                    # still charged, like the reference's decrement.
+                    accountant.force_reserve(len(members))
+                    admit = True
+                if (not admit and len(members) > 1
+                        and self.group_policy.allow_oversized_group):
+                    # Deadlock breaker (SURVEY §7.4): a multi-node group
+                    # that can never fit the budget (e.g. a v5e-16 slice vs
+                    # maxParallel=1, or vs maxUnavailable=25% of a small
+                    # pool) may start when the cluster is otherwise quiet —
+                    # nothing in progress, nothing unavailable beyond this
+                    # group's own pre-cordoned members, and nothing else
+                    # admitted this pass (atomic under the accountant).
+                    cordoned = sum(1 for m in members
+                                   if m.node.spec.unschedulable)
+                    admit = accountant.try_admit_oversized(
+                        in_progress == 0 and unavailable - cordoned == 0)
+                if admit:
+                    admitted.extend(m.node for m in members)
+            return admitted
+
+        to_cordon = self._sharder.run_flat(
+            bucket, key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=admit_groups)
+        # one batched transition + one cache barrier for every admitted
+        # group (the serial code paid a patch-all + barrier per group)
+        self.node_upgrade_state_provider.change_nodes_state_and_annotations(
+            to_cordon, UpgradeState.CORDON_REQUIRED)
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
-        """ProcessCordonRequiredNodes (:635-654)."""
-        cordoned: List[Node] = []
-        for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
-            self.cordon_manager.cordon(ns.node)
-            cordoned.append(ns.node)
+        """ProcessCordonRequiredNodes (:635-654): cordon patches fan out
+        across slice-group shards; the state transition stays one batch."""
+
+        def cordon(items: List[NodeUpgradeState]) -> List[Node]:
+            done: List[Node] = []
+            for ns in items:
+                self.cordon_manager.cordon(ns.node)
+                done.append(ns.node)
+            return done
+
+        cordoned = self._sharder.run_flat(
+            state.bucket(UpgradeState.CORDON_REQUIRED),
+            key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=cordon)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
             cordoned, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
 
@@ -487,46 +733,68 @@ class ClusterUpgradeStateManager:
             return
         if not bucket:
             return
-        self.drain_manager.schedule_nodes_drain(DrainConfiguration(
-            spec=drain_spec, nodes=[ns.node for ns in bucket]))
+        # sharded: in synchronous mode each shard drains its slice groups
+        # in parallel instead of serializing the whole wave (the drain
+        # manager's own StringSet already dedups in-flight nodes); async
+        # mode spawns per-node workers either way
+        self._sharder.run(
+            bucket, key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=lambda items: self.drain_manager.schedule_nodes_drain(
+                DrainConfiguration(spec=drain_spec,
+                                   nodes=[ns.node for ns in items])))
 
     def process_pod_restart_nodes(self, state: ClusterUpgradeState,
                                   groups: Dict[str, GroupView]) -> None:
         """ProcessPodRestartNodes (:764-831) with the group restart barrier:
         in an atomic group, no driver pod restarts until every member host is
         drained (at or past pod-restart-required) — the new libtpu must come
-        up against a quiesced ICI domain."""
+        up against a quiesced ICI domain. Sharded per slice group (the
+        barrier is group-local, so a shard owns every input to it)."""
+
+        def check(items: List[NodeUpgradeState]):
+            restart: List[Pod] = []
+            validate: List[Node] = []
+            uncordon: List[Node] = []
+            for ns in items:
+                if self.group_policy.atomic:
+                    group = groups[self.grouper.group_key(ns.node)]
+                    if not group.all_in(AT_OR_PAST_POD_RESTART):
+                        logger.info(
+                            "node %s waiting at group restart barrier "
+                            "(group %s)", ns.node.metadata.name, group.key)
+                        continue
+                is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+                if not is_synced or is_orphaned:
+                    # restart only if not already terminating (:773-781)
+                    if ns.driver_pod.metadata.deletion_timestamp is None:
+                        restart.append(ns.driver_pod)
+                    continue
+                # pod is in sync: unblock safe driver load (:783-788)
+                self.safe_driver_load_manager.unblock_loading(ns.node)
+                if self._is_driver_pod_in_sync(ns):
+                    if not self._validation_enabled:
+                        uncordon.append(ns.node)
+                        continue
+                    validate.append(ns.node)
+                else:
+                    if not self._is_driver_pod_failing(ns.driver_pod):
+                        continue  # still coming up; check next reconcile
+                    logger.info("driver pod failing on node %s with "
+                                "repeated restarts", ns.node.metadata.name)
+                    self.node_upgrade_state_provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.FAILED)
+            return restart, validate, uncordon
+
         pods_to_restart: List[Pod] = []
         to_validation: List[Node] = []
         to_uncordon: List[Node] = []
-        for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
-            if self.group_policy.atomic:
-                group = groups[self.grouper.group_key(ns.node)]
-                if not group.all_in(AT_OR_PAST_POD_RESTART):
-                    logger.info(
-                        "node %s waiting at group restart barrier (group %s)",
-                        ns.node.metadata.name, group.key)
-                    continue
-            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
-            if not is_synced or is_orphaned:
-                # restart only if not already terminating (:773-781)
-                if ns.driver_pod.metadata.deletion_timestamp is None:
-                    pods_to_restart.append(ns.driver_pod)
-                continue
-            # pod is in sync: unblock safe driver load (:783-788)
-            self.safe_driver_load_manager.unblock_loading(ns.node)
-            if self._is_driver_pod_in_sync(ns):
-                if not self._validation_enabled:
-                    to_uncordon.append(ns.node)
-                    continue
-                to_validation.append(ns.node)
-            else:
-                if not self._is_driver_pod_failing(ns.driver_pod):
-                    continue  # still coming up; check next reconcile
-                logger.info("driver pod failing on node %s with repeated restarts",
-                            ns.node.metadata.name)
-                self.node_upgrade_state_provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.FAILED)
+        for restart, validate, uncordon in self._sharder.run(
+                state.bucket(UpgradeState.POD_RESTART_REQUIRED),
+                key_fn=lambda ns: self.grouper.group_key(ns.node),
+                work_fn=check):
+            pods_to_restart.extend(restart)
+            to_validation.extend(validate)
+            to_uncordon.extend(uncordon)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
             to_validation, UpgradeState.VALIDATION_REQUIRED)
         self._update_nodes_to_uncordon_or_done_state(to_uncordon)
@@ -553,59 +821,86 @@ class ClusterUpgradeStateManager:
         — auto-deleting it would retry a persistent crashloop forever."""
         if groups is None:
             groups = build_group_views(state, self.grouper)
-        pods_to_restart: List[Pod] = []
-        for ns in state.bucket(UpgradeState.FAILED):
-            if self._is_driver_pod_in_sync(ns):
-                self._update_node_to_uncordon_or_done_state(ns.node)
-                continue
-            is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
-            if is_synced and not is_orphaned:
-                continue  # right revision, not Ready yet: keep waiting
-            if self._is_driver_pod_failing(ns.driver_pod):
-                continue  # still broken: manual intervention (reference)
-            if ns.driver_pod.metadata.deletion_timestamp is not None:
-                continue  # already terminating
-            if self.group_policy.atomic:
-                group = groups[self.grouper.group_key(ns.node)]
-                if not group.all_in(AT_OR_PAST_POD_RESTART):
-                    continue  # ICI domain not quiesced yet
-            logger.info("restarting recovered-but-outdated driver pod %s "
-                        "on failed node %s", ns.driver_pod.metadata.name,
-                        ns.node.metadata.name)
-            pods_to_restart.append(ns.driver_pod)
+
+        def recover(items: List[NodeUpgradeState]) -> List[Pod]:
+            restart: List[Pod] = []
+            for ns in items:
+                if self._is_driver_pod_in_sync(ns):
+                    self._update_node_to_uncordon_or_done_state(ns.node)
+                    continue
+                is_synced, is_orphaned = self._pod_in_sync_with_ds(ns)
+                if is_synced and not is_orphaned:
+                    continue  # right revision, not Ready yet: keep waiting
+                if self._is_driver_pod_failing(ns.driver_pod):
+                    continue  # still broken: manual intervention (reference)
+                if ns.driver_pod.metadata.deletion_timestamp is not None:
+                    continue  # already terminating
+                if self.group_policy.atomic:
+                    group = groups[self.grouper.group_key(ns.node)]
+                    if not group.all_in(AT_OR_PAST_POD_RESTART):
+                        continue  # ICI domain not quiesced yet
+                logger.info("restarting recovered-but-outdated driver pod "
+                            "%s on failed node %s",
+                            ns.driver_pod.metadata.name,
+                            ns.node.metadata.name)
+                restart.append(ns.driver_pod)
+            return restart
+
+        pods_to_restart = self._sharder.run_flat(
+            state.bucket(UpgradeState.FAILED),
+            key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=recover)
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
-        """ProcessValidationRequiredNodes (:880-911)."""
-        for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
-            # defensively re-unblock safe load: the driver may have restarted
-            # after reaching this state (:886-893)
-            self.safe_driver_load_manager.unblock_loading(ns.node)
-            if not self.validation_manager.validate(ns.node):
-                continue
-            self._update_node_to_uncordon_or_done_state(ns.node)
+        """ProcessValidationRequiredNodes (:880-911), sharded: each node's
+        validation is an independent pod list + per-node writes."""
+
+        def validate(items: List[NodeUpgradeState]) -> None:
+            for ns in items:
+                # defensively re-unblock safe load: the driver may have
+                # restarted after reaching this state (:886-893)
+                self.safe_driver_load_manager.unblock_loading(ns.node)
+                if not self.validation_manager.validate(ns.node):
+                    continue
+                self._update_node_to_uncordon_or_done_state(ns.node)
+
+        self._sharder.run(
+            state.bucket(UpgradeState.VALIDATION_REQUIRED),
+            key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=validate)
 
     def process_uncordon_required_nodes(self, state: ClusterUpgradeState,
                                         groups: Dict[str, GroupView]) -> None:
         """ProcessUncordonRequiredNodes (:915-934) with the group uncordon
-        barrier: an atomic group returns to service as a unit."""
-        uncordoned: List[Node] = []
-        for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
-            if self.group_policy.atomic:
-                group = groups[self.grouper.group_key(ns.node)]
-                if not group.all_in(AT_OR_PAST_UNCORDON):
-                    logger.info(
-                        "node %s waiting at group uncordon barrier (group %s)",
-                        ns.node.metadata.name, group.key)
+        barrier: an atomic group returns to service as a unit. Sharded per
+        slice group; the barrier inputs are group-local."""
+
+        def uncordon(items: List[NodeUpgradeState]) -> List[Node]:
+            done: List[Node] = []
+            for ns in items:
+                if self.group_policy.atomic:
+                    group = groups[self.grouper.group_key(ns.node)]
+                    if not group.all_in(AT_OR_PAST_UNCORDON):
+                        logger.info(
+                            "node %s waiting at group uncordon barrier "
+                            "(group %s)", ns.node.metadata.name, group.key)
+                        continue
+                if self._sibling_needs_node_down(ns.node):
+                    # another managed component still needs this node out of
+                    # service; retry next pass once its pipeline finishes
+                    logger.info("node %s uncordon deferred: sibling "
+                                "component mid-upgrade",
+                                ns.node.metadata.name)
                     continue
-            if self._sibling_needs_node_down(ns.node):
-                # another managed component still needs this node out of
-                # service; retry next pass once its pipeline finishes
-                logger.info("node %s uncordon deferred: sibling component "
-                            "mid-upgrade", ns.node.metadata.name)
-                continue
-            self.cordon_manager.uncordon(ns.node)
-            uncordoned.append(ns.node)
+                self.cordon_manager.uncordon(ns.node)
+                done.append(ns.node)
+            return done
+
+        uncordoned = self._sharder.run_flat(
+            state.bucket(UpgradeState.UNCORDON_REQUIRED),
+            key_fn=lambda ns: self.grouper.group_key(ns.node),
+            work_fn=uncordon)
         self.node_upgrade_state_provider.change_nodes_state_and_annotations(
             uncordoned, UpgradeState.DONE)
 

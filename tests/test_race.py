@@ -1,6 +1,6 @@
 """Concurrency sanitizer: the utils/threads shim, the cooperative
 schedule explorer (tools/race), the Eraser-style lockset checker, and
-the six real-component harnesses.
+the seven real-component harnesses.
 
 The planted-bug regressions are the load-bearing tests: a seeded
 injected race the explorer MUST find within a bounded schedule count,
@@ -271,10 +271,11 @@ def test_real_harness_smoke(name):
         assert not res.failed, f"{name} seed={seed}:\n{res.describe()}"
 
 
-def test_harness_registry_covers_the_six_components():
+def test_harness_registry_covers_the_seven_components():
     assert set(harnesses.HARNESSES) == {
         "drain_parallel", "evict_workers", "leader_renew_demote",
-        "informer_reader", "uploader_mirror", "router_tick_proxy"}
+        "informer_reader", "uploader_mirror", "router_tick_proxy",
+        "sharded_reconcile"}
 
 
 # --------------------------------------------- CLI shutdown hygiene

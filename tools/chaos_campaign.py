@@ -49,6 +49,16 @@ def main(argv=None) -> int:
                         "capacity-market trade (the CI smoke's guarantee "
                         "that the flash-crowd/arbiter path runs, not "
                         "just converges — docs/capacity-market.md)")
+    p.add_argument("--cached-reads", action="store_true",
+                   help="run every operator candidate on the PR 14 "
+                        "informer read path (pumped CachedClient over the "
+                        "chaos client, incremental BuildState + "
+                        "equivalence oracle) — `make chaos` default")
+    p.add_argument("--shard-workers", type=int, default=0, metavar="N",
+                   help="sharded reconcile with N per-slice-group workers "
+                        "in deterministic serial mode (seed replay stays "
+                        "byte-identical; real interleavings are explored "
+                        "under `make race`)")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="per-scenario fault schedules even on PASS")
     args = p.parse_args(argv)
@@ -63,7 +73,9 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     results = run_campaign(args.seeds, base_seed=args.base_seed,
-                           scenario_fn=scenario_fn)
+                           scenario_fn=scenario_fn,
+                           cached_reads=args.cached_reads,
+                           shard_workers=args.shard_workers)
     failed = [r for r in results if r.failed]
     if args.as_json:
         print(json.dumps([{

@@ -41,6 +41,7 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,  # noqa: E402
                                                 DriverUpgradePolicySpec)
+from k8s_operator_libs_tpu.core.cachedclient import CachedClient  # noqa: E402
 from k8s_operator_libs_tpu.core.fakecluster import FakeCluster  # noqa: E402
 from k8s_operator_libs_tpu.health.classifier import ClassifierConfig  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
@@ -180,9 +181,24 @@ def main(argv=None) -> int:
     p.add_argument("--tick-interval", type=float, default=30.0,
                    help="modelled seconds between ticks")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--round", default="r01")
+    p.add_argument("--round", default="r02")
     p.add_argument("--out", default=None, metavar="PATH",
                    help="artifact path (default FLEET_<round>.json)")
+    p.add_argument("--shards", type=int, default=8,
+                   help="sharded-reconcile workers (per-slice-group; "
+                        "0/1 = serial)")
+    p.add_argument("--uncached", action="store_true",
+                   help="legacy r01 read path: every operator read is a "
+                        "counted apiserver call, no informer deltas, no "
+                        "sharding — the baseline the cached path must beat")
+    p.add_argument("--verify-incremental", action="store_true",
+                   help="assert the incremental BuildState equals a full "
+                        "rebuild EVERY tick (the equivalence oracle; adds "
+                        "an O(fleet) in-memory rebuild per tick)")
+    p.add_argument("--budget", default=None, metavar="PATH",
+                   help="JSON call budget (tools/fleetbench_budget.json): "
+                        "asserts calls/node/tick and per-verb ceilings so "
+                        "an O(fleet) join can never silently return")
     args = p.parse_args(argv)
 
     slices = max(1, args.slices)
@@ -202,8 +218,18 @@ def main(argv=None) -> int:
     hub = MetricsHub()
     profiler = TickProfiler()
     tracer = Tracer(sink=profiler, clock=clock)
-    client = counting_client(cluster.client, metrics=hub, tracer=tracer,
-                             clock=clock)
+    # the CountingClient sits at the APISERVER boundary: in the cached
+    # configuration the informer layer is stacked ON TOP of it, so store
+    # reads are genuinely free and only list/watch/write traffic counts —
+    # exactly the accounting a real apiserver would see
+    api = counting_client(
+        cluster.client if args.uncached else cluster.client.direct(),
+        metrics=hub, tracer=tracer, clock=clock)
+    if args.uncached:
+        client = api
+    else:
+        client = CachedClient(api, namespaces=[NS], pumped=True,
+                              clock=clock).start()
     operator = TPUOperator(
         client,
         components=[ManagedComponent(
@@ -222,7 +248,9 @@ def main(argv=None) -> int:
             policy=RemediationPolicy(
                 recovery_seconds=45.0, backoff_base_seconds=60.0,
                 max_unavailable=args.max_unavailable)),
-        slo=SLOOptions.from_dict({}))
+        slo=SLOOptions.from_dict({}),
+        shard_workers=0 if args.uncached else args.shards,
+        verify_incremental=args.verify_incremental)
 
     tick_wall = []
     tick_calls = []
@@ -243,7 +271,7 @@ def main(argv=None) -> int:
         if not measured:
             return
         tick_wall.append(wall)
-        counts = client.counts()
+        counts = api.counts()
         delta = {k: n - prev["calls"].get(k, 0) for k, n in counts.items()}
         prev["calls"] = counts
         tick_calls.append({f"{v} {k}".rstrip(): n
@@ -284,8 +312,43 @@ def main(argv=None) -> int:
         label = node.metadata.labels.get(keys.state_label, "") or "unknown"
         state_counts[label] = state_counts.get(label, 0) + 1
 
+    # ---------------------------------------------------- the call budget
+    # (fleetbench regression gate: calls/node/tick + per-verb ceilings
+    # against a checked-in budget, so an O(fleet) join can never silently
+    # return — every verb observed on a measured tick MUST be budgeted)
+    budget_ok = True
+    budget_detail = {}
+    mean_total_per_node = (sum(per_tick_totals)
+                           / max(1, len(per_tick_totals)) / len(nodes))
+    if args.budget:
+        with open(args.budget, encoding="utf-8") as f:
+            budget = json.load(f)
+        per_verb_cap = budget.get("per_node_per_tick_by_verb_max", {})
+        total_cap = budget.get("calls_per_node_per_tick_max")
+        if total_cap is not None and mean_total_per_node > total_cap:
+            budget_ok = False
+            budget_detail["total"] = (
+                f"{mean_total_per_node:.4f}/node/tick > cap {total_cap}")
+        for name, mean_calls in mean_by_call.items():
+            per_node = mean_calls / len(nodes)
+            cap = per_verb_cap.get(name)
+            if cap is None:
+                budget_ok = False
+                budget_detail[name] = (
+                    f"unbudgeted verb ({per_node:.4f}/node/tick) — add it "
+                    f"to {args.budget} deliberately or kill the call")
+            elif per_node > cap:
+                budget_ok = False
+                budget_detail[name] = (
+                    f"{per_node:.4f}/node/tick > cap {cap}")
+
+    incremental_rebuilds = {
+        name: mgr._inc.rebuilds
+        for name, mgr in operator.managers.items() if mgr._inc is not None}
+
     assertions = {
         "all_ticks_reconciled": prev["ok"],
+        "call_budget": budget_ok,
         "journey_integrity": not journeys["integrity_errors"],
         "journey_size_guard": (journeys["max_annotation_bytes"]
                                <= MAX_JOURNEY_BYTES),
@@ -309,6 +372,10 @@ def main(argv=None) -> int:
             "max_unavailable": args.max_unavailable,
             "tick_interval_s": args.tick_interval, "seed": args.seed,
             "python": sys.version.split()[0],
+            "read_path": ("uncached (r01 baseline)" if args.uncached
+                          else "informer-cached, delta-driven"),
+            "shard_workers": 0 if args.uncached else args.shards,
+            "verify_incremental": bool(args.verify_incremental),
         },
         "headline": {
             "reconcile_tick_wall_s_p50": round(
@@ -363,6 +430,8 @@ def main(argv=None) -> int:
         },
         "fleet_states_after_run": dict(
             sorted(state_counts.items(), key=lambda kv: -kv[1])),
+        "incremental_rebuilds": incremental_rebuilds,
+        "budget_violations": budget_detail,
         "assertions": assertions,
     }
     out = args.out or f"FLEET_{args.round}.json"

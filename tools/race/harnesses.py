@@ -20,6 +20,9 @@ along and convicts unguarded shared state even on passing schedules.
 |                     | wait_idle                                        |
 | router_tick_proxy   | cmd/router.py drain-watch ticker vs /generate    |
 |                     | proxy threads (socket-free post_json)            |
+| sharded_reconcile   | upgrade/sharding.py per-slice-group shard        |
+|                     | workers + shared BudgetAccountant + concurrent   |
+|                     | barrier pumps into one pumped informer store     |
 """
 
 from __future__ import annotations
@@ -364,6 +367,120 @@ def router_tick_proxy(sched) -> None:
     assert r0.draining or not runtimes["sim://r0"]._draining
 
 
+# ------------------------------------------------------- sharded reconcile
+
+def sharded_reconcile(sched) -> None:
+    """PR 14's concurrency seam end to end: parallel per-slice-group
+    shard workers driving the REAL state machine over a pumped
+    CachedClient — concurrent barrier pumps into one informer store,
+    concurrent admission against the single BudgetAccountant, dirty-set
+    drain between ticks. Contract asserted every tick: the maxUnavailable
+    budget is never overrun and a slice only ever leaves service whole
+    (both hosts or neither); at the end the fleet converges to
+    upgrade-done@v2 and the informer store equals apiserver truth."""
+    from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec
+    from k8s_operator_libs_tpu.core.cachedclient import CachedClient
+    from k8s_operator_libs_tpu.tpu.topology import (GKE_ACCELERATOR_LABEL,
+                                                    GKE_NODEPOOL_LABEL,
+                                                    GKE_TOPOLOGY_LABEL,
+                                                    TPUSliceGrouper)
+    from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+        ClusterUpgradeStateManager)
+
+    cluster = FakeCluster(clock=sched.clock, cache_lag=0.05)
+    ds = cluster.add_daemonset("libtpu", namespace="kube-system",
+                               labels={"app": "libtpu"},
+                               revision_hash="v1")
+    names = []
+    for s in range(2):
+        labels = {GKE_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                  GKE_TOPOLOGY_LABEL: "4x2",
+                  GKE_NODEPOOL_LABEL: f"pool-{s}"}
+        for h in range(2):
+            name = f"pool-{s}-h{h}"
+            cluster.add_node(name, labels=labels)
+            cluster.add_pod(f"drv-{name}", name, namespace="kube-system",
+                            owner_ds=ds, revision_hash="v1")
+            names.append(name)
+    client = CachedClient(cluster.client.direct(), namespaces=["kube-system"],
+                          pumped=True, clock=sched.clock).start()
+    mgr = ClusterUpgradeStateManager(
+        client, KEYS, cluster.recorder, sched.clock,
+        grouper=TPUSliceGrouper(), synchronous=True,
+        shard_workers=3, shard_parallel=True)
+    mgr.verify_incremental = True
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0, max_unavailable="50%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    cluster.bump_daemonset_revision("libtpu", "kube-system", "v2")
+    budget = 2  # 50% of 4 nodes
+
+    def out_of_service():
+        out = set()
+        for n in names:
+            node = cluster.client.direct().get_node(n)
+            label = node.metadata.labels.get(KEYS.state_label, "")
+            if (node.spec.unschedulable
+                    or label == UpgradeState.CORDON_REQUIRED):
+                out.add(n)
+        return out
+
+    for _ in range(24):
+        client.pump()
+        deltas = client.drain_deltas()
+        state = mgr.build_state("kube-system", {"app": "libtpu"},
+                                deltas=deltas)
+        mgr.apply_state(state, policy)
+        cluster.reconcile_daemonsets()
+        down = out_of_service()
+        assert len(down) <= budget, \
+            f"budget overrun: {sorted(down)} > {budget}"
+        # slice atomicity: a slice leaves service whole or not at all —
+        # a cordoned host's sibling must be cordoned too once the slice
+        # is past admission (cordon-required members may still be
+        # mid-cordon this tick)
+        for n in down:
+            node = cluster.client.direct().get_node(n)
+            if not node.spec.unschedulable:
+                continue
+            pool = n.rsplit("-", 1)[0]
+            siblings = [m for m in names
+                        if m.startswith(pool + "-") and m != n]
+            for m in siblings:
+                sib = cluster.client.direct().get_node(m)
+                sib_label = sib.metadata.labels.get(KEYS.state_label, "")
+                assert (sib.spec.unschedulable
+                        or sib_label == UpgradeState.CORDON_REQUIRED), \
+                    f"slice split across the budget: {n} down, {m} up " \
+                    f"({sib_label!r})"
+        sched.clock.sleep(15.0)
+        pods = cluster.client.direct().list_pods(
+            namespace="kube-system", label_selector={"app": "libtpu"})
+        # converged = every node done AND every pod at v2 (at tick 0 the
+        # fleet is legitimately "done" — the new ControllerRevision is
+        # not watch-visible yet)
+        if (all(_state_of(cluster, n) == UpgradeState.DONE for n in names)
+                and len(pods) == len(names)
+                and all(p.metadata.labels.get("controller-revision-hash")
+                        == "v2" for p in pods)):
+            break
+    for n in names:
+        assert _state_of(cluster, n) == UpgradeState.DONE, \
+            f"{n} in {_state_of(cluster, n)!r}"
+        assert not cluster.client.direct().get_node(n).spec.unschedulable
+    pods = cluster.client.direct().list_pods(namespace="kube-system",
+                                             label_selector={"app": "libtpu"})
+    assert all(p.metadata.labels.get("controller-revision-hash") == "v2"
+               for p in pods), "fleet not at v2"
+    # the informer store converged to apiserver truth
+    client.pump()
+    cached = {n.metadata.name: n.metadata.resource_version
+              for n in client.list_nodes()}
+    truth = {n.metadata.name: n.metadata.resource_version
+             for n in cluster.client.direct().list_nodes()}
+    assert cached == truth, "informer store diverged from apiserver"
+
+
 HARNESSES = {
     "drain_parallel": drain_parallel,
     "evict_workers": evict_workers,
@@ -371,6 +488,7 @@ HARNESSES = {
     "informer_reader": informer_reader,
     "uploader_mirror": uploader_mirror,
     "router_tick_proxy": router_tick_proxy,
+    "sharded_reconcile": sharded_reconcile,
 }
 
 # files the lockset checker watches per harness (the component itself;
@@ -385,4 +503,6 @@ LOCKSET_FILES = {
     "uploader_mirror": ["k8s_operator_libs_tpu/train/uploader.py"],
     "router_tick_proxy": ["cmd/router.py",
                           "k8s_operator_libs_tpu/serving/pool.py"],
+    "sharded_reconcile": ["k8s_operator_libs_tpu/upgrade/sharding.py",
+                          "k8s_operator_libs_tpu/core/cachedclient.py"],
 }
