@@ -13,9 +13,9 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
   test-obs-slo test-obs-profile test-delta test-chaos test-router \
-  test-migration test-market test-race \
-  health-sim chaos chaos-market-smoke race race-smoke fleetbench \
-  fleetbench-smoke lint \
+  test-migration test-market test-race test-resilience \
+  health-sim chaos chaos-market-smoke crash crash-smoke race race-smoke \
+  fleetbench fleetbench-smoke lint \
   lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
   dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
@@ -84,12 +84,23 @@ health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 
 SEEDS ?= 20
 CHAOS_FLAGS ?=
-chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). Runs with the informer-cached read path and the sharded reconcile ON (deterministic serial shard execution — real interleavings are `make race`'s job). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
+chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). The catalog includes apiserver-blackout (fail-static degraded mode) and operator-crash (fresh-process reboot) faults, and every candidate runs behind the resilient client boundary. Runs with the informer-cached read path and the sharded reconcile ON (deterministic serial shard execution — real interleavings are `make race`'s job). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
 	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS) --cached-reads \
 	  --shard-workers 2 $(CHAOS_FLAGS)
 
-chaos-market-smoke:  ## the PR 13 arbiter-path guarantee on the legacy read path: seed 1's flash crowd must execute a capacity-market trade. (On the PR 14 cached path the fleet recovers fast enough during these seeds' crowds that the arbiter correctly declines to trade — deterministic trade coverage lives in test_market + the pinned test_chaos composite; this smoke keeps the uncached trade e2e exercised end to end.)
-	$(PYTHON) tools/chaos_campaign.py --seeds 3 --require-market-trade
+chaos-market-smoke:  ## the PR 13 arbiter-path guarantee on the legacy read path: a pinned sustained flash crowd (tools/market_trade_scenario.yaml) must execute a capacity-market trade + return end to end. (On the cached path — and, since PR 15's resilient client boundary, even on retried uncached reads under the old magic seeds — the fleet recovers fast enough that the arbiter correctly declines random crowds; deterministic trade coverage lives in test_market + the pinned test_chaos composite, and this smoke keeps the uncached trade e2e exercised.)
+	$(PYTHON) tools/chaos_campaign.py --seeds 3 \
+	  --scenario tools/market_trade_scenario.yaml --require-market-trade
+
+test-resilience:  ## resilient client boundary + fail-static degraded mode + crash explorer units: breaker/rate-limiter/retry matrix on FakeClock, drain 5xx backoff, health informer reads, the pinned mid-upgrade blackout e2e, and crash-point replays (docs/resilience.md)
+	$(PYTHON) -m pytest tests/test_resilience.py -q
+
+CRASH_SEED ?= 0
+crash:  ## crash-restart explorer full sweep (docs/resilience.md): record every registered durable-write site in the pinned scenario, then kill the operator immediately BEFORE and AFTER each site's writes (first + a later occurrence) and require convergence with every chaos invariant green; failures print a replay command + shrunk reproducer
+	$(PYTHON) -m tools.crash --seed $(CRASH_SEED)
+
+crash-smoke:  ## budgeted CI subset: provider state/journey choke point, the quarantine trio, and a router-stamped site, first occurrence, both phases
+	$(PYTHON) -m tools.crash --smoke --seed $(CRASH_SEED)
 
 RACE_SEEDS ?= 40
 race:  ## deterministic schedule exploration of the seven real-component harnesses (drain/evict workers, leader renew-vs-demote, informer-vs-reader, uploader, router ticker-vs-proxy, sharded reconcile + budget accountant + dirty-set drain) with lockset race detection; failures report seed + shrunk replayable trace (docs/static-analysis.md "Schedule exploration")

@@ -102,6 +102,29 @@ def load_slo(path: str):
     return SLOOptions.from_dict(section)
 
 
+def load_resilience(path: str):
+    """Optional top-level ``resilience:`` section → ResilienceOptions
+    (docs/resilience.md). ON BY DEFAULT — a long-running operator
+    without a breaker turns a sustained apiserver outage into a retry
+    storm; ``resilience: {enabled: false}`` opts out, any other shape
+    tunes the knobs:
+
+        resilience:
+          retries: 3                    # idempotent-read retries
+          retryBaseSeconds: 0.5         #   jittered exponential backoff
+          breakerFailureThreshold: 8    # consecutive failures -> open
+          breakerOpenSeconds: 30        # shed window before probing
+    """
+    import yaml
+    from k8s_operator_libs_tpu.core.resilience import ResilienceOptions
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    section = cfg.get("resilience")
+    if section is not None and section.get("enabled") is False:
+        return None
+    return ResilienceOptions.from_dict(section or {})
+
+
 def load_reconcile(path: str) -> dict:
     """Optional top-level ``reconcile:`` section — the PR 14 scale knobs:
 
@@ -205,13 +228,19 @@ def build_market(section, client, slo_engine, hub, recorder, clock):
         config=MarketConfig.from_dict(section.get("config") or {}))
 
 
-def build_client(args, components):
+def build_client(args, components, resilience_opts=None):
     """The reference's two-client split (upgrade_state.go:127-135): a
     long-running operator reads through an informer cache (CachedClient)
     whose ``direct()`` is the raw LiveClient; ``--once`` ticks (Helm hooks,
     smoke tests) skip the informers — one tick can't amortize them. The
     Pod/DaemonSet informers are scoped to the component namespaces, never
-    cluster-wide."""
+    cluster-wide.
+
+    The resilient boundary (retry / rate limit / circuit breaker) wraps
+    the live client UNDER the informer cache, so list/watch traffic
+    passes the breaker gate while store reads stay free; returns the
+    ResilientClient handle (or None) so the operator can drive its
+    fail-static degraded mode off the breaker."""
     from k8s_operator_libs_tpu.core.cachedclient import CachedClient
     from k8s_operator_libs_tpu.core.client import ClientEventRecorder
     from k8s_operator_libs_tpu.core.liveclient import (KubeConfig, KubeHTTP,
@@ -220,6 +249,10 @@ def build_client(args, components):
           KubeConfig.from_kubeconfig(args.kubeconfig, args.context))
     http = KubeHTTP(kc)
     client = LiveClient(http)
+    resilient = None
+    if resilience_opts is not None:
+        resilient = resilience_opts.build(client)
+        client = resilient
     if not args.once and not args.uncached:
         client = CachedClient(
             client,
@@ -233,7 +266,7 @@ def build_client(args, components):
     # events go through the injected client (ClientEventRecorder falls back
     # to direct() for the cached wrapper), so the same wiring records real
     # Events in production and assertable ones under the fake apiserver
-    return client, ClientEventRecorder(client)
+    return client, ClientEventRecorder(client), resilient
 
 
 class MetricsServer:
@@ -246,7 +279,7 @@ class MetricsServer:
     def __init__(self, port: int):
         self.snapshot = {"text": "", "healthy": False,
                          "slo": None, "alerts": None, "profile": None,
-                         "market": None}
+                         "market": None, "resilience": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -263,13 +296,15 @@ class MetricsServer:
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
                 elif self.path in ("/slo", "/alerts", "/profile",
-                                   "/market"):
+                                   "/market", "/resilience"):
                     payload = snapshot[self.path[1:]]
                     if payload is None:
                         body = {
                             "/profile": b'{"error": "profiler disabled"}',
                             "/market":
                                 b'{"error": "market arbiter disabled"}',
+                            "/resilience":
+                                b'{"error": "resilience disabled"}',
                         }.get(self.path,
                               b'{"error": "slo engine disabled"}')
                         ctype, code = "application/json", 404
@@ -398,7 +433,9 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         slo = load_slo(args.config)
         market_section = load_market(args.config)
         reconcile_opts = load_reconcile(args.config)
-        client, recorder = build_client(args, components)
+        resilience_opts = load_resilience(args.config)
+        client, recorder, resilient = build_client(args, components,
+                                                   resilience_opts)
     except Exception as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -411,6 +448,13 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         logger.info("bootstrapped %d CRDs", n)
 
     hub = MetricsHub()
+    if resilient is not None:
+        resilient.bind_metrics(hub)
+        logger.info("resilient client boundary on (retries=%d, breaker "
+                    "opens after %d failures, sheds for %.0fs before "
+                    "probing)", resilience_opts.retries,
+                    resilience_opts.failure_threshold,
+                    resilience_opts.open_seconds)
     trace_sink = JsonlSink(args.trace_log) if args.trace_log else None
     profiler = TickProfiler(inner=trace_sink) if args.profile else None
     tracer = Tracer(sink=profiler or trace_sink) \
@@ -433,7 +477,8 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                            slo=slo,
                            shard_workers=reconcile_opts["shard_workers"],
                            verify_incremental=reconcile_opts[
-                               "verify_incremental"])
+                               "verify_incremental"],
+                           resilience=resilient)
     if reconcile_opts["shard_workers"] > 1:
         logger.info("sharded reconcile on (%d per-slice-group workers)",
                     reconcile_opts["shard_workers"])
@@ -582,10 +627,11 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
             states = operator.reconcile()
             ticks += 1
             last_ok = all(s is not None for s in states.values())
-            if arbiter is not None:
+            if arbiter is not None and not operator.degraded:
                 # the market trades under the leader only (standby
                 # replicas resumed from the durable annotations above,
-                # via the elector gate's `continue`)
+                # via the elector gate's `continue`) — and NEVER while
+                # degraded: no new trades off a stale view (fail-static)
                 try:
                     arbiter.tick()
                 except Exception:
@@ -596,9 +642,12 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if market_hub is not None:
                     text += market_hub.render(prefix="tpu_market")
                 server.snapshot["text"] = text
-                # healthy = the last tick reconciled every component; an
-                # apiserver outage flips this off so k8s probes can restart us
-                server.snapshot["healthy"] = last_ok
+                # healthy = the last tick reconciled every component.
+                # DEGRADED mode stays healthy: the apiserver is down,
+                # not us — a kubelet restart would only churn a process
+                # that is deliberately failing static (the /resilience
+                # envelope and the degraded gauges carry the real state)
+                server.snapshot["healthy"] = last_ok or operator.degraded
                 if operator.slo_engine is not None:
                     server.snapshot["slo"] = slo_payload(operator)
                     server.snapshot["alerts"] = alerts_payload(operator)
@@ -608,6 +657,13 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if arbiter is not None:
                     server.snapshot["market"] = json.dumps(
                         {"kind": "market", "data": arbiter.payload()})
+                if resilient is not None:
+                    server.snapshot["resilience"] = json.dumps(
+                        {"kind": "resilience", "data": dict(
+                            resilient.payload(),
+                            degraded=operator.degraded,
+                            staleness_s=round(
+                                operator.staleness_seconds(), 1))})
             if args.once:
                 break
             remaining = max(0.0, args.interval - (time.monotonic() - t0))

@@ -363,16 +363,67 @@ def render_alerts(data) -> str:
     return "\n".join(lines)
 
 
-def render_dashboard(slo_data, alerts_data, operator_url: str) -> str:
+def degraded_banner(operator_url: str, fetch=fetch_view):
+    """The fail-static banner (docs/resilience.md): one loud line when
+    the operator reports DEGRADED over its /resilience envelope; None
+    when healthy, unreachable, or resilience is off — every status view
+    prepends it best-effort, never fails on it."""
+    try:
+        data = fetch(operator_url, "/resilience").get("data") or {}
+    except Exception:
+        return None
+    if not data.get("degraded"):
+        return None
+    return (f"*** DEGRADED (fail-static): apiserver unreachable — "
+            f"breaker {data.get('breaker', '?')}, reads "
+            f"{data.get('staleness_s', 0):g}s stale, state-advancing "
+            f"writes suspended, health verdicts masked, serving tier "
+            f"unaffected ***")
+
+
+def render_resilience(data) -> str:
+    lines = [
+        "resilient client boundary",
+        f"  breaker:            {data.get('breaker', '?')}"
+        + ("  [DEGRADED: fail-static]" if data.get("degraded") else ""),
+        f"  breaker opened:     {data.get('breaker_opened_total', 0)}x "
+        f"since start",
+        f"  stale reads age:    {data.get('staleness_s', 0):g}s",
+        f"  reads retried:      {data.get('retried_total', 0)}",
+        f"  calls shed:         {data.get('shed_total', 0)}",
+        f"  429 rate-limited:   {data.get('rate_limited_total', 0)}",
+    ]
+    return "\n".join(lines)
+
+
+def run_resilience_view(args, fetch=fetch_view) -> int:
+    try:
+        env = fetch(args.operator_url, "/resilience")
+    except Exception as exc:
+        print(f"error: cannot read {args.operator_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_resilience(env.get("data") or {}))
+    return 0
+
+
+def render_dashboard(slo_data, alerts_data, operator_url: str,
+                     fetch=fetch_view) -> str:
     stamp = datetime.datetime.now(tz=datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M:%S UTC")
-    return "\n".join([
-        f"tpu-operator fleet SLOs  ({operator_url}, {stamp})",
-        "",
-        render_slo(slo_data),
-        "",
-        render_alerts(alerts_data),
-    ])
+    banner = degraded_banner(operator_url, fetch=fetch)
+    return "\n".join(
+        ([banner] if banner else [])
+        + [
+            f"tpu-operator fleet SLOs  ({operator_url}, {stamp})",
+            "",
+            render_slo(slo_data),
+            "",
+            render_alerts(alerts_data),
+        ])
 
 
 def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
@@ -415,7 +466,8 @@ def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
         if args.watch:
             body = render_dashboard(
                 (slo_env or {}).get("data") or {},
-                (alerts_env or {}).get("data") or [], args.operator_url)
+                (alerts_env or {}).get("data") or [], args.operator_url,
+                fetch=fetch)
             if fetch_error is not None:
                 stamp = datetime.datetime.fromtimestamp(
                     stale_since, tz=datetime.timezone.utc).strftime(
@@ -734,6 +786,11 @@ def main(argv=None, client=None, now=None) -> int:
                    help="render the tick flight recorder's last-tick "
                         "decomposition and critical path from a running "
                         "operator's /profile endpoint")
+    p.add_argument("--resilience", action="store_true",
+                   help="render the resilient client boundary's breaker "
+                        "state, retry/shed counters, and degraded-mode "
+                        "posture from a running operator's /resilience "
+                        "endpoint (docs/resilience.md)")
     p.add_argument("--market", action="store_true",
                    help="render the capacity arbiter's lane depths, "
                         "slice ownership and recent decisions from a "
@@ -755,6 +812,10 @@ def main(argv=None, client=None, now=None) -> int:
         # the arbiter lives in the operator process; its ledger is the
         # authoritative state, so this is an HTTP view like --profile
         return run_market_view(args)
+    if args.resilience:
+        # breaker state + degraded-mode posture: the operator's HTTP
+        # view (docs/resilience.md)
+        return run_resilience_view(args)
     if args.profile:
         # the flight recorder lives in the operator process; its ring is
         # the authoritative state, so this is an HTTP view too

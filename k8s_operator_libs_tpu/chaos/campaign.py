@@ -35,6 +35,7 @@ from ..api.v1alpha1 import (DrainSpec, DriverUpgradePolicySpec,
 from ..core.client import ServerError
 from ..core.fakecluster import FakeCluster
 from ..core.leaderelection import LeaderElector
+from ..core.resilience import ResilientClient
 from ..health.classifier import ClassifierConfig
 from ..health.monitor import HealthOptions
 from ..health.remediation import RemediationPolicy
@@ -72,6 +73,23 @@ LEASE_RETRY_S = 10.0
 DRIVER_LABELS = {"app": COMPONENT}
 
 
+class OperatorKilled(BaseException):
+    """Control-flow signal: the operator process identified by
+    ``identity`` died RIGHT HERE (an operator-crash fault, or the
+    crash-restart explorer killing at a durable-write boundary).
+
+    A ``BaseException`` on purpose: the operator spine's per-component /
+    per-slice / per-handler ``except Exception`` isolation must NOT
+    absorb a process death — the kill propagates to the campaign loop,
+    which discards the instance and reboots a fresh one against the
+    surviving cluster state."""
+
+    def __init__(self, identity: str, reason: str = "killed"):
+        super().__init__(f"{identity}: {reason}")
+        self.identity = identity
+        self.reason = reason
+
+
 @dataclasses.dataclass
 class CampaignResult:
     scenario: str
@@ -82,6 +100,9 @@ class CampaignResult:
     violations: List[Violation]
     trace: List[str]
     failovers: int = 0
+    # operator processes killed and rebooted fresh during the run
+    # (operator-crash faults + crash-gate kills, tools/crash)
+    crashes: int = 0
     # serving-tier summary: submitted/completed/rerouted request counts,
     # drain handoffs, and how many replica generations were spawned
     router_stats: Optional[Dict[str, int]] = None
@@ -98,7 +119,7 @@ class CampaignResult:
         status = "PASS" if not self.failed else "FAIL"
         lines = [f"{status} {self.scenario} seed={self.seed} "
                  f"ticks={self.ticks} modelled={self.modelled_s:.0f}s "
-                 f"failovers={self.failovers} "
+                 f"failovers={self.failovers} crashes={self.crashes} "
                  f"violations={len(self.violations)}"]
         if self.failed:
             if not self.converged:
@@ -138,7 +159,8 @@ def build_fleet(cluster: FakeCluster, fleet) -> List[str]:
 
 
 def _make_operator(client, recorder, clock, max_unavailable: str,
-                   tracer=None, shard_workers: int = 0) -> TPUOperator:
+                   tracer=None, shard_workers: int = 0,
+                   resilience=None) -> TPUOperator:
     return TPUOperator(
         client,
         components=[ManagedComponent(
@@ -166,7 +188,12 @@ def _make_operator(client, recorder, clock, max_unavailable: str,
         # every campaign tick double-checks the incremental BuildState
         # against a full rebuild — divergence fails the component's
         # reconcile, which the convergence gate turns into a red run
-        verify_incremental=True)
+        verify_incremental=True,
+        # the resilient client boundary (retry/rate-limit/breaker) and
+        # its fail-static degraded mode run in EVERY campaign — an
+        # apiserver-blackout window must flip the operator degraded,
+        # and ordinary flake windows exercise the read retries
+        resilience=resilience)
 
 
 class SimJob:
@@ -470,7 +497,8 @@ def run_scenario(scenario: Scenario, seed: int,
                  stop_on_violation: bool = True,
                  profile: bool = False,
                  cached_reads: bool = False,
-                 shard_workers: int = 0) -> CampaignResult:
+                 shard_workers: int = 0,
+                 write_gate=None) -> CampaignResult:
     """Run one scenario under one seed to convergence (or violation /
     tick exhaustion). ``hooks`` run each tick after the reconcile and
     before the invariant pass — tests inject rogue out-of-band writes
@@ -488,7 +516,19 @@ def run_scenario(scenario: Scenario, seed: int,
     operator reads come from the informer stores, and BuildState runs
     incrementally from drained deltas with the equivalence oracle ON.
     ``shard_workers`` additionally runs the sharded reconcile in its
-    deterministic serial mode. `make chaos` runs with both on."""
+    deterministic serial mode. `make chaos` runs with both on.
+
+    Every candidate runs behind a :class:`ResilientClient` (seeded
+    backoff, breaker, fail-static degraded mode) stacked between its
+    chaos client and its informer cache — blackout windows flip the
+    leader degraded, ordinary flake windows exercise the read retries.
+
+    ``write_gate`` installs the crash-restart explorer's hook on the
+    injector (tools/crash): it observes every durable write cluster-wide
+    and may raise :class:`OperatorKilled` at a registered write
+    boundary; the campaign then reboots the victim as a FRESH process
+    (new operator, elector, arbiter, informer cache — only durable
+    cluster state survives), exactly like an ``operator-crash`` fault."""
     clock = FakeClock(10_000.0)
     cluster = FakeCluster(clock=clock, cache_lag=0.5)
     fleet_nodes = build_fleet(cluster, scenario.fleet)
@@ -496,16 +536,29 @@ def run_scenario(scenario: Scenario, seed: int,
     injector = ChaosInjector(cluster, clock, seed, scenario.faults,
                              namespace=NS, driver_labels=DRIVER_LABELS,
                              lease_duration_s=LEASE_DURATION_S)
-    candidates = []
+    if write_gate is not None:
+        if hasattr(write_gate, "reset"):
+            write_gate.reset()
+        injector.write_gate = write_gate
+    identities = ("op-a", "op-b")
     profilers: Dict[str, TickProfiler] = {}
-    for identity in ("op-a", "op-b"):
+
+    def make_candidate(identity: str):
         client = injector.client(identity)
         tracer = None
         if profile:
             profilers[identity] = TickProfiler()
             tracer = Tracer(sink=profilers[identity], clock=clock)
             client = counting_client(client, tracer=tracer, clock=clock)
-        elector_client = client
+        # the resilient boundary sits ABOVE counting/chaos (every retry
+        # is individually counted and individually taxed) and BELOW the
+        # informer cache (list/watch traffic passes the breaker gate);
+        # per-identity seed keeps backoff jitter replay-deterministic
+        res = ResilientClient(
+            client, clock=clock,
+            seed=(seed << 4) ^ identities.index(identity))
+        client = res
+        elector_client = client  # lease ops pass through untouched
         if cached_reads:
             from ..core.cachedclient import CachedClient
             # pumped informers per candidate over ITS chaos client: the
@@ -520,8 +573,11 @@ def run_scenario(scenario: Scenario, seed: int,
                                 retry_period_s=LEASE_RETRY_S, clock=clock)
         op = _make_operator(client, cluster.recorder, clock,
                             scenario.max_unavailable, tracer=tracer,
-                            shard_workers=shard_workers)
-        candidates.append((identity, elector, op))
+                            shard_workers=shard_workers, resilience=res)
+        return elector, op
+
+    candidates: Dict[str, tuple] = {
+        identity: make_candidate(identity) for identity in identities}
 
     tmp = None
     if workdir is None:
@@ -540,9 +596,8 @@ def run_scenario(scenario: Scenario, seed: int,
     # one capacity arbiter per candidate, like the operators: only the
     # leader ticks, standbys resume mid-trade from the durable
     # tpu.dev/market.* annotations after a failover
-    arbiters: Dict[str, CapacityArbiter] = {}
-    for identity, _elector, _op in candidates:
-        arbiters[identity] = CapacityArbiter(
+    def make_arbiter(identity: str) -> CapacityArbiter:
+        return CapacityArbiter(
             [ManagedSlice("market-train", [job.node_name])],
             client=injector.client(identity), component=COMPONENT,
             demand=tier.router, goodput_fn=lambda: 1.0,
@@ -552,23 +607,86 @@ def run_scenario(scenario: Scenario, seed: int,
             config=MarketConfig(preempt_rate=1.5, return_rate=0.4,
                                 sustain_ticks=3, cooldown_seconds=60.0,
                                 budget=budget))
+
+    arbiters: Dict[str, CapacityArbiter] = {
+        identity: make_arbiter(identity) for identity in identities}
     violations: List[Violation] = []
     bumped = scenario.upgrade_at is None
     prev_leader: Optional[str] = None
     failovers = 0
+    crashes = 0
     converged = False
     tick = 0
+    # identities whose process is DEAD and awaiting reboot (an
+    # operator-crash fault or a crash-gate kill; a reboot can itself
+    # fail while a blackout window blocks the informer warm-up — the
+    # identity then stays dead and is retried next tick)
+    dead: set = set()
+    # process incarnation per identity: alert-manager state (like the
+    # tsdb it derives from) is per-PROCESS soft state — a rebooted
+    # operator legally restarts its alert machines from inactive, so
+    # the alert-transition invariant must track each incarnation as a
+    # distinct instance (exactly like a restarted Prometheus re-deriving
+    # `for:` durations from scratch)
+    incarnations: Dict[str, int] = {identity: 0
+                                    for identity in identities}
+    # a dying incarnation's FINAL alert status, frozen: its last
+    # transitions (and the Events they emitted) must still be observed
+    # exactly once by the alert/event-dedup invariants
+    final_alert_status: Dict[str, list] = {}
+
+    def kill(identity: str, reason: str) -> None:
+        nonlocal crashes
+        crashes += 1
+        _, dying = candidates[identity]
+        if dying.alert_manager is not None:
+            final_alert_status[
+                f"{identity}#{incarnations[identity]}"] = \
+                dying.alert_manager.status()
+        incarnations[identity] += 1
+        dead.add(identity)
+        injector.trace.append(
+            f"t={clock.now() - 10_000.0:7.1f}s  CRASH {identity} "
+            f"({reason}) — in-memory state gone; rebooting fresh")
+
+    def reboot(identity: str) -> bool:
+        try:
+            candidates[identity] = make_candidate(identity)
+            arbiters[identity] = make_arbiter(identity)
+            # a fresh arbiter must resume from the durable annotations,
+            # never re-decide trades it cannot remember
+            arbiters[identity].standby()
+            dead.discard(identity)
+            injector.trace.append(
+                f"t={clock.now() - 10_000.0:7.1f}s  REBOOT {identity} "
+                f"as a fresh process")
+            return True
+        except Exception as exc:
+            injector.trace.append(
+                f"t={clock.now() - 10_000.0:7.1f}s  REBOOT {identity} "
+                f"failed ({exc}); retrying next tick")
+            return False
+
     try:
         for tick in range(scenario.max_ticks):
             now = clock.now() - 10_000.0
             injector.tick()
+            for target in injector.drain_operator_crashes():
+                victim = target or prev_leader or identities[0]
+                if victim in candidates and victim not in dead:
+                    kill(victim, "operator-crash fault")
+            for identity in sorted(dead):
+                reboot(identity)
             if not bumped and now >= scenario.upgrade_at:
                 cluster.bump_daemonset_revision(COMPONENT, NS, "v2")
                 injector.trace.append(
                     f"t={now:7.1f}s  UPGRADE daemonset revision -> v2")
                 bumped = True
             leaders = []
-            for identity, elector, op in candidates:
+            for identity in identities:
+                if identity in dead:
+                    continue
+                elector, _op = candidates[identity]
                 if elector.tick_safely():
                     leaders.append(identity)
             if len(leaders) == 1 and leaders[0] != prev_leader:
@@ -578,25 +696,53 @@ def run_scenario(scenario: Scenario, seed: int,
                         f"t={now:7.1f}s  FAILOVER {prev_leader} -> "
                         f"{leaders[0]}")
                 prev_leader = leaders[0]
-            for identity, elector, op in candidates:
+            for identity in identities:
+                if identity in dead:
+                    continue
+                elector, op = candidates[identity]
                 if elector.is_leader:
-                    op.reconcile()
+                    try:
+                        op.reconcile()
+                    except OperatorKilled as killed:
+                        kill(identity, killed.reason)
             cluster.reconcile_daemonsets()
             job.tick(cluster)
             # the router tier stops taking traffic once every fault
             # window closed AND the rollout fired — outstanding work then
             # drains, which the convergence gate requires
             tier.tick(active=not (bumped and injector.quiet()))
-            # the capacity market ticks under the CURRENT leader only;
-            # standbys forget in-memory trade state so a promotion
-            # resumes from the durable annotations mid-trade
+            # a write-gate kill requested from OUTSIDE an operator's own
+            # call stack (e.g. at a router-stamped durable write) lands
+            # on the current leader at the next campaign checkpoint
+            gate = injector.write_gate
+            if gate is not None and getattr(gate, "kill_leader_pending",
+                                            False):
+                gate.kill_leader_pending = False
+                victim = prev_leader or identities[0]
+                if victim not in dead:
+                    kill(victim, getattr(gate, "last_reason",
+                                         "crash-gate"))
+            # the capacity market ticks under the CURRENT leader only —
+            # and NEVER while that leader is degraded (fail-static: no
+            # new trades off a stale view); standbys forget in-memory
+            # trade state so a promotion resumes from the durable
+            # annotations mid-trade
             leader_arbiter = (arbiters.get(leaders[0])
-                             if len(leaders) == 1 else None)
-            for arb in arbiters.values():
-                if arb is leader_arbiter:
+                             if len(leaders) == 1
+                             and leaders[0] not in dead else None)
+            leader_degraded = (len(leaders) == 1
+                              and leaders[0] not in dead
+                              and candidates[leaders[0]][1].degraded)
+            for identity, arb in arbiters.items():
+                if identity in dead:
+                    continue
+                if arb is leader_arbiter and not leader_degraded:
                     tier.arbiter = arb
-                    arb.tick()
-                else:
+                    try:
+                        arb.tick()
+                    except OperatorKilled as killed:
+                        kill(identity, killed.reason)
+                elif arb is not leader_arbiter:
                     arb.standby()
             for hook in hooks or []:
                 hook(cluster=cluster, clock=clock, keys=keys, tick=tick)
@@ -607,9 +753,13 @@ def run_scenario(scenario: Scenario, seed: int,
                 fault_notready=injector.notready_nodes(),
                 leaders=leaders,
                 recorder_events=list(cluster.recorder.events),
-                alert_status={identity: (op.alert_manager.status()
-                                         if op.alert_manager else [])
-                              for identity, _, op in candidates},
+                alert_status={**final_alert_status,
+                              **{f"{identity}#{incarnations[identity]}":
+                                 (op.alert_manager.status()
+                                  if op.alert_manager else [])
+                                 for identity, (_, op)
+                                 in candidates.items()
+                                 if identity not in dead}},
                 ledger_path=job.path, workload_node=job.node_name,
                 tick_seconds=scenario.tick_seconds,
                 router=tier.router, market=leader_arbiter)
@@ -620,8 +770,10 @@ def run_scenario(scenario: Scenario, seed: int,
             # convergence may not be declared while the rollout trigger
             # or any fault window is still ahead — a healthy t=0 fleet is
             # not a survived scenario
-            if bumped and injector.quiet() and tier.healthy() \
-                    and tier.market_settled() \
+            if bumped and injector.quiet() and not dead \
+                    and not any(op.degraded
+                                for _, op in candidates.values()) \
+                    and tier.healthy() and tier.market_settled() \
                     and _converged(
                         cluster, keys, nodes,
                         bumped=scenario.upgrade_at is not None, job=job):
@@ -641,7 +793,7 @@ def run_scenario(scenario: Scenario, seed: int,
         scenario=scenario.name, seed=seed, converged=converged,
         ticks=tick + 1, modelled_s=clock.now() - 10_000.0,
         violations=violations, trace=list(injector.trace),
-        failovers=failovers,
+        failovers=failovers, crashes=crashes,
         router_stats={
             "submitted": tier.submitted + tier.crowd_submitted,
             "completed": sum(

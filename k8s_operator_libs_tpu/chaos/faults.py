@@ -44,6 +44,28 @@ The catalog (docs/chaos.md has the full fault semantics):
                        bounded retry/backoff must absorb the flake or
                        fall back to degraded re-prefill — never a lost
                        or corrupted stream)
+``apiserver-blackout`` EVERY client call fails with 5xx for the window —
+                       a sustained full apiserver outage (etcd quorum
+                       loss, rolling control-plane upgrade gone bad).
+                       The operator's resilient client boundary must
+                       open its circuit breaker and enter fail-static
+                       DEGRADED mode: no new cordons/drains/repairs/
+                       trades, no quarantines off stale data, the
+                       serving tier untouched; on heal, informers
+                       resync and the state machine resumes from the
+                       durable labels. Lease traffic and create_event
+                       are exempt, like the flake fault: leader-loss
+                       composes the lease partition separately (the
+                       campaign must not re-implement renew-deadline
+                       handling), and events are advisory-but-counted
+                       by the event-dedup invariant
+``operator-crash``     the current leader operator process (or a
+                       targeted identity) is killed instantly and
+                       reboots as a FRESH process against the surviving
+                       cluster state — all in-memory state lost, only
+                       the durable labels/annotations/leases remain
+                       (the scheduled-fault twin of the crash-restart
+                       explorer's write-boundary kills, tools/crash)
 ``flash-crowd``        a seeded open-loop arrival-rate spike against the
                        ServingTier (requests/tick across all QoS lanes
                        for the window) — overload must degrade by
@@ -78,6 +100,8 @@ FAULT_TYPES = (
     "mid-stream-kill",
     "kv-transfer-flake",
     "flash-crowd",
+    "apiserver-blackout",
+    "operator-crash",
 )
 
 # Spot/preemption reclaim notice wire contract: the cloud (or the chaos

@@ -49,7 +49,14 @@ _WRITE_PREFIXES = ("patch_", "create_", "delete_", "evict_", "update_")
 class ChaosClient:
     """Client wrapper routing every call through the injector's fault
     gate. ``identity`` names the caller for targeted partitions (each
-    leader-election candidate gets its own wrapper)."""
+    leader-election candidate gets its own wrapper).
+
+    When the injector carries a ``write_gate`` (the crash-restart
+    explorer's hook, tools/crash), every WRITE that passed the fault
+    gate is additionally bracketed by ``gate.before_write`` /
+    ``gate.after_write`` with its full payload — the gate classifies the
+    write against the durable-site registry and may kill the issuing
+    operator immediately before or after the write lands."""
 
     def __init__(self, injector: "ChaosInjector", inner,
                  identity: str = ""):
@@ -68,6 +75,13 @@ class ChaosClient:
 
         def call(*args, **kwargs):
             self._injector.before_op(name, self.identity)
+            gate = self._injector.write_gate
+            if (gate is not None and name.startswith(_WRITE_PREFIXES)
+                    and name not in _FLAKE_EXEMPT):
+                gate.before_write(name, self.identity, args, kwargs)
+                out = attr(*args, **kwargs)
+                gate.after_write(name, self.identity, args, kwargs)
+                return out
             return attr(*args, **kwargs)
 
         return call
@@ -98,6 +112,13 @@ class ChaosInjector:
         self._base_cache_lag = cluster.cache_lag
         self._broken_pods: Dict[int, List[str]] = {}   # event idx -> pods
         self._t0 = clock.now()
+        # crash-restart explorer hook (tools/crash): object with
+        # before_write/after_write, installed by run_scenario
+        self.write_gate = None
+        # operator-crash kills due this tick: identity, or None for
+        # "whoever currently leads" — the campaign drains these after
+        # injector.tick() and reboots the victim as a fresh process
+        self._pending_crashes: List[Optional[str]] = []
 
     # ------------------------------------------------------------- wiring
 
@@ -124,6 +145,13 @@ class ChaosInjector:
                     f"injected partition: {identity} cannot reach the "
                     f"apiserver's lease endpoint")
             return
+        # blackout: EVERY call 5xxs (rate 1.0, no RNG draw — replay
+        # stays byte-identical). create_event/direct stay exempt like
+        # the flake fault; lease traffic returned above (leader-loss
+        # composes the lease partition separately — faults.py).
+        if op not in ("create_event", "direct") \
+                and self._active("apiserver-blackout"):
+            raise ServerError(f"injected apiserver blackout on {op}")
         for ev in self._active("apiserver-latency"):
             self.clock.sleep(self.rng.uniform(
                 0.0, float(ev.params.get("max_latency_s", 1.0))))
@@ -191,6 +219,18 @@ class ChaosInjector:
                 if self.rng.random() < float(ev.params.get("rate", 0.5)):
                     return True
         return False
+
+    def blackout_active(self) -> bool:
+        """True while an apiserver-blackout window is open — the
+        campaign's serving tier and assertions key off it."""
+        return bool(self._active("apiserver-blackout"))
+
+    def drain_operator_crashes(self) -> List[Optional[str]]:
+        """Operator-crash kills that came due since the last drain:
+        each entry is a candidate identity, or None for "the current
+        leader". The campaign reboots each victim as a fresh process."""
+        out, self._pending_crashes = self._pending_crashes, []
+        return out
 
     def flash_crowd_rate(self) -> int:
         """Extra requests/tick the ServingTier must submit right now —
@@ -281,7 +321,10 @@ class ChaosInjector:
                     pass
         elif ev.type == "watch-lag":
             self.cluster.cache_lag = float(ev.params.get("lag_s", 5.0))
-        # latency/flake/conflict windows act purely through before_op;
+        elif ev.type == "operator-crash":
+            self._pending_crashes.append(ev.params.get("identity"))
+        # latency/flake/conflict/blackout windows act purely through
+        # before_op;
         # replica-kill / metrics-flake act through the serving tier's
         # killed_replica_nodes() / metrics_flake_nodes() polls (no
         # cluster object models a replica process)

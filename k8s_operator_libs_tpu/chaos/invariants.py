@@ -114,6 +114,15 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
                           "router-exactly-once"),
     "flash-crowd": ("market-conservation", "router-exactly-once",
                     "router-admission"),
+    # fail-static: during the blackout the operator must take NOTHING
+    # new out of service (budget), never corrupt a journey off stale
+    # state, keep the serving tier whole, and keep event delivery exact
+    "apiserver-blackout": ("budget", "journey", "event-dedup",
+                           "router-exactly-once"),
+    # crash-restart: a fresh process resuming from durable labels alone
+    # must keep journeys continuous, never double-lead, and never
+    # re-take budget it cannot remember holding
+    "operator-crash": ("journey", "single-leader", "budget"),
 }
 
 # Legal pipeline edges (upgrade_state.py processing order + the failure
@@ -204,13 +213,31 @@ class Invariant:
 class BudgetInvariant(Invariant):
     name = "budget"
 
+    def __init__(self):
+        # nodes the operator cordoned/admitted WHILE the injector held
+        # them NotReady: the machine's already-unavailable admission
+        # bypass (reference GetUpgradesAvailable semantics — cordoning a
+        # dead node consumes no NEW availability) makes that legal and
+        # free, so the invariant must not charge it, during the fault
+        # window or after it heals mid-pipeline. The exemption ends when
+        # the node returns to service (schedulable again).
+        self._free_admissions: set = set()
+
     def check(self, view: CampaignView) -> List[Violation]:
         taken = []
         for name, node in view.nodes.items():
             state = node.metadata.labels.get(view.keys.state_label, "")
-            if (node.spec.unschedulable
-                    or state == UpgradeState.CORDON_REQUIRED):
-                taken.append(name)
+            held = (node.spec.unschedulable
+                    or state == UpgradeState.CORDON_REQUIRED)
+            if not held:
+                self._free_admissions.discard(name)
+                continue
+            if name in view.fault_notready:
+                self._free_admissions.add(name)
+            if name in self._free_admissions:
+                continue  # fault-injected NotReady consumed this node's
+                # availability first — not the operator's doing
+            taken.append(name)
         if len(taken) > view.budget:
             return [self._v(view,
                             f"operator holds {len(taken)} nodes out of "

@@ -230,6 +230,26 @@ def _parse_kv_transfer_flake(entry, fleet) -> FaultEvent:
                       params={"rate": _rate(entry, default=0.5)}, **w)
 
 
+def _parse_apiserver_blackout(entry, fleet) -> FaultEvent:
+    # a full outage: every client call 5xxs for the window (lease +
+    # create_event exempt — see faults.py); no targets, no rate
+    w = _window(entry, 120.0)
+    if w["duration"] <= 0:
+        raise ScenarioError("apiserver-blackout: duration must be "
+                            "positive")
+    return FaultEvent("apiserver-blackout", **w)
+
+
+def _parse_operator_crash(entry, fleet) -> FaultEvent:
+    # instant: the named identity (default: whoever leads when the
+    # fault lands) is killed and reboots fresh — duration is meaningless
+    w = _window(entry, 0.0)
+    params: Dict[str, Any] = {}
+    if entry.get("identity"):
+        params["identity"] = str(entry["identity"])
+    return FaultEvent("operator-crash", params=params, **w)
+
+
 # fault type -> parser; CHS001 proves this dict's literal keys equal
 # FAULT_TYPES exactly (an unparseable fault type can never register)
 FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
@@ -247,6 +267,8 @@ FAULT_PARSERS: Dict[str, Callable[[Dict[str, Any], FleetSpec], FaultEvent]] = {
     "mid-stream-kill": _parse_mid_stream_kill,
     "kv-transfer-flake": _parse_kv_transfer_flake,
     "flash-crowd": _parse_flash_crowd,
+    "apiserver-blackout": _parse_apiserver_blackout,
+    "operator-crash": _parse_operator_crash,
 }
 
 
@@ -336,8 +358,10 @@ def random_scenario(seed: int) -> Scenario:
         elif ftype == "flash-crowd":
             entry.update(duration=rng.choice([120.0, 180.0]),
                          requestsPerTick=rng.choice([6, 10]))
-        # leader-loss needs no params: the injector partitions whoever
-        # holds the lease when the fault lands
+        elif ftype == "apiserver-blackout":
+            entry.update(duration=rng.choice([90.0, 180.0]))
+        # leader-loss and operator-crash need no params: the injector
+        # partitions/kills whoever holds the lease when the fault lands
         faults.append(entry)
     return parse_scenario({
         "name": f"seed-{seed}",

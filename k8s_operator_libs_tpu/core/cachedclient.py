@@ -175,6 +175,11 @@ class _Informer:
     def wait_synced(self, timeout: float) -> bool:
         return self._synced.wait(timeout)
 
+    def invalidate(self) -> None:
+        """Drop the watch resume point: the next advance re-lists (the
+        consumer-facing resync surface — CachedClient.resync)."""
+        self._set_resume_point(None)
+
     # --------------------------------------------------------------- reads
 
     def get(self, namespace: str, name: str):
@@ -480,6 +485,18 @@ class CachedClient(Client):
         for inf in self._informers:
             if kinds is None or inf.kind in kinds:
                 inf.pump_once()
+
+    def resync(self) -> None:
+        """Invalidate every informer's resume point so its next advance
+        (pump, or the threaded loop's next window) performs a full
+        re-LIST. The degraded-mode recovery path: after an apiserver
+        blackout the watch replay window is gone and the store may have
+        missed arbitrary events — the operator calls this when its
+        circuit breaker closes, and the resulting ``resynced`` delta
+        flag forces the next BuildState to full-rebuild from the fresh
+        lists (docs/resilience.md)."""
+        for inf in self._informers:
+            inf.invalidate()
 
     def drain_deltas(self) -> Dict[str, KindDelta]:
         """The per-kind dirty sets accumulated since the last drain,

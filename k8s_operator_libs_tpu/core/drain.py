@@ -15,7 +15,7 @@ import random
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.clock import Clock, RealClock
-from .client import (Client, ConflictError, NotFoundError,
+from .client import (Client, ConflictError, NotFoundError, ServerError,
                      TooManyRequestsError)
 from .objects import Pod
 
@@ -139,12 +139,14 @@ class Helper:
                                           self.grace_period_seconds)
                 except NotFoundError:
                     pass
-                except (TooManyRequestsError, ConflictError):
+                except (TooManyRequestsError, ConflictError, ServerError):
                     # a PodDisruptionBudget blocks this eviction right now
-                    # (429), or the write raced another client (409) —
+                    # (429), the write raced another client (409), or the
+                    # apiserver answered 5xx (overload, rolling restart) —
                     # kubectl drain retries until its timeout; so do we,
                     # on the jittered backoff schedule instead of its
-                    # fixed 5 s cadence
+                    # fixed 5 s cadence. The 5xx case used to escape the
+                    # schedule and abort the whole drain mid-flight.
                     still_blocked.append(pod)
             if not still_blocked:
                 break
@@ -161,6 +163,18 @@ class Helper:
                     cur = client.get_pod(pod.metadata.namespace, pod.metadata.name)
                 except NotFoundError:
                     break
+                except ServerError:
+                    # transient 5xx while polling for termination: keep
+                    # waiting on the same deadline instead of aborting
+                    # the drain
+                    if not no_timeout and self.clock.now() >= deadline:
+                        raise DrainError(
+                            f"global timeout reached while waiting for "
+                            f"pod {pod.metadata.name} to terminate "
+                            f"(apiserver 5xx)")
+                    self.clock.sleep(1.0 if no_timeout
+                                     else min(1.0, self.timeout_seconds / 10))
+                    continue
                 if cur.metadata.uid != pod.metadata.uid:
                     break  # same name, new pod — original is gone
                 if not no_timeout and self.clock.now() >= deadline:

@@ -74,6 +74,7 @@ class HealthVerdict:
 from ..wire import (DOMAIN, HBM_ECC_ERRORS_ANNOTATION,
                     HEARTBEAT_ANNOTATION, ICI_LINK_ERRORS_ANNOTATION,
                     PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
+                    QUARANTINE_LIFT_ANNOTATION,
                     QUARANTINE_REASON_ANNOTATION, QUARANTINE_TAINT_KEY,
                     REPAIR_ANNOTATION, REPAIR_ATTEMPTS_ANNOTATION,
                     REPAIR_LAST_ANNOTATION, VERDICT_LABEL)
@@ -85,6 +86,7 @@ __all__ = [
     "DOMAIN", "HBM_ECC_ERRORS_ANNOTATION", "HEARTBEAT_ANNOTATION",
     "HealthVerdict", "ICI_LINK_ERRORS_ANNOTATION",
     "PRE_QUARANTINE_CORDON_ANNOTATION", "QUARANTINE_LABEL",
+    "QUARANTINE_LIFT_ANNOTATION",
     "QUARANTINE_REASON_ANNOTATION", "QUARANTINE_TAINT_EFFECT",
     "QUARANTINE_TAINT_KEY", "REPAIR_ANNOTATION",
     "REPAIR_ATTEMPTS_ANNOTATION", "REPAIR_LAST_ANNOTATION",

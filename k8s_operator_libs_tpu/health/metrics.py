@@ -27,6 +27,9 @@ def collect(report: HealthReport) -> Dict[str, float]:
     assert set(f"nodes_verdict_{v}" for v in HealthVerdict.ALL) == \
         set(per_node)  # every verdict gets a gauge, even at zero
     return {
+        # 1 while the report is a degraded-mode re-publication of stale
+        # verdicts (control plane unreachable; remediation suspended)
+        "masked": 1.0 if report.masked else 0.0,
         "monitored_nodes": len(report.node_health),
         "monitored_slices": len(report.slices),
         "quarantined_nodes": report.quarantined_nodes,

@@ -6,10 +6,26 @@ same way the upgrade state machine is: everything injected, so the whole
 loop runs against :mod:`..core.fakecluster` in tests and a live client in
 production.
 
-Reads are DIRECT (uncached), like the slice scheduler's: remediation acts on
-labels the monitor itself wrote last tick, and reading them through a
-lagging informer cache would double-inject repairs and double-count
-quarantines. One node LIST + one scoped pod LIST per tick.
+Reads: the monitor requires READ-YOUR-LAST-TICK-WRITES — remediation acts
+on labels the monitor itself wrote last tick, and a view that lags past
+one tick would double-inject repairs and double-count quarantines. Two
+read paths satisfy that freshness barrier:
+
+- **Pumped informer store** (the PR 14 deterministic read path, and the
+  default whenever the injected client exposes ``pump``): the monitor
+  pumps the Node + Pod informers at tick start — the explicit freshness
+  barrier — and reads from the store. The barrier is sufficient because
+  (a) a pump drains every watch event due by *now* on the injected
+  clock, (b) the tick interval of every consumer (operator ``--interval``,
+  the campaign's 15 s, fleetbench's modelled 30 s) exceeds the
+  server-side cache lag, so last tick's writes are always due, and
+  (c) same-tick upgrade-pipeline writes are provider-barriered (the
+  barrier itself sleeps the clock past the lag). This removes the last
+  O(fleet) apiserver read from the steady-state tick (FLEET_r03).
+- **Direct (uncached)**, when the client has no pump — the live threaded
+  informer cache advances asynchronously and cannot give a per-tick
+  freshness guarantee, so the monitor keeps the original one node LIST +
+  one scoped pod LIST per tick there.
 """
 
 from __future__ import annotations
@@ -87,6 +103,10 @@ class HealthReport:
     repairs_in_flight: int
     actions: Actions
     probe_errors: List[str]
+    # True when this report is a degraded-mode re-publication of the last
+    # fresh verdicts: the control plane is unreachable, probes did not
+    # run, and nothing here may drive remediation (docs/resilience.md)
+    masked: bool = False
 
     def verdict_counts(self) -> Dict[str, int]:
         out = {v: 0 for v in HealthVerdict.ALL}
@@ -133,18 +153,55 @@ class FleetHealthMonitor:
             client, keys, recorder=recorder, clock=self._clock,
             policy=options.policy)
         self.last_report: Optional[HealthReport] = None
+        self._options = options
+        # post-blackout quarantine grace: until this wall time, signals
+        # sourced from node-agent annotations are untrustworthy (the
+        # agents could not write through the dead apiserver either), so
+        # NEW quarantines are deferred; lifts keep working
+        self._quarantine_grace_until = 0.0
+
+    # ------------------------------------------------------------ degraded
+
+    def masked_report(self) -> Optional[HealthReport]:
+        """Degraded-mode view: re-publish the last fresh report with its
+        verdicts MASKED — probes do not run on stale data (a blackout
+        would manufacture heartbeat-staleness verdicts for the whole
+        fleet), verdict labels are not written, and remediation is
+        suspended. Returns None when no fresh report ever existed."""
+        if self.last_report is None:
+            return None
+        report = dataclasses.replace(self.last_report, masked=True,
+                                     actions=Actions(), probe_errors=[])
+        self.last_report = report
+        return report
+
+    def note_recovery(self, grace_seconds: Optional[float] = None) -> None:
+        """Called by the operator when the control plane returns: defer
+        NEW quarantines for one staleness window (default: the heartbeat
+        staleness threshold) — every agent-sourced annotation is exactly
+        as old as the blackout, and quarantining a healthy fleet off
+        that is the failure mode fail-static exists to prevent."""
+        if grace_seconds is None:
+            grace_seconds = self._options.heartbeat_stale_seconds
+        self._quarantine_grace_until = self._clock.wall() + grace_seconds
 
     # ----------------------------------------------------------------- tick
 
     def tick(self) -> HealthReport:
-        direct = self._client.direct()
-        pods = direct.list_pods(namespace=self._namespace,
-                                label_selector=self._driver_labels)
+        # freshness barrier + read path selection (see module docstring)
+        pump = getattr(self._client, "pump", None)
+        if pump is not None:
+            pump(kinds=("Node", "Pod"))
+            view = self._client
+        else:
+            view = self._client.direct()
+        pods = view.list_pods(namespace=self._namespace,
+                              label_selector=self._driver_labels)
         pods_by_node: Dict[str, List[Pod]] = {}
         for pod in pods:
             if pod.spec.node_name:
                 pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
-        nodes = [n for n in direct.list_nodes() if self._in_scope(
+        nodes = [n for n in view.list_nodes() if self._in_scope(
             n, pods_by_node)]
 
         snapshot = Snapshot(nodes=nodes, pods_by_node=pods_by_node,
@@ -178,7 +235,9 @@ class FleetHealthMonitor:
         ctx = RemediationContext(
             nodes={n.metadata.name: n for n in nodes},
             pods_by_node=pods_by_node,
-            total_nodes=total, unavailable=unavailable)
+            total_nodes=total, unavailable=unavailable,
+            suppress_quarantine=(self._clock.wall()
+                                 < self._quarantine_grace_until))
         actions = self.remediator.apply(slices, ctx)
 
         if self._metrics is not None:

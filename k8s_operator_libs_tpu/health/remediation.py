@@ -110,6 +110,11 @@ class RemediationContext:
     total_nodes: int
     unavailable: int                       # cordoned or not-Ready, fleet-wide
     actions: Actions = dataclasses.field(default_factory=Actions)
+    # post-blackout grace (monitor.note_recovery): every agent-sourced
+    # signal is as stale as the outage was long, so NEW quarantines are
+    # deferred for one staleness window; lifts and repairs of slices
+    # already quarantined proceed (docs/resilience.md)
+    suppress_quarantine: bool = False
 
 
 class HealthRemediator:
@@ -208,6 +213,15 @@ class HealthRemediator:
                 != sv.verdict]
         if not todo:
             return
+        if ctx.suppress_quarantine:
+            logger.warning("deferring quarantine of %s: post-blackout "
+                           "grace window (signals as stale as the "
+                           "outage)", sv.key)
+            ctx.actions.deferred_slices.append(sv.key)
+            log_event(self._recorder, members[0], "Warning", EVENT_REASON,
+                      f"Quarantine of {sv.key} deferred: post-blackout "
+                      f"grace window, agent signals not yet fresh")
+            return
         # shared-availability budget: members that are still schedulable and
         # Ready become newly unavailable; defer if that busts the budget
         newly_unavailable = [m for m in todo
@@ -229,7 +243,11 @@ class HealthRemediator:
                 return
         reason = "; ".join(sv.reasons)[:_REASON_MAX]
         for node in todo:
-            annotations = {consts.QUARANTINE_REASON_ANNOTATION: reason}
+            # (re-)arming quarantine cancels any in-flight lift decree:
+            # a stale lift-intent marker would let the safety pass undo
+            # this quarantine
+            annotations = {consts.QUARANTINE_REASON_ANNOTATION: reason,
+                           consts.QUARANTINE_LIFT_ANNOTATION: None}
             if (node.spec.unschedulable
                     and consts.QUARANTINE_LABEL not in node.metadata.labels):
                 # remember a pre-existing cordon (admin maintenance or an
@@ -262,14 +280,30 @@ class HealthRemediator:
         for node in members:
             keep_cordon = (consts.PRE_QUARANTINE_CORDON_ANNOTATION
                            in node.metadata.annotations)
-            # crash-safe ordering: undo the taint and the cordon FIRST,
-            # remove the quarantine label LAST. The label is what makes
-            # process_healthy retry the lift — removing it first meant a
-            # failed uncordon (apiserver conflict, restart mid-lift)
-            # left the node cordoned forever with nothing left to retry
-            # (found by the chaos campaign's conflict-storm scenarios;
-            # pinned in tests/test_health.py). Every step is idempotent,
-            # so a partial lift simply re-runs next tick.
+            # crash-safe ordering, two guarantees:
+            # 1. the durable LIFT-INTENT annotation lands FIRST — from
+            #    then on every remaining step is a pure capacity-
+            #    returning write, so a crash/blackout anywhere inside
+            #    the sequence leaves unambiguous evidence the degraded-
+            #    mode safety pass (tpu/operator.py) may finish from;
+            #    without it, "label present, taint absent" could as
+            #    well be a crash mid-QUARANTINE, which must never be
+            #    "finished" by removing the label;
+            # 2. undo the taint and the cordon BEFORE removing the
+            #    quarantine label. The label is what makes
+            #    process_healthy retry the lift — removing it first
+            #    meant a failed uncordon (apiserver conflict, restart
+            #    mid-lift) left the node cordoned forever with nothing
+            #    left to retry (found by the chaos campaign's
+            #    conflict-storm scenarios; pinned in tests/test_health.py).
+            # Every step is idempotent, so a partial lift re-runs next
+            # tick.
+            if consts.QUARANTINE_LIFT_ANNOTATION \
+                    not in node.metadata.annotations:
+                self._client.patch_node_metadata(
+                    node.metadata.name,
+                    annotations={consts.QUARANTINE_LIFT_ANNOTATION:
+                                 repr(self._clock.wall())})
             if any(t.key == consts.QUARANTINE_TAINT_KEY
                    for t in node.spec.taints):
                 self._client.patch_node_taints(node.metadata.name, [
@@ -284,6 +318,7 @@ class HealthRemediator:
                 annotations={
                     consts.QUARANTINE_REASON_ANNOTATION: None,
                     consts.PRE_QUARANTINE_CORDON_ANNOTATION: None,
+                    consts.QUARANTINE_LIFT_ANNOTATION: None,
                     consts.REPAIR_ANNOTATION: None,
                     # defensive: a lift must never leave a pending upgrade
                     # request behind to re-cordon the slice later

@@ -95,6 +95,10 @@ HELP_TEXTS: Dict[str, str] = {
         "Quarantines deferred this tick to honor the availability budget",
     "tpu_operator_health_probe_errors":
         "Probes that raised this tick (isolated, not fatal)",
+    "tpu_operator_health_masked":
+        "1 while the health report is a degraded-mode re-publication of "
+        "stale verdicts (control plane unreachable; remediation "
+        "suspended)",
     "tpu_operator_health_nodes_verdict_healthy":
         "Nodes with verdict healthy",
     "tpu_operator_health_nodes_verdict_degraded":
@@ -148,6 +152,34 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_operator_obs_scrape_duration_seconds":
         "Seconds the per-tick tsdb scrape of the hub snapshot and gauge "
         "collectors took — observability overhead, itself observable",
+    # resilient client boundary (core/resilience.py — OBS003 closes
+    # these over the RESILIENCE_*_FAMILIES tables both ways) and the
+    # operator's fail-static degraded mode (tpu/operator.py,
+    # docs/resilience.md)
+    "tpu_operator_apiserver_breaker_state":
+        "Apiserver circuit breaker state: 0 closed, 1 half-open "
+        "(probing), 2 open (calls shed)",
+    "tpu_operator_apiserver_retries_total":
+        "Idempotent reads transparently retried after a 5xx/timeout at "
+        "the resilient client boundary, by verb",
+    "tpu_operator_apiserver_shed_total":
+        "Calls shed instantly by the open circuit breaker instead of "
+        "touching the dead apiserver, by verb",
+    "tpu_operator_apiserver_rate_limited_total":
+        "429 Retry-After responses that engaged the adaptive rate "
+        "limiter (apiserver priority & fairness; PDB eviction 429s "
+        "excluded)",
+    "tpu_operator_degraded":
+        "1 while the operator is in fail-static DEGRADED mode "
+        "(breaker open: state-advancing writes suspended, reads stale, "
+        "health masked)",
+    "tpu_operator_degraded_staleness_seconds":
+        "Age of the stale cache the degraded operator is serving reads "
+        "from (seconds since the last fresh tick)",
+    "tpu_operator_degraded_safety_retries_total":
+        "In-flight safety writes (uncordon, quarantine-lift completion) "
+        "retried during degraded mode; their outcomes double as breaker "
+        "probes",
     # SLO engine + alert manager families (obs/slo.py, obs/alerts.py —
     # OBS003 closes these over the emitted-family tables both ways)
     "tpu_operator_slo_error_budget_remaining":

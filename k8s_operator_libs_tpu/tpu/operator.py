@@ -19,10 +19,17 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
+import types
 from typing import Dict, List, Optional
 
 from ..api.v1alpha1 import DriverUpgradePolicySpec
 from ..core.client import Client, EventRecorder
+from ..core.resilience import ResilientClient
+from ..upgrade.consts import UpgradeState
+from ..wire import (PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
+                    QUARANTINE_LIFT_ANNOTATION,
+                    QUARANTINE_REASON_ANNOTATION, QUARANTINE_TAINT_KEY,
+                    REPAIR_ANNOTATION)
 from ..health import metrics as health_metrics
 from ..health.consts import HealthVerdict
 from ..health.monitor import (FleetHealthMonitor, HealthOptions,
@@ -66,7 +73,8 @@ class TPUOperator:
                  stuck_thresholds: Optional[Dict[str, float]] = None,
                  slo: Optional[SLOOptions] = None,
                  shard_workers: int = 0, shard_parallel: bool = True,
-                 verify_incremental: bool = False):
+                 verify_incremental: bool = False,
+                 resilience: Optional[ResilientClient] = None):
         self.client = client
         self.components = components
         self.clock = clock or RealClock()
@@ -87,7 +95,17 @@ class TPUOperator:
         self.managers: Dict[str, ClusterUpgradeStateManager] = {}
         self.stuck_detectors: Dict[str, StuckNodeDetector] = {}
         self.last_stuck: Dict[str, dict] = {}
+        # fail-static degraded mode (docs/resilience.md): when the
+        # resilient client boundary's circuit breaker opens, the
+        # operator suspends state-ADVANCING writes, serves stale reads,
+        # masks health verdicts, and keeps retrying only the in-flight
+        # safety writes until the breaker closes again
+        self.resilience = resilience
+        self.degraded = False
+        self.degraded_since: Optional[float] = None
+        self._last_fresh = self.clock.now()
         all_keys = {comp.name: KeyFactory(comp.name) for comp in components}
+        self._all_keys = all_keys
         for comp in components:
             # sibling_keys: the other components on the same nodes — the
             # state machine coordinates admission attribution and uncordon
@@ -185,7 +203,21 @@ class TPUOperator:
 
         Returns {component name: the ClusterUpgradeState this tick acted on,
         or None if its reconcile raised} — consumers render metrics and
-        health from it without re-listing the cluster (cmd/operator.py)."""
+        health from it without re-listing the cluster (cmd/operator.py).
+
+        Fail-static gate: when a resilient client boundary is wired and
+        its circuit breaker is not closed, the tick runs in DEGRADED mode
+        instead — no state-advancing writes, stale reads, masked health,
+        safety retries only — until a successful probe closes the breaker,
+        at which point the informers resync and one full-rebuild tick
+        resumes the state machine where the durable labels say it was."""
+        if self.resilience is not None:
+            if not self.degraded and not self.resilience.breaker.is_closed:
+                self._enter_degraded()
+            if self.degraded and not self._degraded_tick():
+                return {comp.name: None for comp in self.components}
+            # breaker closed (possibly just now): fall through into a
+            # normal, fully-rebuilt tick
         t0 = self.clock.now()
         states: Dict[str, Optional[object]] = {}
         with self._span("reconcile-tick", components=len(self.components)):
@@ -249,7 +281,10 @@ class TPUOperator:
                                     placement.slice_id)
                         self.placements.append(placement)
             self._pending = still_pending
+        self._last_fresh = self.clock.now()
         if self.metrics is not None:
+            self.metrics.set_gauge("degraded", 0.0)
+            self.metrics.set_gauge("degraded_staleness_seconds", 0.0)
             self.metrics.observe("reconcile_tick_duration_seconds",
                                  max(0.0, self.clock.now() - t0))
         if self.slo_engine is not None:
@@ -260,6 +295,180 @@ class TPUOperator:
                     logger.exception("SLO tick failed; reconcile result "
                                      "unaffected")
         return states
+
+    # ----------------------------------------------------- degraded mode
+    #
+    # Fail-static (docs/resilience.md): when the control plane is sick,
+    # the data plane must not notice. The breaker tells us the apiserver
+    # is down; the operator then (a) stops issuing state-ADVANCING writes
+    # (new cordons, drains, repairs — nothing new leaves service), (b)
+    # serves stale cached reads with an explicit staleness gauge, (c)
+    # masks health verdicts (stale data must never quarantine a healthy
+    # fleet), and (d) keeps retrying only the in-flight SAFETY writes —
+    # uncordon decrees and quarantine-lift completions, both capacity-
+    # RETURNING and already durably decided — whose outcomes double as
+    # the breaker's recovery probes.
+
+    def staleness_seconds(self) -> float:
+        """Age of the stale cache being served (0 while fresh) — the
+        degraded-staleness gauge's value, for status surfaces."""
+        if not self.degraded:
+            return 0.0
+        return max(0.0, self.clock.now() - self._last_fresh)
+
+    def _operator_obj(self):
+        return types.SimpleNamespace(
+            kind="TPUOperator",
+            metadata=types.SimpleNamespace(
+                name="-".join(c.name for c in self.components)
+                or "tpu-operator"))
+
+    def _enter_degraded(self) -> None:
+        self.degraded = True
+        self.degraded_since = self.clock.now()
+        logger.warning(
+            "apiserver circuit breaker %s: entering fail-static DEGRADED "
+            "mode (state-advancing writes suspended; safety writes keep "
+            "retrying)", self.resilience.breaker.state)
+        if self.metrics is not None:
+            self.metrics.set_gauge("degraded", 1.0)
+        log_event(self.recorder, self._operator_obj(), "Warning",
+                  "OperatorDegraded",
+                  "apiserver unreachable (circuit breaker open): "
+                  "fail-static degraded mode — reads stale, "
+                  "state-advancing writes suspended, health verdicts "
+                  "masked, serving tier unaffected")
+
+    def _exit_degraded(self) -> None:
+        outage_s = max(0.0, self.clock.now() - (self.degraded_since
+                                                or self.clock.now()))
+        self.degraded = False
+        self.degraded_since = None
+        # the watch replay window is gone: force every informer to
+        # re-LIST, which flags the next drained deltas `resynced` and
+        # makes the next BuildState a full rebuild from fresh state
+        resync = getattr(self.client, "resync", None)
+        if resync is not None:
+            resync()
+        if self.health_monitor is not None:
+            # agent-sourced signals are exactly as stale as the outage:
+            # defer NEW quarantines for one staleness window
+            self.health_monitor.note_recovery()
+        self._last_fresh = self.clock.now()
+        if self.metrics is not None:
+            self.metrics.set_gauge("degraded", 0.0)
+            self.metrics.set_gauge("degraded_staleness_seconds", 0.0)
+        logger.warning("apiserver circuit breaker closed after %.0fs: "
+                       "resyncing informers and resuming with a full "
+                       "BuildState rebuild", outage_s)
+        log_event(self.recorder, self._operator_obj(), "Normal",
+                  "OperatorRecovered",
+                  f"apiserver reachable again after {outage_s:.0f}s "
+                  f"degraded: informers resynced, state machine resumed "
+                  f"from durable labels")
+
+    def _degraded_tick(self) -> bool:
+        """One fail-static tick. Returns True when the breaker closed
+        (the caller then runs a normal tick immediately — recovery is
+        never delayed a tick)."""
+        with self._span("degraded-tick"):
+            # the pump doubles as the recovery probe: while the breaker
+            # is open its list/watch calls shed instantly; once half-open
+            # they go through, and a success closes the breaker
+            pump = getattr(self.client, "pump", None)
+            if pump is not None:
+                pump()
+            else:
+                self.resilience.probe()
+            if self.resilience.breaker.is_closed:
+                self._exit_degraded()
+                return True
+            if self.metrics is not None:
+                self.metrics.set_gauge("degraded", 1.0)
+                self.metrics.set_gauge(
+                    "degraded_staleness_seconds",
+                    max(0.0, self.clock.now() - self._last_fresh))
+            with self._span("degraded-safety"):
+                self._degraded_safety_pass()
+            if self.resilience.breaker.is_closed:
+                # a safety write landed and closed the breaker mid-pass
+                self._exit_degraded()
+                return True
+            if self.health_monitor is not None:
+                self.last_health = self.health_monitor.masked_report()
+        # observability keeps working through the outage: the tsdb
+        # scrape is in-memory and alert Events ride the exempt
+        # create_event path, so a burn that started before the blackout
+        # still pages during it
+        if self.slo_engine is not None:
+            with self._span("slo-tick"):
+                try:
+                    self._slo_tick({})
+                except Exception:
+                    logger.exception("SLO tick failed during degraded "
+                                     "mode")
+        return False
+
+    def _degraded_safety_pass(self) -> None:
+        """Retry the in-flight safety writes off the stale cache through
+        the breaker-bypassing safety view. Only writes that RETURN
+        capacity and were already durably decreed qualify:
+
+        - a node the machine parked in ``uncordon-required`` (drain and
+          validation complete — the uncordon decree is durable in the
+          state label) is uncordoned;
+        - a quarantine lift that already stamped its durable lift-intent
+          annotation is finished (taint removal, uncordon unless a
+          pre-quarantine cordon is recorded, label clear).
+
+        Every attempt is idempotent; failures are swallowed (retried
+        next tick) and their outcomes feed the breaker as probes."""
+        safety = self.resilience.safety()
+        try:
+            nodes = self.client.list_nodes()
+        except Exception:
+            return  # even the stale cache is unavailable; nothing to do
+        attempts = 0
+        for node in nodes:
+            name = node.metadata.name
+            labels = node.metadata.labels
+            annos = node.metadata.annotations
+            if node.spec.unschedulable and any(
+                    labels.get(keys.state_label)
+                    == UpgradeState.UNCORDON_REQUIRED
+                    for keys in self._all_keys.values()):
+                attempts += 1
+                try:
+                    safety.patch_node_unschedulable(name, False)
+                except Exception:
+                    logger.debug("degraded safety uncordon of %s failed; "
+                                 "retrying next tick", name)
+            if QUARANTINE_LIFT_ANNOTATION in annos \
+                    and QUARANTINE_LABEL in labels:
+                attempts += 1
+                try:
+                    if any(t.key == QUARANTINE_TAINT_KEY
+                           for t in node.spec.taints):
+                        safety.patch_node_taints(name, [
+                            {"$patch": "delete",
+                             "key": QUARANTINE_TAINT_KEY}])
+                    if node.spec.unschedulable and \
+                            PRE_QUARANTINE_CORDON_ANNOTATION not in annos:
+                        safety.patch_node_unschedulable(name, False)
+                    safety.patch_node_metadata(
+                        name,
+                        labels={QUARANTINE_LABEL: None},
+                        annotations={
+                            QUARANTINE_REASON_ANNOTATION: None,
+                            PRE_QUARANTINE_CORDON_ANNOTATION: None,
+                            QUARANTINE_LIFT_ANNOTATION: None,
+                            REPAIR_ANNOTATION: None,
+                        })
+                except Exception:
+                    logger.debug("degraded safety lift of %s failed; "
+                                 "retrying next tick", name)
+        if attempts and self.metrics is not None:
+            self.metrics.inc("degraded_safety_retries_total", by=attempts)
 
     # ------------------------------------------------------- observability
 

@@ -45,6 +45,15 @@ QUARANTINE_REASON_ANNOTATION = "tpu.dev/health.quarantine-reason"
 # quarantine must not remove a cordon it did not create.
 PRE_QUARANTINE_CORDON_ANNOTATION = "tpu.dev/health.pre-quarantine-cordon"
 
+# Durable lift intent: stamped (wall seconds) as the FIRST write of a
+# quarantine lift, cleared by its last. While present, the lift has been
+# decreed and every remaining step (taint removal, uncordon, label
+# clear) is a pure capacity-RETURNING write — the degraded-mode safety
+# pass and the next healthy tick may finish it idempotently, and a crash
+# or blackout anywhere inside the lift sequence is recoverable without
+# guessing (docs/resilience.md, tools/crash).
+QUARANTINE_LIFT_ANNOTATION = "tpu.dev/health.quarantine-lift"
+
 # Repair bookkeeping: in-flight marker, attempt counter feeding the
 # exponential backoff, wall-clock stamp of the last injection.
 REPAIR_ANNOTATION = "tpu.dev/health.repair"

@@ -229,6 +229,31 @@ def test_budget_invariant_catches_overcordon():
     assert "--base-seed 0" in res.report()  # replay line names the seed
 
 
+def test_budget_exempts_cordons_of_fault_notready_nodes():
+    """Regression pin (surfaced when the PR 15 fault catalog recomposed
+    seed 20): the machine may admit + cordon a slice that the injector
+    already holds NotReady — the reference's already-unavailable
+    admission bypass, consuming no NEW availability — and the budget
+    invariant must not charge the operator for it, during the fault
+    window or after it heals mid-pipeline. The rogue-overcordon test
+    above proves a GENUINE overdraw still fires."""
+    sc = parse_scenario({
+        "name": "notready-slice-admitted-free",
+        "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 0},
+        "max_unavailable": "50%", "upgrade_at": 75.0, "max_ticks": 600,
+        "faults": [
+            # the crowd keeps the router busy while slice 0 consumes the
+            # whole budget; slice 1 then goes NotReady and is admitted
+            # free — 8 nodes cordoned, 4 of them the injector's doing
+            {"type": "flash-crowd", "at": 63.2, "duration": 180.0,
+             "requestsPerTick": 6},
+            {"type": "node-notready", "at": 128.8, "duration": 90.0,
+             "slices": [1]},
+        ]})
+    res = run_scenario(sc, seed=20)
+    assert res.converged and not res.violations, res.report()
+
+
 def test_journey_invariant_catches_out_of_band_reset():
     wiped = []
     seen = []

@@ -19,7 +19,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
-from lint import (chaos_check, determinism, jax_hygiene, layering,  # noqa: E402
+from lint import (chaos_check, crash_check, determinism, jax_hygiene, layering,  # noqa: E402
                   lock_discipline, lock_order, obs_check, state_machine,
                   sync_check, thread_discipline, wire_check)
 from lint.registry import REGISTRY  # noqa: E402
@@ -41,13 +41,14 @@ def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "lock-order",
             "determinism", "state-machine", "obs-journey",
-            "obs-attribution", "obs-slo", "chaos-closure", "wire-closure",
+            "obs-attribution", "obs-slo", "chaos-closure",
+            "crash-closure", "wire-closure",
             "sync-hygiene", "thread-discipline", "import-layering"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
             "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
-            "OBS002", "OBS003", "CHS001", "WIRE001", "SYN001", "THR001",
-            "GRD001", "ARC001"} <= set(all_codes)
+            "OBS002", "OBS003", "CHS001", "CRS001", "WIRE001", "SYN001",
+            "THR001", "GRD001", "ARC001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
@@ -595,7 +596,8 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
               obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH,
-              obs_check.PROFILE_PATH, obs_check.MARKET_METRICS_PATH]
+              obs_check.PROFILE_PATH, obs_check.MARKET_METRICS_PATH,
+              obs_check.RESILIENCE_PATH]
 
 
 def _obs3_root(tmp_path, mutate=None, skip=()):
@@ -766,11 +768,11 @@ def test_obs003_profile_family_without_help_fails(tmp_path):
         obs_check.PROFILE_PATH: lambda s: s.replace(
             '    "tpu_operator_apiserver_requests_total",',
             '    "tpu_operator_apiserver_requests_total",\n'
-            '    "tpu_operator_apiserver_retries_total",')})
+            '    "tpu_operator_apiserver_dropped_total",')})
     findings = obs_check.run_slo(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
-    assert "tpu_operator_apiserver_retries_total" in msgs
+    assert "tpu_operator_apiserver_dropped_total" in msgs
     assert "no HELP_TEXTS entry" in msgs
 
 
@@ -1592,3 +1594,131 @@ def test_format_json_and_github_emitters(capsys):
     lint.emit(findings, "github")
     out = capsys.readouterr().out
     assert out.startswith("::error file=a/b.py,line=3,title=DET001::")
+
+
+# ----------------------------------------------- OBS003 (resilience half)
+
+
+def test_obs003_resilience_family_without_help_fails(tmp_path):
+    """A new resilient-boundary family with no HELP_TEXTS entry."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.RESILIENCE_PATH: lambda s: s.replace(
+            '    "tpu_operator_apiserver_shed_total",',
+            '    "tpu_operator_apiserver_shed_total",\n'
+            '    "tpu_operator_apiserver_paused_total",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_operator_apiserver_paused_total" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_resilience_help_covered_by_either_table(tmp_path):
+    """The tpu_operator_apiserver_ prefix is shared by the flight
+    recorder and the resilient boundary: dropping the breaker gauge from
+    the RESILIENCE tables makes its HELP entry stale (matched by
+    NEITHER module's emitted set)."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.RESILIENCE_PATH: lambda s: s.replace(
+            '    "tpu_operator_apiserver_breaker_state",\n', '')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_operator_apiserver_breaker_state" in msgs
+    assert "RESILIENCE_*_FAMILIES" in msgs
+
+
+# ------------------------------------------------ CRS001 (scratch roots)
+
+CRS_FILES = [crash_check.REGISTRY_PATH, crash_check.WIRE_PATH,
+             "k8s_operator_libs_tpu/health/monitor.py",
+             "k8s_operator_libs_tpu/health/remediation.py",
+             "k8s_operator_libs_tpu/market/arbiter.py",
+             "k8s_operator_libs_tpu/serving/router.py",
+             "k8s_operator_libs_tpu/serving/pool.py"]
+
+
+def _crs_root(tmp_path, mutate=None, skip=()):
+    root = tmp_path / "repo_crs"
+    for rel in CRS_FILES:
+        if rel in skip:
+            continue
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_crs001_real_repo_files_pass(tmp_path):
+    assert crash_check.run_project(_crs_root(tmp_path)) == []
+
+
+def test_crs001_real_repo_passes():
+    assert crash_check.run_project(REPO) == []
+
+
+def test_crs001_repo_without_crash_explorer_is_silent(tmp_path):
+    assert crash_check.run_project(tmp_path) == []
+
+
+def test_crs001_unregistered_stamp_fails(tmp_path):
+    """A durable write stamping a wire key no site claims is an unswept
+    crash boundary — the pass names the key and the stamping file."""
+    root = _crs_root(tmp_path, mutate={
+        "k8s_operator_libs_tpu/health/remediation.py": lambda s: s.replace(
+            "annotations = {consts.QUARANTINE_REASON_ANNOTATION: reason,",
+            "annotations = {consts.HEARTBEAT_ANNOTATION: \"0\",\n"
+            "               consts.QUARANTINE_REASON_ANNOTATION: reason,")})
+    findings = crash_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "CRS001" for (_, _, c, _) in findings)
+    assert "HEARTBEAT_ANNOTATION" in msgs
+    assert "unswept crash boundary" in msgs
+    assert any(path.endswith("remediation.py")
+               for (path, _, _, _) in findings)
+
+
+def test_crs001_unknown_registry_claim_fails(tmp_path):
+    root = _crs_root(tmp_path, mutate={
+        crash_check.REGISTRY_PATH: lambda s: s.replace(
+            '"health-verdict": ("VERDICT_LABEL",),',
+            '"health-verdict": ("VERDICT_LABEL_X",),')})
+    findings = crash_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "VERDICT_LABEL_X" in msgs and "not a wire.py constant" in msgs
+    # the real key is now stamped-but-unclaimed, from the other side
+    assert "VERDICT_LABEL " in msgs or "VERDICT_LABEL b" in msgs
+
+
+def test_crs001_dead_coverage_fails(tmp_path):
+    """A claim nothing stamps: registry rot that would quietly turn the
+    sweep vacuous for that key."""
+    root = _crs_root(tmp_path, mutate={
+        crash_check.REGISTRY_PATH: lambda s: s.replace(
+            '"health-verdict": ("VERDICT_LABEL",),',
+            '"health-verdict": ("VERDICT_LABEL",\n'
+            '                   "HEARTBEAT_ANNOTATION"),')})
+    findings = crash_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "HEARTBEAT_ANNOTATION" in msgs
+    assert "dead crash coverage" in msgs
+
+
+def test_crs001_double_claim_fails(tmp_path):
+    root = _crs_root(tmp_path, mutate={
+        crash_check.REGISTRY_PATH: lambda s: s.replace(
+            '"health-repair": ("REPAIR_ANNOTATION",',
+            '"health-repair": ("VERDICT_LABEL", "REPAIR_ANNOTATION",')})
+    findings = crash_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "claimed by BOTH" in msgs and "VERDICT_LABEL" in msgs
+
+
+def test_crs001_missing_process_entry_fails(tmp_path):
+    root = _crs_root(tmp_path, mutate={
+        crash_check.REGISTRY_PATH: lambda s: s.replace(
+            '    "health-verdict": "operator",\n', '')})
+    findings = crash_check.run_project(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "health-verdict" in msgs and "SITE_PROCESS" in msgs

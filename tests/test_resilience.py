@@ -1,0 +1,847 @@
+"""Resilient client boundary + fail-static degraded mode + crash explorer.
+
+Four layers (docs/resilience.md):
+
+- the breaker / rate-limiter / retry unit matrix on FakeClock
+  (core/resilience.py);
+- the drain helper's 5xx backoff pin and the health monitor's pumped
+  informer read path (the last O(fleet) LIST gone) with its freshness
+  barrier and post-blackout quarantine grace;
+- TPUOperator's DEGRADED mode: entry on breaker open, state-advancing
+  writes suspended, safety writes retried through the bypass (their
+  success IS the recovery probe), informer resync + full BuildState
+  rebuild + Degraded/Recovered Events on exit;
+- the pinned mid-rolling-upgrade apiserver-blackout campaign e2e and
+  crash-restart explorer replays (tools/crash), including the shrunk
+  reproducer of the alert-incarnation bug the first full sweep caught.
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (DrainSpec,
+                                                DriverUpgradePolicySpec)
+from k8s_operator_libs_tpu.core.cachedclient import CachedClient
+from k8s_operator_libs_tpu.core.client import (ServerError,
+                                               TooManyRequestsError)
+from k8s_operator_libs_tpu.core.drain import Helper
+from k8s_operator_libs_tpu.core.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                                   AdaptiveRateLimiter,
+                                                   BreakerOpenError,
+                                                   CircuitBreaker,
+                                                   ResilienceOptions,
+                                                   ResilientClient)
+from k8s_operator_libs_tpu.chaos.campaign import run_scenario
+from k8s_operator_libs_tpu.chaos.scenario import parse_scenario
+from k8s_operator_libs_tpu.health import consts as hconsts
+from k8s_operator_libs_tpu.health.classifier import ClassifierConfig
+from k8s_operator_libs_tpu.health.monitor import (FleetHealthMonitor,
+                                                  HealthOptions)
+from k8s_operator_libs_tpu.health.remediation import RemediationPolicy
+from k8s_operator_libs_tpu.obs.profile import counting_client
+from k8s_operator_libs_tpu.tpu.operator import (ManagedComponent,
+                                                TPUOperator)
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.util import KeyFactory
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+NS = "kube-system"
+LABELS = {"app": "libtpu"}
+
+
+# ------------------------------------------------------------ breaker unit
+
+
+def test_breaker_opens_after_consecutive_failures():
+    clock = FakeClock()
+    breaker = CircuitBreaker(clock=clock, failure_threshold=3,
+                             open_seconds=30.0)
+    for _ in range(2):
+        breaker.record_failure()
+    assert breaker.state == CLOSED and breaker.allow()
+    breaker.record_success()  # a success resets the streak
+    for _ in range(2):
+        breaker.record_failure()
+    assert breaker.state == CLOSED
+    breaker.record_failure()
+    assert breaker.state == OPEN and not breaker.allow()
+    assert breaker.opened_total == 1
+
+
+def test_breaker_half_opens_after_timer_and_closes_on_probe():
+    clock = FakeClock()
+    breaker = CircuitBreaker(clock=clock, failure_threshold=1,
+                             open_seconds=30.0)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.advance(29.0)
+    assert not breaker.allow()
+    clock.advance(2.0)
+    assert breaker.state == HALF_OPEN and breaker.allow()
+    breaker.record_success()
+    assert breaker.state == CLOSED
+
+
+def test_breaker_probe_failure_reopens_with_fresh_timer():
+    clock = FakeClock()
+    breaker = CircuitBreaker(clock=clock, failure_threshold=1,
+                             open_seconds=30.0)
+    breaker.record_failure()
+    clock.advance(31.0)
+    assert breaker.state == HALF_OPEN
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.advance(29.0)
+    assert not breaker.allow()  # the open window restarted
+    clock.advance(2.0)
+    assert breaker.state == HALF_OPEN
+
+
+def test_breaker_safety_success_while_open_closes():
+    """A safety-bypass write landing while OPEN is the recovery probe."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(clock=clock, failure_threshold=1,
+                             open_seconds=600.0)
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    breaker.record_success()
+    assert breaker.state == CLOSED
+
+
+# --------------------------------------------------- resilient client unit
+
+
+class _Inner:
+    """Scriptable fake client: ``fail_reads``/``fail_writes`` count down
+    failures before succeeding; every call is logged."""
+
+    def __init__(self):
+        self.calls = []
+        self.fail_reads = 0
+        self.fail_writes = 0
+        self.raise_429 = None  # an exception instance to raise once
+
+    def direct(self):
+        return self
+
+    def _maybe_fail(self, name, budget_attr):
+        self.calls.append(name)
+        if self.raise_429 is not None:
+            exc, self.raise_429 = self.raise_429, None
+            raise exc
+        budget = getattr(self, budget_attr)
+        if budget > 0:
+            setattr(self, budget_attr, budget - 1)
+            raise ServerError(f"scripted 5xx on {name}")
+
+    def list_nodes(self, label_selector=None):
+        self._maybe_fail("list_nodes", "fail_reads")
+        return []
+
+    def get_node(self, name):
+        self._maybe_fail("get_node", "fail_reads")
+        return name
+
+    def patch_node_unschedulable(self, name, unschedulable):
+        self._maybe_fail("patch_node_unschedulable", "fail_writes")
+
+    def get_lease(self, namespace, name):
+        self.calls.append("get_lease")
+        raise ServerError("lease endpoint down")
+
+    def create_event(self, event, namespace="default"):
+        self.calls.append("create_event")
+
+
+def _client(clock, **kw):
+    inner = _Inner()
+    kw.setdefault("retries", 3)
+    kw.setdefault("retry_base_s", 0.5)
+    kw.setdefault("retry_jitter", 0.0)
+    kw.setdefault("failure_threshold", 8)
+    return inner, ResilientClient(inner, clock=clock, **kw)
+
+
+def test_reads_retried_on_backoff_writes_never():
+    clock = FakeClock()
+    inner, rc = _client(clock)
+    inner.fail_reads = 2
+    t0 = clock.now()
+    assert rc.list_nodes() == []
+    assert inner.calls.count("list_nodes") == 3
+    # jitter 0: the schedule is exactly base + 2*base
+    assert clock.now() - t0 == pytest.approx(0.5 + 1.0)
+    assert rc.retried_total == 2
+    inner.calls.clear()
+    inner.fail_writes = 1
+    with pytest.raises(ServerError):
+        rc.patch_node_unschedulable("n", True)
+    assert inner.calls == ["patch_node_unschedulable"]  # one attempt
+
+
+def test_read_retries_exhaust_then_raise():
+    clock = FakeClock()
+    inner, rc = _client(clock, retries=2)
+    inner.fail_reads = 10
+    with pytest.raises(ServerError):
+        rc.list_nodes()
+    assert inner.calls.count("list_nodes") == 3  # 1 + 2 retries
+
+
+def test_breaker_sheds_and_safety_bypasses():
+    clock = FakeClock()
+    inner, rc = _client(clock, retries=0, failure_threshold=2,
+                        open_seconds=600.0)
+    inner.fail_reads = 99
+    for _ in range(2):
+        with pytest.raises(ServerError):
+            rc.list_nodes()
+    assert rc.breaker.state == OPEN
+    attempts = len(inner.calls)
+    with pytest.raises(BreakerOpenError):
+        rc.list_nodes()
+    assert len(inner.calls) == attempts  # shed: never reached the server
+    assert rc.shed_total == 1
+    # the safety view still attempts — and its success closes the breaker
+    inner.fail_reads = 0
+    inner.fail_writes = 0
+    rc.safety().patch_node_unschedulable("n", False)
+    assert rc.breaker.state == CLOSED
+
+
+def test_shed_is_a_server_error_subclass():
+    assert issubclass(BreakerOpenError, ServerError)
+
+
+def test_429_never_feeds_breaker_and_limiter_honors_retry_after():
+    clock = FakeClock()
+    inner, rc = _client(clock, failure_threshold=1)
+    exc = TooManyRequestsError("APF throttled")
+    exc.retry_after = 7.0
+    inner.raise_429 = exc
+    with pytest.raises(TooManyRequestsError):
+        rc.list_nodes()
+    assert rc.breaker.state == CLOSED  # the server answered: alive
+    t0 = clock.now()
+    rc.list_nodes()  # paced: waits out the server-stated window first
+    assert clock.now() - t0 >= 7.0
+    assert rc.limiter.limited_total == 1
+
+
+def test_pdb_429_without_retry_after_never_engages_limiter():
+    clock = FakeClock()
+    inner, rc = _client(clock)
+    inner.raise_429 = TooManyRequestsError("PDB blocks this eviction")
+    with pytest.raises(TooManyRequestsError):
+        rc.list_nodes()
+    t0 = clock.now()
+    rc.list_nodes()
+    assert clock.now() == t0  # no pacing
+    assert rc.limiter.limited_total == 0
+
+
+def test_lease_and_event_ops_exempt_from_gate():
+    clock = FakeClock()
+    inner, rc = _client(clock, failure_threshold=1, open_seconds=600.0)
+    inner.fail_reads = 1
+    with pytest.raises(ServerError):
+        rc.list_nodes()
+    assert rc.breaker.state == OPEN
+    # lease errors surface raw (the elector owns renew-deadline
+    # semantics) and events pass through — neither is shed or retried
+    with pytest.raises(ServerError):
+        rc.get_lease("ns", "lease")
+    rc.create_event(object())
+    assert inner.calls[-2:] == ["get_lease", "create_event"]
+    assert rc.breaker.state == OPEN  # neither fed the breaker
+
+
+def test_options_from_dict_roundtrip():
+    opts = ResilienceOptions.from_dict({
+        "retries": 5, "retryBaseSeconds": 1.0,
+        "breakerFailureThreshold": 4, "breakerOpenSeconds": 60.0})
+    clock = FakeClock()
+    rc = opts.build(_Inner(), clock=clock)
+    assert rc.retries == 5 and rc.retry_base_s == 1.0
+    assert rc.breaker.failure_threshold == 4
+    assert rc.breaker.open_seconds == 60.0
+    with pytest.raises(ValueError):
+        ResilienceOptions.from_dict({"retries": -1})
+
+
+def test_limiter_penalty_decays_on_success():
+    clock = FakeClock()
+    limiter = AdaptiveRateLimiter(clock=clock, base_penalty_s=2.0,
+                                  max_penalty_s=30.0)
+    limiter.on_429(1.0)
+    limiter.on_429(1.0)
+    assert limiter._penalty_s == 4.0
+    limiter.on_success()
+    assert limiter._penalty_s == 2.0
+    limiter.on_success()
+    assert limiter._penalty_s == 0.0
+
+
+# -------------------------------------------------------- drain 5xx pin
+
+
+class _FlakyEvict:
+    """Direct-client wrapper whose evict_pod 5xxs N times first."""
+
+    def __init__(self, inner, failures):
+        self._inner = inner
+        self.failures = failures
+        self.evict_attempts = 0
+
+    def direct(self):
+        return self
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name != "evict_pod" or not callable(attr):
+            return attr
+
+        def evict(*a, **kw):
+            self.evict_attempts += 1
+            if self.failures > 0:
+                self.failures -= 1
+                raise ServerError("injected 5xx on evict")
+            return attr(*a, **kw)
+
+        return evict
+
+
+def test_drain_retries_5xx_evictions_on_backoff(cluster, clock):
+    """A 5xx mid-drain used to escape the retry schedule and abort the
+    whole drain; now it rides the same jittered backoff as 429/409."""
+    cluster.add_node("h0")
+    cluster.add_pod("w0", "h0", namespace="default")
+    flaky = _FlakyEvict(cluster.client.direct(), failures=2)
+    helper = Helper(client=flaky, force=True, clock=clock,
+                    timeout_seconds=300.0, retry_jitter=0.0)
+    t0 = clock.now()
+    helper.run_node_drain("h0")
+    assert flaky.evict_attempts == 3
+    # two backoff sleeps (5, 10) happened before the eviction landed
+    assert clock.now() - t0 >= 15.0
+    assert cluster.client.direct().list_pods(
+        field_node_name="h0") == []
+
+
+def test_drain_timeout_still_raises_under_persistent_5xx(cluster, clock):
+    from k8s_operator_libs_tpu.core.drain import DrainError
+    cluster.add_node("h0")
+    cluster.add_pod("w0", "h0", namespace="default")
+    flaky = _FlakyEvict(cluster.client.direct(), failures=10_000)
+    helper = Helper(client=flaky, force=True, clock=clock,
+                    timeout_seconds=60.0, retry_jitter=0.0)
+    with pytest.raises(DrainError):
+        helper.run_node_drain("h0")
+
+
+# ------------------------------------------- health monitor informer path
+
+
+def _pumped_monitor(cluster, clock, options=None):
+    """Monitor over counting -> pumped informer store (the FLEET_r03
+    read path); returns (monitor, counting client)."""
+    api = counting_client(cluster.client.direct(), clock=clock)
+    cached = CachedClient(api, namespaces=[NS], pumped=True,
+                          clock=clock).start()
+    monitor = FleetHealthMonitor(
+        cached, KeyFactory("libtpu"), namespace=NS, driver_labels=LABELS,
+        clock=clock,
+        options=options or HealthOptions(
+            classifier=ClassifierConfig(damping_seconds=30.0,
+                                        persist_seconds=300.0),
+            policy=RemediationPolicy(recovery_seconds=45.0)))
+    return monitor, api
+
+
+def _health_fleet(cluster, n=3, crashloop=()):
+    for i in range(n):
+        cluster.add_node(f"h{i}")
+        cluster.add_pod(f"drv-h{i}", f"h{i}", namespace=NS, labels=LABELS,
+                        ready=i not in crashloop,
+                        restart_count=12 if i in crashloop else 0)
+
+
+def test_monitor_pumped_path_issues_zero_lists(cluster, clock):
+    """Satellite: the monitor's two direct LISTs are gone — reads come
+    from the pumped informer store (apiserver traffic is watch polls)."""
+    _health_fleet(cluster)
+    monitor, api = _pumped_monitor(cluster, clock)
+    before = api.counts()
+    clock.advance(15.0)
+    report = monitor.tick()
+    delta = {k: n - before.get(k, 0)
+             for k, n in api.counts().items() if n != before.get(k, 0)}
+    assert len(report.node_health) == 3
+    assert not any(verb == "list" for verb, _ in delta), delta
+    assert any(verb == "watch" for verb, _ in delta)  # the pump barrier
+
+
+def test_monitor_read_your_writes_no_verdict_repatch(cluster, clock):
+    """The freshness barrier: the verdict label written last tick is
+    visible this tick, so an unchanged verdict re-patches nothing."""
+    _health_fleet(cluster, crashloop=(0,))
+    monitor, api = _pumped_monitor(cluster, clock)
+    clock.advance(15.0)
+    monitor.tick()
+    assert cluster.client.direct().get_node("h0").metadata.labels.get(
+        hconsts.VERDICT_LABEL) == hconsts.HealthVerdict.DEGRADED
+    patches = api.counts().get(("patch", "Node"), 0)
+    clock.advance(15.0)
+    monitor.tick()  # same verdict: the label is current in the store
+    assert api.counts().get(("patch", "Node"), 0) == patches
+
+
+def test_monitor_uncached_client_keeps_direct_reads(cluster, clock):
+    """No pump on the client -> the original direct-LIST path (a live
+    threaded cache cannot give the per-tick freshness guarantee)."""
+    _health_fleet(cluster)
+    api = counting_client(cluster.client, clock=clock)
+    monitor = FleetHealthMonitor(
+        api, KeyFactory("libtpu"), namespace=NS, driver_labels=LABELS,
+        clock=clock, options=HealthOptions())
+    monitor.tick()
+    assert api.counts().get(("list", "Node"), 0) == 1
+    assert api.counts().get(("list", "Pod"), 0) == 1
+
+
+def test_quarantine_grace_defers_then_acts(cluster, clock):
+    """After note_recovery, NEW quarantines defer for one staleness
+    window (agent signals are as stale as the outage); the verdict still
+    lands once the grace expires."""
+    _health_fleet(cluster, n=2, crashloop=(0,))
+    monitor, _ = _pumped_monitor(cluster, clock, options=HealthOptions(
+        classifier=ClassifierConfig(damping_seconds=10.0,
+                                    persist_seconds=600.0),
+        policy=RemediationPolicy(recovery_seconds=45.0)))
+    clock.advance(15.0)
+    monitor.tick()                      # degraded (damping)
+    monitor.note_recovery(grace_seconds=120.0)
+    clock.advance(15.0)
+    report = monitor.tick()             # escalated, but grace holds
+    assert report.actions.deferred_slices
+    assert not report.actions.quarantined_slices
+    assert hconsts.QUARANTINE_LABEL not in cluster.client.direct(
+        ).get_node("h0").metadata.labels
+    clock.advance(121.0)
+    report = monitor.tick()
+    assert report.actions.quarantined_slices
+    assert hconsts.QUARANTINE_LABEL in cluster.client.direct(
+        ).get_node("h0").metadata.labels
+
+
+def test_masked_report_republishes_flagged(cluster, clock):
+    _health_fleet(cluster, crashloop=(1,))
+    monitor, _ = _pumped_monitor(cluster, clock)
+    clock.advance(15.0)
+    fresh = monitor.tick()
+    assert not fresh.masked
+    masked = monitor.masked_report()
+    assert masked.masked
+    assert masked.node_health.keys() == fresh.node_health.keys()
+    assert not masked.actions.quarantined_slices
+    # never ticked -> nothing to republish
+    other = FleetHealthMonitor(
+        cluster.client, KeyFactory("x"), namespace=NS,
+        driver_labels=LABELS, clock=clock)
+    assert other.masked_report() is None
+
+
+# ------------------------------------------------- degraded operator unit
+
+
+class _OutageGate:
+    """Toggleable full-outage wrapper (the unit-test blackout): while
+    ``state['down']``, every call but create_event raises 5xx and is
+    logged to ``state['blocked']``."""
+
+    def __init__(self, inner, state):
+        self._inner = inner
+        self._state = state
+
+    def direct(self):
+        return _OutageGate(self._inner.direct(), self._state)
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            if self._state["down"] and name != "create_event":
+                self._state["blocked"].append(name)
+                raise ServerError(f"unit outage on {name}")
+            return attr(*args, **kwargs)
+
+        return call
+
+
+def _degraded_rig(cluster, clock, open_seconds=600.0):
+    """FakeCluster -> outage gate -> resilient -> pumped cache ->
+    operator(resilience=...)."""
+    state = {"down": False, "blocked": []}
+    gated = _OutageGate(cluster.client, state)
+    res = ResilientClient(gated, clock=clock, retries=0,
+                          failure_threshold=3, open_seconds=open_seconds)
+    cached = CachedClient(res, namespaces=[NS], pumped=True,
+                          clock=clock).start()
+    operator = TPUOperator(
+        cached,
+        components=[ManagedComponent(
+            name="libtpu", namespace=NS, driver_labels=dict(LABELS),
+            policy=DriverUpgradePolicySpec(
+                auto_upgrade=True, max_unavailable="50%",
+                drain=DrainSpec(enable=True, force=True,
+                                timeout_second=60)))],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        resilience=res)
+    return operator, res, state
+
+
+def _upgrade_fleet(cluster):
+    ds = cluster.add_daemonset("libtpu", namespace=NS,
+                               labels=dict(LABELS), revision_hash="v1")
+    for i in range(4):
+        cluster.add_node(f"h{i}")
+        cluster.add_pod(f"drv-h{i}", f"h{i}", namespace=NS, owner_ds=ds,
+                        revision_hash="v1")
+    return ds
+
+
+def _events(cluster, reason):
+    return [e for e in cluster.recorder.events if e.reason == reason]
+
+
+def test_operator_enters_degraded_and_suspends_writes(cluster, clock):
+    _upgrade_fleet(cluster)
+    operator, res, state = _degraded_rig(cluster, clock)
+    clock.advance(15.0)
+    states = operator.reconcile()
+    assert states["libtpu"] is not None and not operator.degraded
+    state["down"] = True
+    clock.advance(15.0)
+    operator.reconcile()  # failures open the breaker mid-tick
+    assert res.breaker.state == OPEN
+    clock.advance(15.0)
+    states = operator.reconcile()
+    assert operator.degraded
+    assert states == {"libtpu": None}
+    assert len(_events(cluster, "OperatorDegraded")) == 1
+    # degraded ticks attempt ONLY cache pumps and safety writes — never
+    # a state-advancing write (no cordon, drain, evict, decree patch)
+    state["blocked"].clear()
+    clock.advance(15.0)
+    operator.reconcile()
+    assert operator.degraded
+    assert all(op.startswith(("watch_", "list_"))
+               for op in state["blocked"]), state["blocked"]
+    assert len(_events(cluster, "OperatorDegraded")) == 1  # no re-emit
+
+
+def test_degraded_safety_writes_land_and_double_as_probes(cluster, clock):
+    """A node decreed back-to-service (uncordon-required) and a mid-lift
+    quarantine (durable lift intent) are finished by the safety pass the
+    moment the apiserver answers — and that success closes the breaker
+    and exits degraded mode in the same tick."""
+    _upgrade_fleet(cluster)
+    keys = KeyFactory("libtpu")
+    direct = cluster.client.direct()
+    # h0: the machine already decreed return-to-service
+    direct.patch_node_unschedulable("h0", True)
+    direct.patch_node_metadata("h0", labels={
+        keys.state_label: UpgradeState.UNCORDON_REQUIRED})
+    # h1: mid-lift — intent stamped, taint already gone, still cordoned
+    # and labelled (the crash the durable lift intent exists for)
+    direct.patch_node_unschedulable("h1", True)
+    direct.patch_node_metadata(
+        "h1", labels={hconsts.QUARANTINE_LABEL: "unhealthy-transient"},
+        annotations={hconsts.QUARANTINE_LIFT_ANNOTATION: "123.0",
+                     hconsts.QUARANTINE_REASON_ANNOTATION: "x"})
+    cluster.flush_cache()
+    operator, res, state = _degraded_rig(cluster, clock)
+    clock.advance(15.0)
+    # force the breaker open without ticking the machine (it would
+    # legitimately process the uncordon itself on a healthy tick)
+    state["down"] = True
+    for _ in range(3):
+        with pytest.raises(ServerError):
+            res.list_nodes()
+    assert res.breaker.state == OPEN
+    clock.advance(15.0)
+    operator.reconcile()
+    assert operator.degraded
+    # outage continues: safety writes attempted but fail
+    assert any(op.startswith("patch_") for op in state["blocked"])
+    h0 = direct.get_node("h0")
+    assert h0.spec.unschedulable  # nothing landed
+    # the apiserver returns; breaker is still OPEN (open_seconds=600 —
+    # no probe window yet), so ONLY the safety bypass can reach it
+    state["down"] = False
+    clock.advance(15.0)
+    states = operator.reconcile()
+    assert not operator.degraded  # safety success closed the breaker
+    assert states["libtpu"] is not None  # the same tick ran fully
+    assert not direct.get_node("h0").spec.unschedulable
+    h1 = direct.get_node("h1")
+    assert not h1.spec.unschedulable
+    assert hconsts.QUARANTINE_LABEL not in h1.metadata.labels
+    assert hconsts.QUARANTINE_LIFT_ANNOTATION not in \
+        h1.metadata.annotations
+    assert len(_events(cluster, "OperatorRecovered")) == 1
+
+
+def test_recovery_resyncs_informers_and_full_rebuilds(cluster, clock):
+    _upgrade_fleet(cluster)
+    operator, res, state = _degraded_rig(cluster, clock,
+                                         open_seconds=30.0)
+    clock.advance(15.0)
+    operator.reconcile()
+    mgr = operator.managers["libtpu"]
+    rebuilds = mgr._inc.rebuilds
+    clock.advance(15.0)
+    operator.reconcile()
+    assert mgr._inc.rebuilds == rebuilds  # incremental steady state
+    state["down"] = True
+    clock.advance(15.0)
+    operator.reconcile()
+    assert res.breaker.state == OPEN
+    clock.advance(15.0)
+    operator.reconcile()
+    assert operator.degraded
+    assert operator.staleness_seconds() > 0
+    state["down"] = False
+    clock.advance(31.0)  # past open_seconds: the pump probe half-opens
+    states = operator.reconcile()
+    assert not operator.degraded
+    assert states["libtpu"] is not None
+    # the resync forced a full BuildState rebuild from fresh lists
+    assert mgr._inc.rebuilds == rebuilds + 1
+    assert operator.staleness_seconds() == 0.0
+    assert len(_events(cluster, "OperatorDegraded")) == 1
+    assert len(_events(cluster, "OperatorRecovered")) == 1
+
+
+# ------------------------------------------------ blackout campaign e2e
+
+
+BLACKOUT_SPEC = {
+    "name": "blackout-mid-upgrade",
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 1},
+    "max_unavailable": "50%",
+    "upgrade_at": 30.0,
+    "max_ticks": 600,
+    "faults": [
+        # the blackout lands mid-rolling-upgrade; the crashloop burns
+        # INSIDE it (and heals before it ends), so any quarantine could
+        # only have come from stale data — there must be none, ever
+        {"type": "apiserver-blackout", "at": 120.0, "duration": 180.0},
+        {"type": "driver-crashloop", "at": 130.0, "duration": 60.0,
+         "slices": [1]},
+    ],
+}
+
+
+def test_blackout_mid_upgrade_e2e():
+    """The acceptance scenario (docs/resilience.md): breaker opens ->
+    no new cordons, zero quarantines off stale data, the serving tier
+    completes 100% of requests exactly-once -> recovery resync -> the
+    rolling upgrade completes."""
+    captured = {"cluster": None, "cordons": [], "quarantines": 0}
+
+    def capture(cluster=None, clock=None, keys=None, tick=None):
+        captured["cluster"] = cluster
+        t = clock.now() - 10_000.0
+        nodes = cluster.client.direct().list_nodes()
+        cordoned = {n.metadata.name for n in nodes
+                    if n.spec.unschedulable}
+        captured["cordons"].append((t, cordoned))
+        captured["quarantines"] += sum(
+            1 for n in nodes
+            if hconsts.QUARANTINE_LABEL in n.metadata.labels)
+
+    result = run_scenario(parse_scenario(BLACKOUT_SPEC), seed=7,
+                          cached_reads=True, shard_workers=2,
+                          hooks=[capture])
+    assert result.converged and not result.violations, result.report()
+    # fail-static: from the first degraded tick to the heal, the
+    # cordoned set only ever SHRINKS (safety uncordons allowed; new
+    # cordons are state-advancing and suspended — and every write 5xxs
+    # anyway, which is exactly why the operator must not try)
+    window = [(t, c) for t, c in captured["cordons"]
+              if 135.0 <= t < 300.0]
+    for (_, earlier), (_, later) in zip(window, window[1:]):
+        assert later <= earlier, (earlier, later)
+    # zero nodes ever quarantined off stale data
+    assert captured["quarantines"] == 0
+    cluster = captured["cluster"]
+    reasons = [e.reason for e in cluster.recorder.events]
+    assert reasons.count("OperatorDegraded") >= 1
+    assert reasons.count("OperatorRecovered") >= 1
+    assert not any(e.reason == "FleetHealth"
+                   and "Quarantined" in e.message
+                   for e in cluster.recorder.events)
+    # the serving tier never noticed: 100% of accepted requests
+    # completed exactly once, none shed, none lost
+    stats = result.router_stats
+    assert stats["completed"] == stats["submitted"] > 0
+    assert stats["shed"] == 0
+
+
+def test_blackout_replay_is_byte_identical():
+    r1 = run_scenario(parse_scenario(BLACKOUT_SPEC), seed=7,
+                      cached_reads=True, shard_workers=2)
+    r2 = run_scenario(parse_scenario(BLACKOUT_SPEC), seed=7,
+                      cached_reads=True, shard_workers=2)
+    assert r1.trace == r2.trace
+    assert r1.router_stats == r2.router_stats
+    assert r1.ticks == r2.ticks
+
+
+def test_operator_crash_fault_reboots_and_converges():
+    spec = {
+        "name": "crash-mid-upgrade",
+        "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 1},
+        "max_unavailable": "50%",
+        "upgrade_at": 30.0,
+        "max_ticks": 600,
+        "faults": [{"type": "operator-crash", "at": 90.0}],
+    }
+    result = run_scenario(parse_scenario(spec), seed=3,
+                          cached_reads=True, shard_workers=2)
+    assert result.converged and not result.violations, result.report()
+    assert result.crashes == 1
+    assert any("CRASH" in line for line in result.trace)
+    assert any("REBOOT" in line for line in result.trace)
+
+
+# ------------------------------------------------------- crash explorer
+
+
+def test_crash_registry_classifier():
+    from k8s_operator_libs_tpu import wire
+    from tools.crash.registry import SITES, classify
+    assert classify("patch_node_unschedulable", ("h0", True), {}) == \
+        "cordon-flip"
+    assert classify(
+        "patch_node_metadata", ("h0",),
+        {"labels": {wire.MARKET_OWNER_LABEL: "serving"}}) == \
+        "market-lease"
+    assert classify(
+        "patch_node_taints",
+        ("h0", [{"key": wire.QUARANTINE_TAINT_KEY, "value": "x",
+                 "effect": "NoSchedule"}]), {}) == "health-quarantine"
+    assert classify(
+        "patch_node_metadata", ("h0",),
+        {"labels": {"tpu.dev/libtpu-driver-upgrade-state":
+                    "upgrade-required"}}) == "rollout-decree"
+    assert classify(
+        "patch_node_metadata", ("h0",),
+        {"labels": {"tpu.dev/libtpu-driver-upgrade-state":
+                    "upgrade-done"}}) == "state-journey"
+    # repair injection carries REPAIR_* plus the upgrade-requested
+    # template: precedence must pick health-repair
+    assert classify(
+        "patch_node_metadata", ("h0",),
+        {"annotations": {
+            wire.REPAIR_ANNOTATION: "pending",
+            "tpu.dev/libtpu-driver-upgrade-requested": "true"}}) == \
+        "health-repair"
+    assert classify("delete_pod", ("ns", "p"), {}) is None
+    assert classify("patch_node_metadata", ("h0",),
+                    {"labels": {"other": "x"}}) is None
+    assert set(SITES) == {
+        "state-journey", "rollout-decree", "cordon-flip",
+        "health-verdict", "health-quarantine", "health-repair",
+        "market-lease", "drain-intent", "migration-intent",
+        "replica-registry"}
+
+
+def test_crash_plan_validation():
+    from tools.crash.explorer import CrashPlan
+    with pytest.raises(ValueError):
+        CrashPlan("no-such-site", 1, "before")
+    with pytest.raises(ValueError):
+        CrashPlan("cordon-flip", 1, "sideways")
+    with pytest.raises(ValueError):
+        CrashPlan("cordon-flip", 0, "before")
+
+
+def test_crash_sweep_covers_every_registered_site():
+    from tools.crash.explorer import record_sites
+    from tools.crash.registry import SITES
+    observed = record_sites(seed=0)
+    for site in SITES:
+        assert observed.get(site, 0) > 0, (site, observed)
+
+
+def test_crash_points_converge_and_replay():
+    from tools.crash.explorer import CrashPlan, run_crash_point
+    plan = CrashPlan("state-journey", 1, "before")
+    r1 = run_crash_point(plan, seed=0, shrink=False)
+    assert not r1.failed, r1.report()
+    assert r1.crashes == 1
+    r2 = run_crash_point(plan, seed=0, shrink=False)
+    assert r1.trace == r2.trace  # (scenario, seed, plan) IS the repro
+    after = run_crash_point(CrashPlan("drain-intent", 1, "after"),
+                            seed=0, shrink=False)
+    assert not after.failed, after.report()
+
+
+def test_alert_incarnation_regression_pin():
+    """The first full sweep's shrunk reproducer: a kill while a burn
+    alert is FIRING used to trip the alert-transition invariant
+    (firing -> inactive on the fresh process) and orphan the dying
+    incarnation's final SLOAlertFiring event. The campaign now tracks
+    alert machines per process INCARNATION and freezes the dying one's
+    final status — both crash points converge."""
+    from tools.crash.explorer import CrashPlan, run_crash_point
+    for phase in ("before", "after"):
+        result = run_crash_point(CrashPlan("health-quarantine", 9, phase),
+                                 seed=0, shrink=False)
+        assert not result.failed, result.report()
+
+
+# -------------------------------------------------------- status surface
+
+
+def test_status_resilience_view_and_banner(capsys):
+    import importlib.util
+    import os
+    import types
+    spec = importlib.util.spec_from_file_location(
+        "status_cli_resilience",
+        os.path.join(os.path.dirname(__file__), "..", "cmd", "status.py"))
+    status = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(status)
+    degraded_banner = status.degraded_banner
+    render_resilience = status.render_resilience
+    run_resilience_view = status.run_resilience_view
+    payload = {"kind": "resilience", "data": {
+        "breaker": "open", "degraded": True, "staleness_s": 42.0,
+        "retried_total": 3, "shed_total": 17, "rate_limited_total": 0,
+        "breaker_opened_total": 1}}
+
+    def fetch(url, path):
+        assert path == "/resilience"
+        return payload
+
+    banner = degraded_banner("http://x", fetch=fetch)
+    assert banner and "DEGRADED (fail-static)" in banner
+    assert "42" in banner
+    text = render_resilience(payload["data"])
+    assert "open" in text and "17" in text
+    args = types.SimpleNamespace(operator_url="http://x", as_json=False)
+    assert run_resilience_view(args, fetch=fetch) == 0
+    assert "DEGRADED" in capsys.readouterr().out
+    payload["data"]["degraded"] = False
+    assert degraded_banner("http://x", fetch=fetch) is None
+
+    def broken(url, path):
+        raise OSError("no route")
+
+    assert degraded_banner("http://x", fetch=broken) is None
+    args = types.SimpleNamespace(operator_url="http://x", as_json=False)
+    assert run_resilience_view(args, fetch=broken) == 2

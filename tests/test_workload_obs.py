@@ -297,6 +297,11 @@ def test_status_watch_survives_transient_endpoint_failures(capsys):
     def fetch(url, path):
         if path == "/alerts":
             return {"kind": "alerts", "data": []}
+        if path == "/resilience":
+            # the dashboard polls the degraded banner every frame;
+            # an operator without resilience wired answers the
+            # disabled-envelope shape (no banner)
+            return {"error": "resilience disabled"}
         frame = next(frames)
         if isinstance(frame, Exception):
             raise frame

@@ -181,7 +181,7 @@ def main(argv=None) -> int:
     p.add_argument("--tick-interval", type=float, default=30.0,
                    help="modelled seconds between ticks")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--round", default="r02")
+    p.add_argument("--round", default="r03")
     p.add_argument("--out", default=None, metavar="PATH",
                    help="artifact path (default FLEET_<round>.json)")
     p.add_argument("--shards", type=int, default=8,
@@ -306,6 +306,18 @@ def main(argv=None) -> int:
     decomposed = (profile.get("self_total_s", 0.0)
                   + profile.get("api_total_s", 0.0))
     tick_sample = profile.get("duration_s", 0.0)
+    # the r03 claim (ROADMAP item 2 headroom closed): the health tick
+    # reads from the pumped informer store, so its only apiserver
+    # traffic on the cached path is the freshness barrier's O(changed)
+    # watch polls — the two O(fleet) LIST/GET reads are gone
+    health_entry = next(
+        (e for e in profile.get("entries", [])
+         if e["handler"] == "health-tick"), None)
+    health_calls = dict(health_entry["api_calls"]) if health_entry else {}
+    health_list_calls = sum(
+        n for name, n in health_calls.items()
+        if name.split(" ")[0] in ("list", "get"))
+    health_api_s = health_entry["api_s"] if health_entry else 0.0
     tsdb = operator.tsdb
     state_counts = {}
     for node in cluster.client.direct().list_nodes():
@@ -357,6 +369,11 @@ def main(argv=None) -> int:
         * (tsdb.raw_points + tsdb.coarse_points),
         "scrape_sub_tick": (percentile(scrape_s, 0.99)
                             < max(1e-9, percentile(tick_wall, 0.5))),
+        # cached path only: the health monitor must issue ZERO LIST/GET
+        # apiserver calls per tick (informer-store reads behind the pump
+        # barrier; the barrier's watch polls are O(changed) and allowed)
+        "health_tick_zero_list_calls": (
+            bool(args.uncached) or health_list_calls == 0),
         "profile_decomposes_within_5pct": (
             tick_sample > 0
             and abs(decomposed - tick_sample) <= 0.05 * tick_sample),
@@ -413,6 +430,9 @@ def main(argv=None) -> int:
         },
         "journeys": dict(journeys, nodes=len(nodes)),
         "profile_last_tick": {
+            "health_tick_api_calls": health_calls,
+            "health_tick_list_get_calls": health_list_calls,
+            "health_tick_api_s": round(health_api_s, 4),
             "duration_s": round(tick_sample, 3),
             "self_total_s": round(profile.get("self_total_s", 0.0), 3),
             "api_total_s": round(profile.get("api_total_s", 0.0), 3),

@@ -20,6 +20,8 @@ Passes:
 - :mod:`.state_machine`   — STM001 upgrade-state-machine exhaustiveness
 - :mod:`.obs_check`       — OBS001–OBS003 journey/attribution/SLO closure
 - :mod:`.chaos_check`     — CHS001 chaos fault-catalog closure
+- :mod:`.crash_check`     — CRS001 crash-explorer durable-write-site
+                            closure over the wire keys it stamps
 - :mod:`.wire_check`      — WIRE001 wire-key registry closure
 - :mod:`.sync_check`      — SYN001 host-sync hygiene on the hot paths
 - :mod:`.thread_discipline` — THR001 threading-shim closure, GRD001
@@ -57,8 +59,8 @@ from typing import List, Optional, Tuple
 from .registry import REGISTRY, Check, FileContext, all_codes, register
 from .index import ProjectIndex, as_index
 from . import (core, jax_hygiene, lock_discipline, lock_order, determinism,  # noqa: F401,E501  (registration imports)
-               state_machine, obs_check, chaos_check, wire_check, sync_check,
-               thread_discipline, layering)
+               state_machine, obs_check, chaos_check, crash_check,
+               wire_check, sync_check, thread_discipline, layering)
 from .core import BUILTINS, Checker, Scope  # noqa: F401  (compat re-exports)
 
 __all__ = ["lint_file", "lint_project", "run_suite", "main", "REGISTRY",

@@ -344,6 +344,12 @@ MARKET_FAMILY_PREFIX = "tpu_market_"
 PROFILE_FAMILY_PREFIXES = ("tpu_operator_apiserver_",
                            "tpu_operator_tsdb_",
                            "tpu_operator_obs_scrape_")
+# the resilient client boundary's emitted-family tables
+# (RESILIENCE_GAUGE_FAMILIES / RESILIENCE_COUNTER_FAMILIES) — its
+# families share the tpu_operator_apiserver_ prefix with the flight
+# recorder, so the profile reverse-check treats both tables as the
+# emitted set for that prefix; same absent-module skip rule
+RESILIENCE_PATH = "k8s_operator_libs_tpu/core/resilience.py"
 
 
 def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
@@ -539,6 +545,30 @@ def run_slo(root) -> List[Finding]:
                      f"({MARKET_METRICS_PATH}) (renamed or removed "
                      f"market metric?)"))
 
+    # resilient client boundary: core/resilience.py's emitted-family
+    # tables close over HELP_TEXTS both ways (skipped when the checkout
+    # carries no resilience module). Collected BEFORE the profile block
+    # so the shared tpu_operator_apiserver_ prefix check can treat the
+    # union of both modules' tables as the emitted set.
+    resilience_emitted: Dict[str, int] = {}
+    if index.exists(RESILIENCE_PATH):
+        resilience_tree = index.tree(RESILIENCE_PATH)
+        for table in ("RESILIENCE_GAUGE_FAMILIES",
+                      "RESILIENCE_COUNTER_FAMILIES"):
+            fams, fams_line = _string_tuple(resilience_tree, table)
+            if fams_line == 0:
+                findings.append(
+                    (RESILIENCE_PATH, 1, "OBS003",
+                     f"{table} table not found (parse drift?)"))
+                continue
+            resilience_emitted.update(fams)
+        for family, lineno in sorted(resilience_emitted.items()):
+            if family not in help_keys:
+                findings.append(
+                    (RESILIENCE_PATH, lineno, "OBS003",
+                     f"emitted resilience family {family!r} has no "
+                     f"HELP_TEXTS entry ({METRICS_PATH})"))
+
     # flight recorder: the obs/profile.py emitted-family tables close
     # over HELP_TEXTS both ways too (skipped when the checkout carries
     # no profile module)
@@ -563,13 +593,15 @@ def run_slo(root) -> List[Finding]:
                      f"HELP_TEXTS entry ({METRICS_PATH})"))
         for key, lineno in sorted(help_keys.items()):
             if (key.startswith(PROFILE_FAMILY_PREFIXES)
-                    and key not in profile_emitted):
+                    and key not in profile_emitted
+                    and key not in resilience_emitted):
                 findings.append(
                     (METRICS_PATH, lineno, "OBS003",
                      f"HELP_TEXTS entry {key!r} matches no emitted "
                      f"family in the PROFILE_*_FAMILIES tables "
-                     f"({PROFILE_PATH}) (renamed or removed "
-                     f"flight-recorder metric?)"))
+                     f"({PROFILE_PATH}) or the RESILIENCE_*_FAMILIES "
+                     f"tables ({RESILIENCE_PATH}) (renamed or removed "
+                     f"metric?)"))
     return findings
 
 
