@@ -129,7 +129,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 # ProjectIndex parse per file (tools/lint/index.py).
 LINT_FLAGS ?=
 
-lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, THR001/GRD001 thread discipline, ARC001 import layering (docs/static-analysis.md)
+lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, THR001/GRD001 thread discipline, ARC001 import layering, EXC001-003 interprocedural exception contracts, STL001 stale-read taint (docs/static-analysis.md)
 	$(PYTHON) -m tools.lint --domain $(LINT_FLAGS)
 
 LINT_BUDGET ?= 60

@@ -219,7 +219,7 @@ def build_market(section, client, slo_engine, hub, recorder, clock):
             try:
                 return marginal_goodput(summarize(read_ledger(ledger_path)),
                                         max(1, len(supply)))
-            except Exception:
+            except Exception:  # exc: allow — goodput is an advisory pricing input; a broken ledger prices as 0.0
                 return 0.0
     return CapacityArbiter(
         supply, client=client, demand=demand, slo_engine=slo_engine,
@@ -436,7 +436,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         resilience_opts = load_resilience(args.config)
         client, recorder, resilient = build_client(args, components,
                                                    resilience_opts)
-    except Exception as exc:
+    except Exception as exc:  # exc: allow — CLI startup: any config/build failure becomes exit 2 with its message
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
@@ -578,7 +578,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                             dirty.set()
                             if stop.is_set():
                                 return
-                    except Exception as exc:
+                    except Exception as exc:  # exc: allow — watch threads survive any transport failure and re-watch after backoff
                         logger.warning("%s watch dropped (%s); retrying",
                                        source_name, exc)
                         stop.wait(1.0)
@@ -634,7 +634,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 # degraded: no new trades off a stale view (fail-static)
                 try:
                     arbiter.tick()
-                except Exception:
+                except Exception:  # exc: allow — tick isolation: a market failure must not stop reconcile; next tick retries
                     logger.exception("market arbiter tick failed; "
                                      "retrying next tick")
             if server:

@@ -61,6 +61,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
+from k8s_operator_libs_tpu.core.client import ApiError  # noqa: E402
 from k8s_operator_libs_tpu.utils import threads  # noqa: E402
 
 logger = logging.getLogger("tpu-router")
@@ -125,7 +126,7 @@ class HTTPRuntime:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout):
                 pass
-        except Exception:
+        except Exception:  # exc: allow — the replica may already be gone; the drain POST is best-effort
             logger.warning("drain POST to %s failed (replica gone?)",
                            self.url, exc_info=True)
 
@@ -265,7 +266,7 @@ class RouterFront:
                     replica.stats.draining = True
                     continue
                 return exc.code, payload
-            except Exception as exc:
+            except Exception as exc:  # exc: allow — connection refused/reset of any shape means the replica is gone — reroute
                 # connection refused / reset: the replica is gone; mark
                 # it failed and reroute (it never served the request)
                 logger.warning("replica %s unreachable: %s", replica.id,
@@ -393,7 +394,7 @@ class RouterFront:
                     continue
                 emit(payload)
                 return exc.code
-            except Exception as exc:
+            except Exception as exc:  # exc: allow — a dying stream source of any shape fails the replica and reroutes
                 logger.warning("stream source %s died mid-relay: %s",
                                replica.id, exc)
                 replica.runtime.fail()
@@ -428,7 +429,7 @@ class RouterFront:
             env = self._post_json(base + "/export", {"rid": rid},
                                   self.proxy_timeout)
             payload = env["data"]
-        except Exception:
+        except Exception:  # exc: allow — export failure of any shape falls back to re-submit at degraded priority
             logger.warning("export of rid %s from %s failed; falling "
                            "back to re-submit", rid, donor.id,
                            exc_info=True)
@@ -450,7 +451,7 @@ class RouterFront:
                 out = self._post_json(peer.url.rstrip("/") + "/adopt",
                                       payload, self.proxy_timeout)
                 data = out["data"]
-            except Exception:
+            except Exception:  # exc: allow — an adoption failure of any shape just tries the next peer
                 logger.warning("peer %s rejected adoption of rid %s",
                                peer.id, rid, exc_info=True)
                 continue
@@ -483,12 +484,12 @@ class RouterFront:
                     replica.node_name, annotations={
                         DRAIN_INTENT_ANNOTATION:
                             f"{reason}@{self._clock.wall():.3f}"})
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.warning("could not stamp drain intent on %s",
                                replica.node_name, exc_info=True)
         try:
             replica.runtime.drain()
-        except Exception:
+        except Exception:  # exc: allow — a crashed runtime surface marks the replica failed; the pool collects it
             replica.failed = True
         logger.info("draining replica %s on %s (%s)", replica.id,
                     replica.node_name, reason)
@@ -547,7 +548,7 @@ class RouterFront:
 def _safe_json(exc):
     try:
         return json.loads(exc.read())
-    except Exception:
+    except Exception:  # exc: allow — the error body may be any shape; fall back to a synthesized envelope
         return {"error": f"replica error {exc.code}"}
 
 
@@ -743,7 +744,7 @@ def main(argv=None, on_ready=None):
             try:
                 front.tick()
                 autoscaler.tick()
-            except Exception:
+            except Exception:  # exc: allow — ticker isolation: log and retry next tick; the process must not die
                 logger.exception("router tick failed; retrying")
             stop.wait(args.tick)
 

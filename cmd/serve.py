@@ -273,7 +273,7 @@ class ServingRuntime:
                             if ev:
                                 ev.set()
                         continue
-            except Exception:
+            except Exception:  # exc: allow — a dead stepper must flip unhealthy and release every waiter, not hang them
                 # a dead stepper with no diagnosis would leave every
                 # waiter blocked forever behind a green healthz — log,
                 # flip the server unhealthy, and release all waiters

@@ -370,7 +370,7 @@ def degraded_banner(operator_url: str, fetch=fetch_view):
     prepends it best-effort, never fails on it."""
     try:
         data = fetch(operator_url, "/resilience").get("data") or {}
-    except Exception:
+    except Exception:  # exc: allow — the banner is best-effort; unreachable just means no banner
         return None
     if not data.get("degraded"):
         return None
@@ -399,7 +399,7 @@ def render_resilience(data) -> str:
 def run_resilience_view(args, fetch=fetch_view) -> int:
     try:
         env = fetch(args.operator_url, "/resilience")
-    except Exception as exc:
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
         print(f"error: cannot read {args.operator_url}: {exc}",
               file=sys.stderr)
         return 2
@@ -450,7 +450,7 @@ def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
                        if (args.slo or args.watch) else None)
             alerts_env = (fetch(args.operator_url, "/alerts")
                           if (args.alerts or args.watch) else None)
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — watch mode renders the error inline; one-shot exits 2
             if not args.watch:
                 print(f"error: cannot read {args.operator_url}: {exc}",
                       file=sys.stderr)
@@ -567,7 +567,7 @@ def run_profile_view(args, fetch=fetch_view) -> int:
     is unreachable, like the other HTTP views)."""
     try:
         env = fetch(args.operator_url, "/profile")
-    except Exception as exc:
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
         print(f"error: cannot read {args.operator_url}: {exc}",
               file=sys.stderr)
         return 2
@@ -644,7 +644,7 @@ def run_market_view(args, fetch=fetch_view) -> int:
     endpoint is unreachable, like --profile)."""
     try:
         env = fetch(args.operator_url, "/market")
-    except Exception as exc:
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
         print(f"error: cannot read {args.operator_url}: {exc}",
               file=sys.stderr)
         return 2
@@ -699,7 +699,7 @@ def render_replicas(data) -> str:
 def run_replicas_view(args, fetch=fetch_view) -> int:
     try:
         env = fetch(args.router_url, "/replicas")
-    except Exception as exc:
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
         print(f"error: cannot read {args.router_url}: {exc}",
               file=sys.stderr)
         return 2

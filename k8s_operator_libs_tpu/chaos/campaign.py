@@ -337,7 +337,7 @@ class ServingTier:
         that would be delivering the SIGTERM."""
         try:
             obj = self.cluster.client.direct().get_node(node)
-        except Exception:
+        except Exception:  # exc: allow — pod-side view: any read failure counts as not-clean (conservative)
             return False
         return (not obj.spec.unschedulable and obj.is_ready()
                 and QUARANTINE_LABEL not in obj.metadata.labels
@@ -661,7 +661,7 @@ def run_scenario(scenario: Scenario, seed: int,
                 f"t={clock.now() - 10_000.0:7.1f}s  REBOOT {identity} "
                 f"as a fresh process")
             return True
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — chaos reboot injection retries next tick; the campaign must not die
             injector.trace.append(
                 f"t={clock.now() - 10_000.0:7.1f}s  REBOOT {identity} "
                 f"failed ({exc}); retrying next tick")

@@ -244,7 +244,7 @@ class _Informer:
                 try:
                     self._relist()
                     self._synced.set()
-                except Exception as exc:
+                except Exception as exc:  # exc: allow — the informer must survive any list failure; staleness is surfaced and the next pump retries
                     logger.warning("informer %s: pump re-list failed: %s "
                                    "(stale until next pump)", self.kind, exc)
                 return
@@ -257,12 +257,12 @@ class _Informer:
                             self.kind, exc)
                 try:
                     self._relist()
-                except Exception as exc2:
+                except Exception as exc2:  # exc: allow — re-list after watch expiry is best-effort; the next pump retries
                     self._set_resume_point(None)
                     logger.warning("informer %s: pump re-list failed: %s "
                                    "(stale until next pump)", self.kind, exc2)
                 return
-            except Exception as exc:
+            except Exception as exc:  # exc: allow — a pump watch failure leaves the cache stale until the next pump, by design
                 logger.warning("informer %s: pump watch failed: %s "
                                "(stale until next pump)", self.kind, exc)
                 return
@@ -307,7 +307,7 @@ class _Informer:
                 logger.info("informer %s: watch expired (%s); re-listing",
                             self.kind, exc)
                 self._set_resume_point(None)
-            except Exception as exc:
+            except Exception as exc:  # exc: allow — the background informer thread must survive anything and re-list
                 if self._stop.is_set():
                     return
                 logger.warning("informer %s: %s; re-listing in 1s",

@@ -24,21 +24,33 @@ from .objects import ControllerRevision, DaemonSet, Event, Job, Node, Pod
 logger = logging.getLogger(__name__)
 
 
-class NotFoundError(KeyError):
+class ApiError(RuntimeError):
+    """Root of the structured apiserver error family. Every status-coded
+    client error (404/409/422/429/5xx, plus the breaker's synthetic shed)
+    is an ``ApiError`` subclass, so one ``except ApiError:`` arm
+    classifies the whole family at a reconcile-spine boundary — the
+    EXC001 lint contract (docs/static-analysis.md): these must never be
+    swallowed by a broad ``except Exception`` before the DEGRADED-mode
+    machinery (core/resilience.py) can see what they were. A
+    ``RuntimeError`` subclass so pre-existing broad RuntimeError handling
+    keeps working."""
+
+
+class NotFoundError(ApiError, KeyError):
     """Object does not exist (apierrors.IsNotFound analog)."""
 
 
-class TooManyRequestsError(RuntimeError):
+class TooManyRequestsError(ApiError):
     """HTTP 429 from the eviction subresource: a PodDisruptionBudget is
     blocking the eviction right now. kubectl drain retries these until its
     timeout; so does our drain Helper."""
 
 
-class ConflictError(RuntimeError):
+class ConflictError(ApiError):
     """resourceVersion conflict on update (apierrors.IsConflict analog)."""
 
 
-class ServerError(RuntimeError):
+class ServerError(ApiError):
     """HTTP 5xx from the apiserver: a transient server-side failure
     (overload, rolling restart, etcd leader change). Retryable — the
     reconcile loop's per-component isolation and the drain helper's
@@ -46,7 +58,7 @@ class ServerError(RuntimeError):
     they do."""
 
 
-class InvalidError(ValueError):
+class InvalidError(ApiError, ValueError):
     """HTTP 422 Unprocessable Entity: the object failed apiserver
     validation (apierrors.IsInvalid analog) — e.g. a taint appended
     without an effect."""
@@ -169,7 +181,7 @@ class ClientEventRecorder(EventRecorder):
         try:
             create(make_event(obj, event_type, reason, message),
                    namespace=self._namespace)
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — events are advisory; an event write must never fail the caller
             logger.debug("event write failed (%s); dropping %s", exc, reason)
 
 

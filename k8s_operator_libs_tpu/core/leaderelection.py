@@ -136,7 +136,7 @@ class LeaderElector:
         campaign ticks candidates on a fake clock)."""
         try:
             return self.tick()
-        except Exception:
+        except Exception:  # exc: allow — any tick failure demotes at the renew deadline, exactly like a renew timeout
             logger.exception("leader-election tick failed")
             # demote at a renew DEADLINE strictly inside the lease
             # (client-go: renewDeadline < leaseDuration): a standby
@@ -203,7 +203,7 @@ class LeaderElector:
                 lease.spec.holder_identity = ""
                 lease.spec.renew_time = None
                 self._client.update_lease(lease)
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — release is best-effort; an unreleased lease expires on its own
             logger.warning("could not release lease %s/%s (%s); it will "
                            "expire on its own", self._ns, self._name, exc)
 

@@ -614,7 +614,7 @@ class LiveEventRecorder:
         try:
             self._http.request("POST", f"/api/v1/namespaces/{ns}/events",
                                body=body)
-        except Exception:  # advisory only
+        except Exception:  # exc: allow — events are advisory; an event POST must never fail the caller
             pass
 
 

@@ -58,7 +58,8 @@ import random
 from typing import Dict, Optional
 
 from ..utils.clock import Clock, RealClock
-from .client import ServerError, TooManyRequestsError, method_verb_kind
+from .client import (ApiError, ServerError, TooManyRequestsError,
+                     method_verb_kind)
 
 logger = logging.getLogger(__name__)
 
@@ -316,12 +317,14 @@ class ResilientClient:
         """One cheap gated read (a label-scoped node LIST matching
         nothing) — the degraded-mode recovery probe for configurations
         without an informer pump. Sheds instantly while the breaker is
-        open; once half-open, a success closes the breaker."""
+        open; once half-open, a success closes the breaker. A 5xx, a
+        shed, a throttle, or the retry budget expiring all mean the same
+        thing here: not recovered yet."""
         try:
             self._call("list_nodes", self._inner.list_nodes, "list", (),
                        {"label_selector": {"breaker-probe": "none"}})
             return True
-        except Exception:
+        except (ApiError, TimeoutError):
             return False
 
     def payload(self) -> Dict[str, object]:

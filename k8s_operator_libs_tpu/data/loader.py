@@ -62,7 +62,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32)]
             lib.tl_close.argtypes = [ctypes.c_void_p]
             _lib = lib
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — native-library probing; any ctypes failure falls back to numpy
             logger.warning("native tokenloader unavailable (%s); "
                            "using numpy fallback", exc)
             _lib_failed = True
@@ -256,7 +256,7 @@ class TokenDataset:
             while not stop.is_set():
                 try:
                     item = self.sample_at(batch, seqlen, seed, step, shard)
-                except BaseException as exc:  # surface, don't die silently
+                except BaseException as exc:  # exc: allow — forwarded to the consumer queue, then exit; dying silently would hang every reader
                     _put(_ProducerDied(exc))
                     return
                 step += 1

@@ -34,7 +34,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional
 
-from ..core.client import Client, EventRecorder
+from ..core.client import ApiError, Client, EventRecorder
 from ..core.objects import Node, Pod
 from ..upgrade.consts import UpgradeState
 from ..upgrade.groups import NodeGrouper, SingleNodeGrouper
@@ -187,22 +187,31 @@ class FleetHealthMonitor:
 
     # ----------------------------------------------------------------- tick
 
-    def tick(self) -> HealthReport:
+    def tick(self) -> Optional[HealthReport]:
         # freshness barrier + read path selection (see module docstring)
         pump = getattr(self._client, "pump", None)
-        if pump is not None:
-            pump(kinds=("Node", "Pod"))
-            view = self._client
-        else:
-            view = self._client.direct()
-        pods = view.list_pods(namespace=self._namespace,
-                              label_selector=self._driver_labels)
-        pods_by_node: Dict[str, List[Pod]] = {}
-        for pod in pods:
-            if pod.spec.node_name:
-                pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
-        nodes = [n for n in view.list_nodes() if self._in_scope(
-            n, pods_by_node)]
+        try:
+            if pump is not None:
+                pump(kinds=("Node", "Pod"))
+                view = self._client
+            else:
+                view = self._client.direct()
+            pods = view.list_pods(namespace=self._namespace,
+                                  label_selector=self._driver_labels)
+            pods_by_node: Dict[str, List[Pod]] = {}
+            for pod in pods:
+                if pod.spec.node_name:
+                    pods_by_node.setdefault(pod.spec.node_name,
+                                            []).append(pod)
+            nodes = [n for n in view.list_nodes() if self._in_scope(
+                n, pods_by_node)]
+        except ApiError:
+            # classified: the fleet read failed mid-tick — never probe
+            # a half-read snapshot; re-publish the last fresh report
+            # with verdicts masked (None before any fresh tick existed)
+            logger.warning("fleet read failed mid-tick; serving the "
+                           "masked last report", exc_info=True)
+            return self.masked_report()
 
         snapshot = Snapshot(nodes=nodes, pods_by_node=pods_by_node,
                             clock=self._clock)
@@ -313,6 +322,6 @@ class FleetHealthMonitor:
                     node.metadata.labels.pop(consts.VERDICT_LABEL, None)
                 else:
                     node.metadata.labels[consts.VERDICT_LABEL] = want
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.exception("could not sync verdict label on %s",
                                  node.metadata.name)

@@ -271,7 +271,7 @@ def run_probes(probes: List[Probe], snapshot: Snapshot
     for probe in probes:
         try:
             signals.extend(probe.observe(snapshot))
-        except Exception:
+        except Exception:  # exc: allow — probe isolation: one broken signal source must not blind the fleet
             logger.exception("health probe %s failed", probe.name)
             errors.append(probe.name)
     return signals, errors

@@ -155,7 +155,7 @@ class HealthRemediator:
                     f"no remediation handler for verdict {sv.verdict!r}")
             try:
                 handler(sv, ctx)
-            except Exception:
+            except Exception:  # exc: allow — per-slice isolation: one slice's failure must not starve the rest; next tick retries idempotently
                 # one slice's apiserver hiccup must not starve the rest;
                 # the next tick retries idempotently (all state is labels)
                 logger.exception("remediation of %s failed", sv.key)

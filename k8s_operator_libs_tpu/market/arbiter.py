@@ -50,6 +50,7 @@ import json
 import logging
 from typing import Callable, Dict, List, Optional
 
+from ..core.client import ApiError
 from ..serving.pool import DRAIN_STATES
 from ..serving.router import LANE_WEIGHTS
 from ..upgrade.consts import UpgradeState
@@ -232,7 +233,7 @@ class CapacityArbiter:
             try:
                 depths = self.demand.lane_depths()
                 admitting = max(1, int(self.demand.admitting_count()))
-            except Exception:
+            except Exception:  # exc: allow — the demand surface is advisory; price with empty lanes when it fails
                 depths, admitting = {}, 1
             weighted = sum(LANE_WEIGHTS.get(name, 1.0) * depth
                            for name, depth in depths.items())
@@ -249,7 +250,7 @@ class CapacityArbiter:
             return 1.0
         try:
             raw = float(self.goodput_fn())
-        except Exception:
+        except Exception:  # exc: allow — the goodput hook is external; any failure prices at parity
             return 1.0
         if self.config.goodput_norm > 0:
             return raw / self.config.goodput_norm
@@ -335,7 +336,7 @@ class CapacityArbiter:
             return default
         try:
             return hook(ms)
-        except Exception:
+        except Exception:  # exc: allow — market hooks are tenant callbacks; a raising hook reads as its safe default
             logger.exception("market %s hook raised for slice %s", name,
                              ms.slice_id)
             return False
@@ -400,7 +401,7 @@ class CapacityArbiter:
                                    for t in node.spec.taints)
                             or state in DRAIN_STATES):
                         return False
-        except Exception:
+        except Exception:  # exc: allow — any view failure defers the trade — the market trades on truth, never a guess
             # the cluster view is unavailable: defer the trade — the
             # market trades on truth, never on a guess
             return False
@@ -436,7 +437,7 @@ class CapacityArbiter:
                 else:
                     self._client.patch_node_metadata(node, labels=labels)
             ms.stamp_pending = False
-        except Exception:
+        except (ApiError, TimeoutError):
             ms.stamp_pending = True
             logger.warning("could not stamp market state %s on slice %s; "
                            "retrying next tick", ms.phase, ms.slice_id,
@@ -452,7 +453,7 @@ class CapacityArbiter:
         for ms in self.supply:
             try:
                 node = self._client.direct().get_node(ms.anchor)
-            except Exception:
+            except Exception:  # exc: allow — resume keeps defaults on any read failure; the stamp re-asserts and converges
                 continue        # keep defaults; stamp will converge
             lease = node.metadata.annotations.get(MARKET_LEASE_ANNOTATION)
             if not lease:
@@ -498,7 +499,7 @@ class CapacityArbiter:
         if self.demand is not None:
             try:
                 lanes = self.demand.lane_stats()
-            except Exception:
+            except Exception:  # exc: allow — the /market payload is best-effort observability
                 lanes = None
         return {
             "rate": (self.last_rate if self.last_rate != float("inf")
@@ -535,6 +536,6 @@ class CapacityArbiter:
         try:
             self._recorder.event(_MarketObject(ms.slice_id), event_type,
                                  reason, message)
-        except Exception:
+        except Exception:  # exc: allow — events are advisory; never fail the decree on the recorder
             logger.warning("could not record %s event", reason,
                            exc_info=True)

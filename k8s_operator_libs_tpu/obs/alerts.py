@@ -162,7 +162,7 @@ class AlertManager:
         try:
             self._recorder.event(_AlertObject(rule.name), event_type,
                                  reason, message)
-        except Exception:
+        except Exception:  # exc: allow — events are advisory; alert evaluation must not fail on the recorder
             logger.exception("alert event emit failed for %s", rule.name)
 
     # -------------------------------------------------------------- reads

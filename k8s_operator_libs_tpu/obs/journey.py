@@ -43,6 +43,7 @@ import json
 import logging
 from typing import Dict, List, Optional, Tuple
 
+from ..core.client import ApiError
 from ..utils.clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
@@ -249,7 +250,7 @@ class StuckNodeDetector:
             try:
                 self._client.patch_node_metadata(
                     name, annotations={self._stuck_key: marker})
-            except Exception:
+            except (ApiError, TimeoutError):
                 # marker write failed: do NOT emit — an event without the
                 # durable marker would duplicate on the next pass/leader
                 logger.exception("could not persist stuck marker on %s",

@@ -290,7 +290,7 @@ class SLOEngine:
         for spec in self.specs:
             try:
                 out[spec.name] = self._evaluate_one(spec)
-            except Exception:
+            except Exception:  # exc: allow — per-SLO isolation: one bad spec must not kill the other evaluations
                 logger.exception("SLO %s evaluation failed", spec.name)
         self.last = out
         return out

@@ -74,7 +74,7 @@ def make_mesh(data: int = 1, fsdp: Optional[int] = None, seq: int = 1,
             dev_array = mesh_utils.create_device_mesh(
                 shape, devices=devices, allow_split_physical_axes=True)
             return Mesh(dev_array, AXES)
-        except Exception as exc:
+        except Exception as exc:  # exc: allow — mesh_utils varies across jax versions; fall back to device-order reshape (logged loud)
             # loud: the reshape fallback can put the tensor axis on the
             # slowest ICI dimension — a silent step-time regression
             logger.warning("physical mesh assignment unavailable (%s); "

@@ -206,14 +206,14 @@ class Autoscaler:
             if self.market is not None:
                 try:
                     leased = set(self.market.leased_slice_ids())
-                except Exception:
+                except Exception:  # exc: allow — the market surface is advisory; place without preference when it fails
                     logger.warning("market lease lookup failed; placing "
                                    "without preference", exc_info=True)
             try:
                 placement = self.scheduler.place(
                     workload,
                     prefer=(leased.__contains__ if leased else None))
-            except Exception:
+            except Exception:  # exc: allow — scale-up isolation: a scheduler failure reads as no placement this tick
                 logger.exception("scale-up slice placement raised")
                 placement = None
             if placement is None:
@@ -226,7 +226,7 @@ class Autoscaler:
         if self.replica_factory is not None:
             try:
                 replica = self.replica_factory(placement)
-            except Exception:
+            except Exception:  # exc: allow — the replica factory is a tenant callback; on failure the slice serves pool-less
                 logger.exception("replica factory failed on scale-up")
         if replica is not None:
             self.pool.register(replica)
@@ -264,7 +264,7 @@ class Autoscaler:
                 if self.release is not None:
                     try:
                         self.release(replica)
-                    except Exception:
+                    except Exception:  # exc: allow — the release hook is a tenant callback; deregistration already happened
                         logger.exception("release hook failed for %s",
                                          replica.id)
 
@@ -274,6 +274,6 @@ class Autoscaler:
             try:
                 self._recorder.event(_RouterObject(), event_type, reason,
                                      message)
-            except Exception:
+            except Exception:  # exc: allow — events are advisory; never fail the decision on the recorder
                 logger.warning("could not record %s event", reason,
                                exc_info=True)

@@ -51,6 +51,7 @@ import logging
 import re
 from typing import Callable, Dict, List, Optional
 
+from ..core.client import ApiError
 from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import Clock, RealClock
@@ -222,7 +223,7 @@ class ReplicaPool:
                 self._client.patch_node_metadata(
                     replica.node_name, labels=labels,
                     annotations=annotations or None)
-            except Exception:
+            except (ApiError, TimeoutError):
                 # in-memory registry stays authoritative; the mirror is
                 # observability, not a correctness dependency
                 logger.warning("could not mirror replica %s registration "
@@ -241,7 +242,7 @@ class ReplicaPool:
                             LANE_LABEL: None},
                     annotations={REPLICA_ENDPOINT_ANNOTATION: None,
                                  KV_PAYLOAD_VERSION_ANNOTATION: None})
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.warning("could not clear replica %s registration "
                                "from node %s", replica_id,
                                replica.node_name, exc_info=True)
@@ -283,7 +284,7 @@ class ReplicaPool:
         for node_name in {r.node_name for r in self.replicas.values()}:
             try:
                 node = self._client.direct().get_node(node_name)
-            except Exception:
+            except (ApiError, TimeoutError):
                 self.node_refresh_errors += 1
                 continue
             labels = node.metadata.labels
@@ -308,7 +309,7 @@ class ReplicaPool:
                 if self.scrape_gate is not None:
                     self.scrape_gate(replica)
                 gauges = parse_gauges(replica.runtime.metrics_text())
-            except Exception:
+            except Exception:  # exc: allow — a failing scrape of any shape marks the stats stale; the router routes around it
                 replica.stats.stale = True
                 replica.stats.scrape_errors += 1
                 continue
@@ -415,7 +416,7 @@ class BatcherRuntime:
         try:
             if not self.srv.idle:
                 self.srv.step(n)
-        except Exception:
+        except Exception:  # exc: allow — a batcher crash of any shape fails the runtime; the router collects it
             logger.exception("replica batcher step crashed; failing the "
                              "runtime")
             self._failed = True

@@ -37,6 +37,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Tuple
 
+from ..core.client import ApiError
 from ..utils.clock import Clock, RealClock
 from ..wire import DRAIN_INTENT_ANNOTATION, MIGRATION_INTENT_ANNOTATION
 from .pool import DRAIN_STATES, Replica, ReplicaPool
@@ -316,13 +317,13 @@ class RequestRouter:
                     replica.node_name, annotations={
                         DRAIN_INTENT_ANNOTATION:
                             f"{reason}@{self._clock.wall():.3f}"})
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.warning("could not stamp drain intent on %s",
                                replica.node_name, exc_info=True)
         try:
             replica.runtime.drain()
             handoff = replica.runtime.handoff()
-        except Exception:
+        except Exception:  # exc: allow — a crashed runtime mid-drain is failed; its queue re-prefills on peers
             logger.exception("drain of replica %s failed; treating its "
                              "runtime as crashed", replica.id)
             replica.failed = True
@@ -367,7 +368,7 @@ class RequestRouter:
                     replica.node_name, annotations={
                         MIGRATION_INTENT_ANNOTATION:
                             f"{len(rids)}@{self._clock.wall():.3f}"})
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.warning("could not stamp migration intent on %s",
                                replica.node_name, exc_info=True)
         for rid in rids:
@@ -379,7 +380,7 @@ class RequestRouter:
                 payload = runtime.export_slot(req.local_rid)
             except KeyError:
                 continue    # finished between the drain and the export
-            except Exception:
+            except Exception:  # exc: allow — an export failure of any shape falls back to re-prefill from prompt
                 logger.exception("export of request %d from replica %s "
                                  "failed; falling back to re-prefill",
                                  rid, replica.id)
@@ -428,7 +429,7 @@ class RequestRouter:
             try:
                 if self.transfer_gate is not None:
                     self.transfer_gate(donor, peer)
-            except Exception:
+            except Exception:  # exc: allow — transfer-gate failures retry under the bounded backoff budget
                 logger.warning(
                     "KV transfer of request %d to %s failed (attempt "
                     "%d/%d); backing off", rid, peer.id, attempts,
@@ -437,7 +438,7 @@ class RequestRouter:
                 continue
             try:
                 local = peer.runtime.adopt_slot(payload)
-            except Exception:
+            except Exception:  # exc: allow — an adoption failure of any shape just tries the next peer
                 logger.warning(
                     "peer %s rejected adoption of request %d; trying "
                     "the next peer", peer.id, rid, exc_info=True)
@@ -489,7 +490,7 @@ class RequestRouter:
                 try:
                     if replica.runtime.idle:
                         replica.drained = True
-                except Exception:
+                except Exception:  # exc: allow — a dead runtime surface marks the replica failed
                     replica.failed = True
 
     # ---------------------------------------------------------- failures
@@ -503,7 +504,7 @@ class RequestRouter:
             alive = True
             try:
                 alive = replica.runtime.alive()
-            except Exception:
+            except Exception:  # exc: allow — an unreachable liveness surface counts as dead (conservative)
                 alive = False
             if alive and not replica.stats.failed:
                 continue
@@ -563,7 +564,7 @@ class RequestRouter:
                 continue
             try:
                 chunks = replica.runtime.poll_stream()
-            except Exception:
+            except Exception:  # exc: allow — a failing stream poll fails the replica; its requests migrate
                 replica.failed = True
                 continue
             for local_rid, toks in chunks.items():
@@ -580,7 +581,7 @@ class RequestRouter:
                 continue
             try:
                 done = replica.runtime.poll()
-            except Exception:
+            except Exception:  # exc: allow — a failing completion poll fails the replica; its requests migrate
                 replica.failed = True
                 continue
             for local_rid, tokens in done.items():
@@ -655,7 +656,7 @@ class RequestRouter:
             try:
                 local = target.runtime.submit(list(req.prompt),
                                               req.max_new)
-            except Exception:
+            except Exception:  # exc: allow — a refused submit requeues the request and stops picking the replica this tick
                 logger.warning("submit to replica %s refused; requeueing",
                                target.id, exc_info=True)
                 target.stats.draining = True   # stop picking it this tick

@@ -23,8 +23,8 @@ import types
 from typing import Dict, List, Optional
 
 from ..api.v1alpha1 import DriverUpgradePolicySpec
-from ..core.client import Client, EventRecorder
-from ..core.resilience import ResilientClient
+from ..core.client import ApiError, Client, EventRecorder
+from ..core.resilience import BreakerOpenError, ResilientClient
 from ..upgrade.consts import UpgradeState
 from ..wire import (PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
                     QUARANTINE_LIFT_ANNOTATION,
@@ -242,7 +242,23 @@ class TPUOperator:
                                                 deltas=deltas)
                         mgr.apply_state(state, comp.policy)
                         states[comp.name] = state
-                    except Exception:
+                    except ApiError as exc:
+                        logger.exception("upgrade reconcile failed for %s",
+                                         comp.name)
+                        states[comp.name] = None
+                        if (isinstance(exc, BreakerOpenError)
+                                and self.resilience is not None
+                                and not self.degraded):
+                            # the breaker opened mid-tick: every later
+                            # phase would trade on the same dead
+                            # apiserver — fail static NOW, not next tick
+                            # (remaining components, health, placement
+                            # and SLO all wait for the degraded loop)
+                            self._enter_degraded()
+                            for rest in self.components:
+                                states.setdefault(rest.name, None)
+                            return states
+                    except Exception:  # exc: allow — per-component isolation: one component's bug must not starve the others (next tick retries idempotently)
                         logger.exception("upgrade reconcile failed for %s",
                                          comp.name)
                         states[comp.name] = None
@@ -255,7 +271,7 @@ class TPUOperator:
                 with self._span("health-tick"):
                     try:
                         self.last_health = self.health_monitor.tick()
-                    except Exception:
+                    except Exception:  # exc: allow — health-tick isolation: the monitor classifies ApiError itself (masked report); a probe bug must not stop upgrades or placement
                         logger.exception("health tick failed; upgrades and "
                                          "placement continue")
                 self._emit_verdict_change_events()
@@ -269,7 +285,15 @@ class TPUOperator:
                     # per-component try/except above)
                     try:
                         placement = self.scheduler.place(wl)
-                    except Exception:
+                    except ApiError:
+                        # classified: pod create/delete failed against
+                        # the apiserver — keep the workload pending and
+                        # let the breaker see the failure shape
+                        logger.exception("placement of workload %s failed; "
+                                         "keeping it pending", wl.name)
+                        still_pending.append(wl)
+                        continue
+                    except Exception:  # exc: allow — per-workload isolation: a scheduler bug on one workload must not starve upgrades or the other workloads
                         logger.exception("placement of workload %s failed; "
                                          "keeping it pending", wl.name)
                         still_pending.append(wl)
@@ -291,7 +315,7 @@ class TPUOperator:
             with self._span("slo-tick"):
                 try:
                     self._slo_tick(states)
-                except Exception:
+                except Exception:  # exc: allow — SLO evaluation is observability; it must never affect the reconcile result
                     logger.exception("SLO tick failed; reconcile result "
                                      "unaffected")
         return states
@@ -404,7 +428,7 @@ class TPUOperator:
             with self._span("slo-tick"):
                 try:
                     self._slo_tick({})
-                except Exception:
+                except Exception:  # exc: allow — SLO evaluation is observability, also while degraded
                     logger.exception("SLO tick failed during degraded "
                                      "mode")
         return False
@@ -426,7 +450,7 @@ class TPUOperator:
         safety = self.resilience.safety()
         try:
             nodes = self.client.list_nodes()
-        except Exception:
+        except (ApiError, TimeoutError):
             return  # even the stale cache is unavailable; nothing to do
         attempts = 0
         for node in nodes:
@@ -440,7 +464,7 @@ class TPUOperator:
                 attempts += 1
                 try:
                     safety.patch_node_unschedulable(name, False)
-                except Exception:
+                except (ApiError, TimeoutError):
                     logger.debug("degraded safety uncordon of %s failed; "
                                  "retrying next tick", name)
             if QUARANTINE_LIFT_ANNOTATION in annos \
@@ -464,7 +488,7 @@ class TPUOperator:
                             QUARANTINE_LIFT_ANNOTATION: None,
                             REPAIR_ANNOTATION: None,
                         })
-                except Exception:
+                except (ApiError, TimeoutError):
                     logger.debug("degraded safety lift of %s failed; "
                                  "retrying next tick", name)
         if attempts and self.metrics is not None:
@@ -543,7 +567,7 @@ class TPUOperator:
             try:
                 self.last_stuck[comp.name] = \
                     self.stuck_detectors[comp.name].check(nodes)
-            except Exception:
+            except Exception:  # exc: allow — stuck detection is observability; a detector bug must not stop the tick
                 logger.exception("stuck detection failed for %s", comp.name)
 
     def _emit_verdict_change_events(self) -> None:
@@ -563,7 +587,7 @@ class TPUOperator:
                 escalated = HealthVerdict.worst([prev, verdict]) == verdict
                 try:
                     node = self.client.direct().get_node(name)
-                except Exception:
+                except (ApiError, TimeoutError):
                     continue  # node gone mid-tick; next tick re-evaluates
                 log_event(self.recorder, node,
                           "Warning" if escalated else "Normal",

@@ -24,7 +24,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional
 
-from ..core.client import Client, ConflictError
+from ..core.client import ApiError, Client, ConflictError
 from ..core.objects import ObjectMeta, Pod
 from ..utils.clock import Clock, RealClock
 from ..wire import WORKLOAD_LABEL
@@ -260,11 +260,11 @@ class SliceScheduler:
                 try:
                     self._client.delete_pod(p.metadata.namespace,
                                             p.metadata.name)
-                except Exception:
+                except (ApiError, TimeoutError):
                     logger.warning("rollback: could not delete %s/%s",
                                    p.metadata.namespace, p.metadata.name)
             return None
-        except Exception:
+        except Exception:  # exc: allow — any failure mid-placement must roll back the partially created pods and report no placement
             logger.exception("placement of %s failed after %d/%d pods; "
                              "rolling back", workload.name, len(created),
                              len(pods))
@@ -272,7 +272,7 @@ class SliceScheduler:
                 try:
                     self._client.delete_pod(p.metadata.namespace,
                                             p.metadata.name)
-                except Exception:
+                except (ApiError, TimeoutError):
                     logger.warning("rollback: could not delete %s/%s",
                                    p.metadata.namespace, p.metadata.name)
             return None
@@ -349,7 +349,7 @@ class SliceScheduler:
             try:
                 self._client.delete_pod(p.metadata.namespace,
                                         p.metadata.name)
-            except Exception:
+            except (ApiError, TimeoutError):
                 logger.warning("cleanup: could not delete %s/%s",
                                p.metadata.namespace, p.metadata.name)
 

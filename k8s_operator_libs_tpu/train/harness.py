@@ -198,7 +198,7 @@ class CheckpointingTrainer:
         try:
             self._device_count = (int(mesh.devices.size) if mesh is not None
                                   else len(jax.devices()))
-        except Exception:
+        except Exception:  # exc: allow — device-count probing is environment-dependent; default to a single host
             self._device_count = 1
         self._resume_rng = None
         self._mngr = ocp.CheckpointManager(

@@ -108,7 +108,7 @@ class DrainManager:
         try:
             try:
                 helper.run_cordon_or_uncordon(name, True, node=node)
-            except Exception as exc:  # cordon failure → upgrade-failed (:112-118)
+            except Exception as exc:  # exc: allow — any cordon failure routes the node to upgrade-failed (:112-118)
                 logger.error("failed to cordon node %s: %s", name, exc)
                 self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
                 log_event(self._recorder, node, "Warning", self._keys.event_reason,
@@ -117,7 +117,7 @@ class DrainManager:
             t0 = self._clock.now()
             try:
                 helper.run_node_drain(name)
-            except Exception as exc:  # drain failure → upgrade-failed (:122-128)
+            except Exception as exc:  # exc: allow — any drain failure routes the node to upgrade-failed (:122-128)
                 logger.error("failed to drain node %s: %s", name, exc)
                 self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
                 log_event(self._recorder, node, "Warning", self._keys.event_reason,

@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from ..core.client import Client, EventRecorder
+from ..core.client import ApiError, Client, EventRecorder
 from ..core.objects import Node
 from ..obs.journey import JourneyRecorder
 from ..utils.clock import Clock, RealClock
@@ -143,7 +143,7 @@ class NodeUpgradeStateProvider:
                 cached = None
                 try:
                     cached = self._client.get_node(node.metadata.name)
-                except Exception:
+                except (ApiError, TimeoutError):
                     pass
                 if cached is not None and self._values_current(
                         cached, labels, label_value, annos):
@@ -252,7 +252,7 @@ class NodeUpgradeStateProvider:
             if pump is not None:
                 try:
                     pump(kinds=("Node",))
-                except Exception:
+                except Exception:  # exc: allow — a failing barrier pump degrades to polling the (possibly stale) cache
                     logger.debug("barrier pump failed; polling stale cache")
             for name in list(pending):
                 try:

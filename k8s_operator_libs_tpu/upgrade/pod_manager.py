@@ -188,7 +188,7 @@ class PodManager:
                 return
             try:
                 helper.delete_or_evict_pods(deletable)
-            except Exception as exc:
+            except Exception as exc:  # exc: allow — any eviction failure routes the node to drain-failed handling
                 logger.error("failed to delete pods on node %s: %s", name, exc)
                 log_event(self._recorder, node, "Warning", self._keys.event_reason,
                           f"Failed to delete workload pods on the node for the "

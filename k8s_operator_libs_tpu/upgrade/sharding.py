@@ -131,7 +131,7 @@ class ShardRunner:
         def _one(i: int, shard: List) -> None:
             try:
                 results[i] = work_fn(shard)
-            except BaseException as exc:  # re-raised below, never dropped
+            except BaseException as exc:  # exc: allow — collected and re-raised after the join; a shard worker must never die silently
                 errors.append((i, exc))
 
         if self.parallel:

@@ -19,9 +19,11 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 import lint  # noqa: E402  (the tools/lint package; shadows the shim)
-from lint import (chaos_check, crash_check, determinism, jax_hygiene, layering,  # noqa: E402
-                  lock_discipline, lock_order, obs_check, state_machine,
-                  sync_check, thread_discipline, wire_check)
+from lint import (chaos_check, crash_check, dataflow, determinism,  # noqa: E402
+                  exc_contracts, exc_kill, exc_swallow, jax_hygiene, layering,
+                  lock_discipline, lock_order, obs_check, stale_taint,
+                  state_machine, sync_check, thread_discipline, wire_check)
+from lint.index import as_index  # noqa: E402
 from lint.registry import REGISTRY  # noqa: E402
 
 
@@ -43,18 +45,21 @@ def test_registry_has_all_passes():
             "determinism", "state-machine", "obs-journey",
             "obs-attribution", "obs-slo", "chaos-closure",
             "crash-closure", "wire-closure",
-            "sync-hygiene", "thread-discipline", "import-layering"} <= names
+            "sync-hygiene", "thread-discipline", "import-layering",
+            "exc-contracts", "exc-swallow", "exc-kill",
+            "stale-taint"} <= names
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
             "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
             "OBS002", "OBS003", "CHS001", "CRS001", "WIRE001", "SYN001",
-            "THR001", "GRD001", "ARC001"} <= set(all_codes)
+            "THR001", "GRD001", "ARC001", "EXC001", "EXC002", "EXC003",
+            "STL001"} <= set(all_codes)
     # codes are globally unique across checks
     per_check = [set(c.codes) for c in REGISTRY]
     assert sum(map(len, per_check)) == len(set().union(*per_check))
 
 
-@pytest.mark.parametrize("mod", [jax_hygiene, lock_discipline])
+@pytest.mark.parametrize("mod", [jax_hygiene, lock_discipline, exc_swallow])
 def test_every_file_check_ships_fixture_pairs(mod):
     """The plugin contract: one firing offender and one silent clean
     fixture per code, carried by the check module itself."""
@@ -1722,3 +1727,354 @@ def test_crs001_missing_process_entry_fails(tmp_path):
     findings = crash_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "health-verdict" in msgs and "SITE_PROCESS" in msgs
+
+
+# --------------------------------------- EXC002 (package-shaped fixtures)
+
+MONITOR_REL = "k8s_operator_libs_tpu/health/monitor.py"
+
+
+def test_exc002_offender_fires_twice(tmp_path):
+    """Both offender shapes: a broad catch with no hatch, and a hatch
+    with no reason."""
+    found = run_lint_pkg(tmp_path, exc_swallow.OFFENDERS["EXC002"],
+                         "off_exc002.py")
+    assert codes(found) == ["EXC002", "EXC002"], found
+    msgs = " | ".join(found)
+    assert "narrow to concrete types" in msgs
+    assert "hatch without a reason" in msgs
+
+
+def test_exc002_clean_stays_silent(tmp_path):
+    found = run_lint_pkg(tmp_path, exc_swallow.CLEAN["EXC002"],
+                         "clean_exc002.py")
+    assert found == [], found
+
+
+def test_exc002_out_of_scope_path_is_silent(tmp_path):
+    """The same offender outside the package/cmd trees (e.g. a tools/
+    script) is not EXC002's business."""
+    found = run_lint(tmp_path, exc_swallow.OFFENDERS["EXC002"],
+                     name="off_elsewhere.py")
+    assert "EXC002" not in codes(found), found
+
+
+def test_exc002_alternate_dash_spellings_accepted(tmp_path):
+    src = (
+        "def tick(mgr):\n"
+        "    try:\n"
+        "        mgr.apply_state()\n"
+        "    except Exception:  # exc: allow -- double-dash reason\n"
+        "        pass\n"
+        "    try:\n"
+        "        mgr.flush()\n"
+        "    except Exception:  # exc: allow - single-dash reason\n"
+        "        pass\n"
+    )
+    found = run_lint_pkg(tmp_path, src, "dashes.py")
+    assert found == [], found
+
+
+def test_exc002_real_package_is_triaged():
+    """Satellite: the whole package + cmd trees carry ZERO unjustified
+    broad catches — every survivor re-raises or carries a reasoned
+    hatch. New broad catches must justify themselves at review time."""
+    index = as_index(REPO)
+    findings = []
+    for rel in index.files_under("k8s_operator_libs_tpu") \
+            + index.files_under("cmd"):
+        findings.extend(lint.lint_file(REPO / rel))
+    offenders = [f for f in findings if "EXC002" in f]
+    assert offenders == [], offenders[:10]
+
+
+# ----------------------------- dataflow engine scratch roots (EXC/STL)
+
+# client.py rides along for the ApiError-family class hierarchy
+# (is_subclass) — without it `except ApiError:` could not classify a
+# ServerError escape
+DFE_FILES = [MONITOR_REL, "k8s_operator_libs_tpu/core/client.py"]
+KILL_FILES = DFE_FILES + [crash_check.REGISTRY_PATH, crash_check.WIRE_PATH]
+
+
+def _dfe_root(tmp_path, mutate=None, files=DFE_FILES):
+    root = tmp_path / "repo_dfe"
+    for rel in files:
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+# ------------------------------------------------ EXC001 (scratch roots)
+
+def test_exc001_real_repo_passes():
+    assert exc_contracts.run_project(REPO) == []
+
+
+def test_exc001_monitor_root_passes(tmp_path):
+    """The real monitor classifies ApiError at the tick boundary."""
+    assert exc_contracts.run_project(_dfe_root(tmp_path)) == []
+
+
+def test_exc001_family_raise_in_helper_fires_with_chain(tmp_path):
+    """Inject a classified raise into a helper tick calls OUTSIDE the
+    classified try: it escapes the tick boundary unclassified, and the
+    finding renders the interprocedural chain."""
+    root = _dfe_root(tmp_path, mutate={
+        MONITOR_REL: lambda s: s.replace(
+            "current = node.metadata.labels.get(consts.VERDICT_LABEL)",
+            'raise ServerError("injected: verdict sync is down")')})
+    findings = exc_contracts.run_project(root)
+    assert findings and all(c == "EXC001" for (_, _, c, _) in findings)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "ServerError" in msgs
+    assert "FleetHealthMonitor.tick -> " \
+           "FleetHealthMonitor._sync_verdict_labels" in msgs
+    assert "except ApiError" in msgs  # the prescribed fix
+    assert all(p == MONITOR_REL for (p, _, _, _) in findings)
+
+
+def test_exc001_renamed_root_is_config_drift(tmp_path):
+    root = _dfe_root(tmp_path, mutate={
+        MONITOR_REL: lambda s: s.replace("def tick(", "def tick_renamed(")})
+    findings = exc_contracts.run_project(root)
+    assert [(p, ln, c) for (p, ln, c, _) in findings] \
+        == [(MONITOR_REL, 1, "EXC001")]
+    assert "not found" in findings[0][3]
+
+
+# ------------------------------------------------ EXC003 (scratch roots)
+
+def test_exc003_real_repo_passes():
+    assert exc_kill.run_project(REPO) == []
+
+
+def test_exc003_broad_catch_over_durable_write_fires(tmp_path):
+    """BaseException around the verdict patch would absorb the crash
+    explorer's kill — the finding names the voided site."""
+    root = _dfe_root(tmp_path, files=KILL_FILES, mutate={
+        MONITOR_REL: lambda s: s.replace("except (ApiError, TimeoutError):",
+                                         "except BaseException:")})
+    findings = exc_kill.run_project(root)
+    assert findings and all(c == "EXC003" for (_, _, c, _) in findings)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "health-verdict" in msgs and "OperatorKilled" in msgs
+
+
+def test_exc003_reraise_passes(tmp_path):
+    """A broad catch that re-raises is transparent to the kill."""
+    root = _dfe_root(tmp_path, files=KILL_FILES, mutate={
+        MONITOR_REL: lambda s: s.replace(
+            "except (ApiError, TimeoutError):",
+            "except BaseException:\n                raise\n"
+            "            except (ApiError, TimeoutError):")})
+    assert exc_kill.run_project(root) == []
+
+
+def test_exc003_operator_killed_catch_site_exempt(tmp_path):
+    """Naming OperatorKilled marks a designated campaign catch site."""
+    root = _dfe_root(tmp_path, files=KILL_FILES, mutate={
+        MONITOR_REL: lambda s: s.replace(
+            "except (ApiError, TimeoutError):",
+            "except (OperatorKilled, BaseException):")})
+    assert exc_kill.run_project(root) == []
+
+
+def test_exc003_hatch_suppresses(tmp_path):
+    root = _dfe_root(tmp_path, files=KILL_FILES, mutate={
+        MONITOR_REL: lambda s: s.replace(
+            "except (ApiError, TimeoutError):",
+            "except BaseException:  "
+            "# exc: allow — deliberate last-ditch isolation")})
+    assert exc_kill.run_project(root) == []
+
+
+def test_exc003_repo_without_crash_explorer_is_silent(tmp_path):
+    """No registry/wire in the checkout: nothing to void."""
+    root = _dfe_root(tmp_path, mutate={
+        MONITOR_REL: lambda s: s.replace("except (ApiError, TimeoutError):",
+                                         "except BaseException:")})
+    assert exc_kill.run_project(root) == []
+
+
+# ------------------------------------------------ STL001 (scratch roots)
+
+def test_stl001_real_repo_passes():
+    assert stale_taint.run_project(REPO) == []
+
+
+def test_stl001_monitor_root_passes(tmp_path):
+    assert stale_taint.run_project(_dfe_root(tmp_path)) == []
+
+
+def test_stl001_dropped_pump_fires(tmp_path):
+    """Delete the tick-start pump: the store reads feeding the verdict
+    patch are no longer freshness-barriered."""
+    root = _dfe_root(tmp_path, mutate={
+        MONITOR_REL: lambda s: s.replace(
+            'pump(kinds=("Node", "Pod"))', "pass")})
+    findings = stale_taint.run_project(root)
+    assert findings and all(c == "STL001" for (_, _, c, _) in findings)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "patch_node_metadata" in msgs
+    assert "freshness barrier" in msgs
+
+
+def test_stl001_renamed_root_is_config_drift(tmp_path):
+    root = _dfe_root(tmp_path, mutate={
+        MONITOR_REL: lambda s: s.replace("def tick(", "def tick_renamed(")})
+    findings = stale_taint.run_project(root)
+    assert [(p, ln, c) for (p, ln, c, _) in findings] \
+        == [(MONITOR_REL, 1, "STL001")]
+
+
+# ------------------------------------------------- DataflowEngine units
+
+def _mini_root(tmp_path, source):
+    root = tmp_path / "repo_mini"
+    f = root / "k8s_operator_libs_tpu" / "mini.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(source)
+    return root
+
+
+def test_engine_scc_fixpoint_mutual_recursion(tmp_path):
+    """a <-> b form one SCC; the bounded fixpoint propagates the raise
+    to BOTH and terminates."""
+    root = _mini_root(tmp_path, '''
+def a(n):
+    if n:
+        return b(n - 1)
+    raise ValueError("boom")
+
+
+def b(n):
+    return a(n)
+''')
+    engine = dataflow.get_engine(as_index(root))
+    rel = "k8s_operator_libs_tpu/mini.py"
+    assert "ValueError" in engine.summaries[(rel, "a")].raises
+    # propagated across the cycle: b's witness is the call into a
+    b_wit = engine.summaries[(rel, "b")].raises["ValueError"]
+    assert b_wit[0] == "call" and b_wit[1] == (rel, "a")
+
+
+def test_engine_client_alias_awareness(tmp_path):
+    """`view = self._client` is an informer-store alias; the value of
+    `self._client.direct()` is NOT (the uncached view cannot be
+    stale)."""
+    root = _mini_root(tmp_path, '''
+class M:
+    def helper(self):
+        view = self._client
+        cached = view.list_nodes()
+        fresh = self._client.direct()
+        uncached = fresh.list_nodes()
+        return cached, uncached
+''')
+    engine = dataflow.get_engine(as_index(root))
+    summary = engine.summaries[("k8s_operator_libs_tpu/mini.py",
+                                "M.helper")]
+    read_methods = [m for (_, m) in summary.reads]
+    assert read_methods == ["list_nodes"], summary.reads
+    # the RPC model: a client call may raise ServerError
+    assert "ServerError" in summary.raises
+
+
+def test_engine_cached_once_per_index(tmp_path):
+    """get_engine builds once per ProjectIndex — the seam every pass
+    shares. DataflowEngine.builds is the spy."""
+    index = as_index(_mini_root(tmp_path, "def f():\n    pass\n"))
+    before = dataflow.DataflowEngine.builds
+    e1 = dataflow.get_engine(index)
+    e2 = dataflow.get_engine(index)
+    assert e1 is e2
+    assert dataflow.DataflowEngine.builds == before + 1
+
+
+def test_engine_chain_renders_propagation_path(tmp_path):
+    root = _mini_root(tmp_path, '''
+def outer(x):
+    return inner(x)
+
+
+def inner(x):
+    raise RuntimeError("x")
+''')
+    engine = dataflow.get_engine(as_index(root))
+    rel = "k8s_operator_libs_tpu/mini.py"
+    chain = engine.chain((rel, "outer"), "RuntimeError", lattice="raises")
+    assert "outer" in chain and "inner" in chain
+    assert "RuntimeError" in chain
+
+
+def test_engine_classified_handler_subtracts_only_named_family(tmp_path):
+    """The dual-lattice contract: only an arm explicitly naming a
+    CLASSIFIED family type subtracts the escape from `unclassified` —
+    a blanket `except Exception` is a runtime catch, never a
+    classification (name-based: without the client.py hierarchy it
+    subtracts nothing from `raises` either)."""
+    root = _mini_root(tmp_path, '''
+def blanket(client):
+    try:
+        client.list_nodes()
+    except Exception:
+        pass
+
+
+def named(client):
+    try:
+        client.list_nodes()
+    except ServerError:
+        pass
+''')
+    engine = dataflow.get_engine(as_index(root))
+    rel = "k8s_operator_libs_tpu/mini.py"
+    blanket = engine.summaries[(rel, "blanket")]
+    assert "ServerError" in blanket.unclassified
+    named = engine.summaries[(rel, "named")]
+    assert "ServerError" not in named.raises
+    assert "ServerError" not in named.unclassified
+
+
+# --------------------------------------------------- --explain coverage
+
+def test_every_registered_code_has_explain_entry():
+    """Satellite contract: registering a code without a
+    docs/static-analysis.md section is a test failure."""
+    missing = [c for c in lint.all_codes() if not lint.explain(c)]
+    assert missing == [], missing
+
+
+def test_explain_cli_prints_docs_section():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--explain", "EXC001"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "exception-contract closure" in proc.stdout
+    assert "exc_contracts.py" in proc.stdout
+
+
+def test_explain_cli_unknown_code_exits_2():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--explain", "NOPE999"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+# -------------------------------------------------- runtime budget gate
+
+def test_full_suite_inside_smoke_budget():
+    """The interprocedural engine must not blow the make lint-smoke
+    budget (LINT_BUDGET=60s): the FULL suite — generic + domain, engine
+    build included — stays comfortably inside it in-process."""
+    import time
+    t0 = time.monotonic()
+    findings, index = lint.run_suite(mode="all")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60, f"full suite took {elapsed:.1f}s"
+    assert findings == [], findings[:5]

@@ -28,6 +28,7 @@ import urllib.request
 
 import pytest
 
+from k8s_operator_libs_tpu.core.client import ServerError
 from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
 from k8s_operator_libs_tpu.market import (MARKET_GAUGE_FAMILIES,
                                           PREEMPTING, SERVING, TRAINING,
@@ -405,7 +406,7 @@ class _FlakyClient:
             def call(*a, **kw):
                 if self.fail_patches > 0:
                     self.fail_patches -= 1
-                    raise RuntimeError("injected patch failure")
+                    raise ServerError("injected patch failure")
                 return attr(*a, **kw)
             return call
         return attr

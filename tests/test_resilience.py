@@ -845,3 +845,78 @@ def test_status_resilience_view_and_banner(capsys):
     assert degraded_banner("http://x", fetch=broken) is None
     args = types.SimpleNamespace(operator_url="http://x", as_json=False)
     assert run_resilience_view(args, fetch=broken) == 2
+
+
+def test_breaker_open_mid_tick_fails_static_same_tick(cluster, clock):
+    """PR pin: the breaker opening MID-tick must not let the rest of the
+    tick trade against a dead apiserver. Two components, threshold 1:
+    the first component's apply eats the ServerError that opens the
+    breaker; the second's apply sheds with BreakerOpenError — the
+    operator enters DEGRADED immediately, skips every remaining phase,
+    and returns all-None states in the SAME tick (not the next one)."""
+    _upgrade_fleet(cluster)
+    vfio_labels = {"app": "vfio"}
+    vds = cluster.add_daemonset("vfio", namespace=NS,
+                                labels=dict(vfio_labels),
+                                revision_hash="v1")
+    for i in range(4):
+        cluster.add_pod(f"vfio-h{i}", f"h{i}", namespace=NS, owner_ds=vds,
+                        revision_hash="v1")
+
+    class _WriteOutage:
+        """Reads and watches stay up; every write 5xxes — the shape of a
+        blackout caught mid-tick between the pump and the first patch."""
+
+        def __init__(self, inner, state):
+            self._inner = inner
+            self._state = state
+
+        def direct(self):
+            return _WriteOutage(self._inner.direct(), self._state)
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if not callable(attr):
+                return attr
+
+            def call(*args, **kwargs):
+                if self._state["down"] and not name.startswith(
+                        ("list_", "watch_", "get_", "create_event")):
+                    raise ServerError(f"write outage on {name}")
+                return attr(*args, **kwargs)
+
+            return call
+
+    state = {"down": False}
+    gated = _WriteOutage(cluster.client, state)
+    res = ResilientClient(gated, clock=clock, retries=0,
+                          failure_threshold=1, open_seconds=600.0)
+    cached = CachedClient(res, namespaces=[NS], pumped=True,
+                          clock=clock).start()
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True, max_unavailable="50%",
+        drain=DrainSpec(enable=True, force=True, timeout_second=60))
+    operator = TPUOperator(
+        cached,
+        components=[
+            ManagedComponent(name="libtpu", namespace=NS,
+                             driver_labels=dict(LABELS), policy=policy),
+            ManagedComponent(name="vfio", namespace=NS,
+                             driver_labels=dict(vfio_labels),
+                             policy=policy)],
+        recorder=cluster.recorder, clock=clock, synchronous=True,
+        resilience=res)
+    clock.advance(15.0)
+    states = operator.reconcile()
+    assert not operator.degraded and None not in states.values()
+    # give BOTH components cordon work, then cut the apiserver: libtpu's
+    # apply eats the ServerError that opens the breaker, vfio's sheds
+    cluster.bump_daemonset_revision("libtpu", NS, "v2")
+    cluster.bump_daemonset_revision("vfio", NS, "v2")
+    state["down"] = True
+    clock.advance(15.0)
+    states = operator.reconcile()
+    assert operator.degraded, \
+        "breaker opened mid-tick but the tick did not fail static"
+    assert states == {"libtpu": None, "vfio": None}
+    assert len(_events(cluster, "OperatorDegraded")) == 1
