@@ -12,10 +12,10 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-obs-profile test-delta test-chaos test-router \
-  test-migration test-market test-race test-resilience \
+  test-obs-slo test-obs-profile test-obs-request test-delta test-chaos \
+  test-router test-migration test-market test-race test-resilience \
   health-sim chaos chaos-market-smoke crash crash-smoke race race-smoke \
-  fleetbench fleetbench-smoke lint \
+  fleetbench fleetbench-smoke servebench servebench-smoke lint \
   lint-domain lint-smoke cov-report cov-artifact bench bench-decode \
   dryrun apply-crds-dry clean $(DOCKER_TARGETS) .build-image
 
@@ -48,6 +48,9 @@ test-obs-slo:  ## SLO engine: tsdb, error budgets, burn-rate alerting, dashboard
 test-obs-profile:  ## tick flight recorder: CountingClient accounting, profile decomposition + critical path, journey size guard, profiler-invariance under chaos (docs/observability.md "Tick profiling & apiserver accounting")
 	$(PYTHON) -m pytest tests/test_obs_profile.py -q
 
+test-obs-request:  ## request flight recorder: trace-context wire format, stage state machine + partition law, recorder memory bounds, router transparency pins (tracing on == off), request-trace-integrity invariant, chaos campaign timelines (docs/observability.md "Request tracing & servebench")
+	$(PYTHON) -m pytest tests/test_reqtrace.py -q
+
 FLEET_NODES ?= 10000
 FLEET_SLICES ?= 1000
 FLEET_TICKS ?= 12
@@ -63,6 +66,20 @@ fleetbench-smoke:  ## budgeted CI gate (like lint-smoke): the same harness at ~5
 	  --nodes 500 --slices 50 --ticks 6 --warmup 2 \
 	  --verify-incremental --budget tools/fleetbench_budget.json \
 	  --out /tmp/fleet_smoke.json
+
+SERVE_RPS ?= 16
+SERVE_LANES ?= interactive,batch,best-effort
+SERVE_SEED ?= 0
+servebench:  ## serving-plane benchmark: seeded open-loop Poisson lanes through the REAL RequestRouter over sim replicas, swept to the knee where TTFT p99 crosses the serving-ttft-p99 SLO; writes SERVE_r01.json (router_rps_at_slo + proxy_overhead_p99_ms + per-stage decomposition, which must partition measured latency) and asserts the checked-in budget (docs/observability.md "Request tracing & servebench")
+	$(PYTHON) tools/servebench.py --rps-max $(SERVE_RPS) \
+	  --lanes $(SERVE_LANES) --seed $(SERVE_SEED) \
+	  --budget tools/servebench_budget.json
+
+SERVE_SMOKE_BUDGET ?= 120
+servebench-smoke:  ## budgeted CI gate (like fleetbench-smoke): the same harness on a small tier must finish inside SERVE_SMOKE_BUDGET seconds with every assertion holding — timelines valid + partitioning latency, knee bracketed, and the servebench budget (proxy-overhead ceiling, unbudgeted stages fail)
+	timeout $(SERVE_SMOKE_BUDGET) $(PYTHON) tools/servebench.py --smoke \
+	  --seed $(SERVE_SEED) --budget tools/servebench_budget.json \
+	  --out /tmp/serve_smoke.json
 
 test-delta:  ## PR 14 delta-driven reconcile: dirty-set drain vs snapshot equivalence under randomized mutations (incl. watch-lag + re-list gap), incremental BuildState oracle, no-op patch dedupe call-count pins, shard runner / budget accountant, parallel-vs-serial rollout equivalence, quiet-tick near-zero-calls pin, cached+sharded chaos seed
 	$(PYTHON) -m pytest tests/test_deltacache.py -q

@@ -42,6 +42,15 @@ HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
   the admitting-replica count — the demand signal the operator's
   capacity arbiter polls (docs/capacity-market.md).
 - ``GET  /metrics``   → ``tpu_router_*`` families (docs/observability.md).
+- ``GET  /requests``  → the request flight recorder's summary: open/
+  closed/dropped counts, splice/fallback totals, the last few closed
+  stage timelines, and per-stage dwell totals
+  (docs/observability.md "Request tracing & servebench").
+- ``GET  /trace?rid=N`` → one request's full stage timeline (open or
+  closed), with per-stage durations and the trace context it carries
+  across hops. Requests inherit an ``X-TPU-Trace`` header (or a
+  ``"trace"`` field in the POST body) when the caller supplies one;
+  a garbled header degrades to a fresh root trace, never an error.
 - ``GET  /healthz``   → 200 while at least one replica admits, else 503.
 
 The queue-depth half of the autoscaler runs in-process (scale decisions
@@ -55,13 +64,17 @@ import argparse
 import json
 import logging
 import sys
+import time as _time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.core.client import ApiError  # noqa: E402
+from k8s_operator_libs_tpu.obs.reqtrace import (  # noqa: E402
+    TRACE_HEADER, parse_trace_header)
 from k8s_operator_libs_tpu.utils import threads  # noqa: E402
 
 logger = logging.getLogger("tpu-router")
@@ -163,7 +176,10 @@ class RouterFront:
                          "best-effort": 0.5}
 
     def __init__(self, pool, metrics=None, clock=None, queue_high=8.0,
-                 proxy_timeout=300.0, post_json=None, open_sse=None):
+                 proxy_timeout=300.0, post_json=None, open_sse=None,
+                 selfclock=None):
+        from k8s_operator_libs_tpu.obs.reqtrace import (
+            RequestTraceRecorder)
         from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
         from k8s_operator_libs_tpu.utils.clock import RealClock
         self.pool = pool
@@ -191,6 +207,20 @@ class RouterFront:
         self._migration_attempts = 0
         self._migration_fallbacks = 0
         self.drains = []
+        # request flight recorder (obs/reqtrace.py): per-request stage
+        # timelines + the tpu_router_proxy_overhead_seconds headline
+        # (self-time measured on a real performance counter, separate
+        # from the injected stage clock so virtual-clock harnesses stay
+        # deterministic)
+        self.reqtrace = RequestTraceRecorder(
+            clock=self._clock, metrics=metrics,
+            selfclock=selfclock or _time.perf_counter)
+        self._rid_counter = 0
+
+    def _mint_rid(self):
+        with self.lock:
+            self._rid_counter += 1
+            return self._rid_counter
 
     # --------------------------------------------------------- placement
 
@@ -213,31 +243,43 @@ class RouterFront:
                 (self._outstanding.get(r.id, 0) + r.stats.queue_depth)
                 / r.weight))
 
-    def generate(self, tokens, max_new, session=None, lane="interactive"):
+    def generate(self, tokens, max_new, session=None, lane="interactive",
+                 trace=None, accept_s=0.0):
         """→ (http status, body dict). Retries distinct peers until one
         serves the request; a replica that refuses (503 = draining) or
         drops the connection is excluded and the next-best peer tried.
         ``lane`` prices overload: a sheddable lane that no replica has
         headroom for is DROPPED with a 429 ``{"shed": true}`` while
         interactive keeps the full backpressure budget — degradation by
-        policy, not by accident."""
+        policy, not by accident. ``trace`` is the client's propagated
+        context (None = fresh root); ``accept_s`` the handler's measured
+        parse/accept self-time."""
         if lane not in self._lanes:
             return 400, {"error": f"unknown lane {lane!r} "
                                   f"(known: {', '.join(self._lanes)})"}
+        rid = self._mint_rid()
+        ctx = self.reqtrace.begin(rid, lane=lane, parent=trace)
+        if accept_s:
+            self.reqtrace.overhead(rid, accept_s, phase="accept")
+        self.reqtrace.stage(rid, "queued")
         prefix_key = tuple(tokens[:self._prefix_tokens])
         tried = set()
         while True:
-            replica = self._pick(session, prefix_key, tried, lane=lane)
+            with self.reqtrace.timer(rid, "route"):
+                replica = self._pick(session, prefix_key, tried,
+                                     lane=lane)
             if replica is None:
                 if lane != "interactive" and self.pool.admitting():
                     # capacity exists but not at this lane's admit
                     # factor: shed rather than queue behind interactive
                     with self.lock:
                         self._lane_shed[lane] += 1
+                    self.reqtrace.stage(rid, "shed")
                     return 429, {"shed": True, "lane": lane,
                                  "error": "overload: lane shed; retry "
                                           "with backoff"}
                 return 503, {"error": "no admitting replica; retry later"}
+            self.reqtrace.stage(rid, "assigned")
             tried.add(replica.id)
             with self.lock:
                 self._outstanding[replica.id] = \
@@ -248,14 +290,17 @@ class RouterFront:
             with self.lock:
                 self._lane_outstanding[lane] += 1
             try:
+                self.reqtrace.stage(rid, "prefill")
                 out = self._post_json(
                     replica.url.rstrip("/") + "/generate",
-                    {"tokens": tokens, "max_new": max_new},
+                    {"tokens": tokens, "max_new": max_new,
+                     "trace": ctx.encode()},
                     self.proxy_timeout)
                 with self.lock:
                     self._routed += 1
                     self._completed += 1
                     self._lane_completed[lane] += 1
+                self.reqtrace.stage(rid, "completed")
                 return 200, out
             except urllib.error.HTTPError as exc:
                 payload = _safe_json(exc)
@@ -264,6 +309,7 @@ class RouterFront:
                     with self.lock:
                         self._rerouted += 1
                     replica.stats.draining = True
+                    self.reqtrace.stage(rid, "queued")
                     continue
                 return exc.code, payload
             except Exception as exc:  # exc: allow — connection refused/reset of any shape means the replica is gone — reroute
@@ -275,6 +321,7 @@ class RouterFront:
                 replica.failed = True
                 with self.lock:
                     self._rerouted += 1
+                self.reqtrace.stage(rid, "queued")
                 continue
             finally:
                 with self.lock:
@@ -301,7 +348,7 @@ class RouterFront:
     # ------------------------------------------------- streaming + splice
 
     def generate_stream(self, tokens, max_new, session=None, emit=None,
-                        lane="interactive"):
+                        lane="interactive", trace=None, accept_s=0.0):
         """Relay a streamed generation with GLOBAL per-token sequence
         numbers; ``emit(event)`` writes one SSE event to the client.
         The relay makes upgrades invisible mid-stream: a replica's
@@ -312,18 +359,26 @@ class RouterFront:
         replayed tokens by sequence number (greedy decode is
         deterministic, so the replay matches what the client already
         saw). Returns the terminal HTTP status (200 after ``done``)."""
+        frid = self._mint_rid()     # the front's request id (/trace?rid=)
+        ctx = self.reqtrace.begin(frid, lane=lane, parent=trace)
+        if accept_s:
+            self.reqtrace.overhead(frid, accept_s, phase="accept")
+        self.reqtrace.stage(frid, "queued")
+        emit({"rid": frid, "trace": ctx.encode()})
         prefix_key = tuple(tokens[:self._prefix_tokens])
         expected = 0                # next seq the client needs
         tried = set()
         source = None               # (replica, local rid) to reattach
         while True:
             if source is None:
-                replica = self._pick(session, prefix_key, tried,
-                                     lane=lane)
+                with self.reqtrace.timer(frid, "route"):
+                    replica = self._pick(session, prefix_key, tried,
+                                         lane=lane)
                 if replica is None:
                     emit({"error": "no admitting replica; retry later"})
                     return 503
                 rid = None
+                self.reqtrace.stage(frid, "assigned")
             else:
                 replica, rid = source
                 source = None
@@ -337,10 +392,12 @@ class RouterFront:
             try:
                 base = replica.url.rstrip("/")
                 if rid is None:
+                    self.reqtrace.stage(frid, "prefill")
                     resp = self._open_sse(
                         base + "/generate",
                         {"tokens": tokens, "max_new": max_new,
-                         "stream": True}, self.proxy_timeout)
+                         "stream": True, "trace": ctx.encode()},
+                        self.proxy_timeout)
                 else:
                     resp = self._open_sse(base + f"/stream?rid={rid}",
                                           None, self.proxy_timeout)
@@ -351,16 +408,24 @@ class RouterFront:
                             # the client's stream is gapless and
                             # duplicate-free no matter how we got here
                             if event["seq"] >= expected:
-                                emit({"seq": expected,
-                                      "token": int(event["token"])})
-                                expected += 1
+                                with self.reqtrace.timer(frid, "relay"):
+                                    emit({"seq": expected,
+                                          "token": int(event["token"])})
+                                    expected += 1
+                                self.reqtrace.token_appended(frid)
+                            else:
+                                with self.reqtrace.timer(frid, "reseq"):
+                                    pass    # replayed token swallowed
                             continue
                         if "rid" in event:
                             rid = int(event["rid"])
                             continue
                         if event.get("draining") and rid is not None:
-                            spliced = self._splice(replica, rid,
-                                                   expected, emit)
+                            self.reqtrace.stage(frid, "drain")
+                            with self.reqtrace.timer(frid, "splice"):
+                                spliced = self._splice(replica, rid,
+                                                       expected, emit,
+                                                       frid=frid)
                             if spliced is not None:
                                 peer, new_rid, expected = spliced
                                 source = (peer, new_rid)
@@ -372,11 +437,13 @@ class RouterFront:
                             outcome = "fallback"
                             break
                         if event.get("done"):
-                            emit({"done": True,
-                                  "tokens": event["tokens"]})
+                            with self.reqtrace.timer(frid, "relay"):
+                                emit({"done": True,
+                                      "tokens": event["tokens"]})
                             with self.lock:
                                 self._routed += 1
                                 self._completed += 1
+                            self.reqtrace.stage(frid, "completed")
                             return 200
                         if "error" in event:
                             emit(event)
@@ -391,6 +458,7 @@ class RouterFront:
                         self._rerouted += 1
                     replica.stats.draining = True
                     tried.add(replica.id)
+                    self.reqtrace.stage(frid, "queued")
                     continue
                 emit(payload)
                 return exc.code
@@ -402,6 +470,7 @@ class RouterFront:
                 with self.lock:
                     self._rerouted += 1
                 tried.add(replica.id)
+                self.reqtrace.stage(frid, "queued")
                 continue
             finally:
                 with self.lock:
@@ -414,14 +483,16 @@ class RouterFront:
             with self.lock:
                 self._rerouted += 1
             tried.add(replica.id)
+            self.reqtrace.stage(frid, "queued")
 
-    def _splice(self, donor, rid, expected, emit):
+    def _splice(self, donor, rid, expected, emit, frid=None):
         """The live-migration hop: export the request's KV state from
         the draining donor, adopt it on the least-loaded peer, emit any
         catch-up tokens the donor decoded past the client's last acked
         seq, and hand back ``(peer, new rid, new expected)``. None on
         any failure — the caller's fallback re-submit takes over
-        (degraded: re-prefills from the prompt; never lost)."""
+        (degraded: re-prefills from the prompt; never lost). ``frid`` is
+        the front's request id for the flight recorder's stage edges."""
         base = donor.url.rstrip("/")
         try:
             with self.lock:
@@ -435,8 +506,11 @@ class RouterFront:
                            exc_info=True)
             with self.lock:
                 self._migration_fallbacks += 1
+            self.reqtrace.stage(frid, "fallback")
             return None
+        self.reqtrace.stage(frid, "export")
         tried = {donor.id}
+        self.reqtrace.stage(frid, "transfer")
         for _ in range(3):
             with self.lock:
                 peers = [r for r in self.pool.admitting()
@@ -456,6 +530,8 @@ class RouterFront:
                                peer.id, rid, exc_info=True)
                 continue
             generated = [int(t) for t in data["generated"]]
+            self.reqtrace.stage(frid, "adopt")
+            self.reqtrace.stage(frid, "splice")
             # catch-up: tokens the donor decoded after the last acked
             # seq ride the adoption response, not the dead stream
             for seq in range(expected, len(generated)):
@@ -467,6 +543,7 @@ class RouterFront:
             return peer, int(data["rid"]), max(expected, len(generated))
         with self.lock:
             self._migration_fallbacks += 1
+        self.reqtrace.stage(frid, "fallback")
         return None
 
     # ------------------------------------------------------- drain watch
@@ -603,6 +680,22 @@ def make_handler(front, pool, hub, autoscaler=None):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/requests":
+                self._json(200, {"kind": "requests",
+                                 "data": front.reqtrace.payload()})
+            elif self.path.startswith("/trace"):
+                query = urllib.parse.urlparse(self.path).query
+                params = urllib.parse.parse_qs(query)
+                try:
+                    rid = int(params["rid"][0])
+                except (KeyError, ValueError, IndexError):
+                    self._json(400, {"error": "want /trace?rid=N"})
+                    return
+                timeline = front.reqtrace.trace_payload(rid)
+                if timeline is None:
+                    self._json(404, {"error": f"no trace for rid {rid}"})
+                    return
+                self._json(200, {"kind": "trace", "data": timeline})
             else:
                 self._json(404, {"error": "not found"})
 
@@ -631,6 +724,7 @@ def make_handler(front, pool, hub, autoscaler=None):
             if self.path != "/generate":
                 self._json(404, {"error": "not found"})
                 return
+            t0 = _time.perf_counter()
             try:
                 tokens = [int(t) for t in req["tokens"]]
                 max_new = int(req.get("max_new", 32))
@@ -640,6 +734,11 @@ def make_handler(front, pool, hub, autoscaler=None):
             except (KeyError, TypeError, ValueError) as exc:
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
+            # A garbled or absent X-TPU-Trace degrades to a fresh root
+            # trace — never a 4xx/5xx (parse_trace_header → None).
+            trace = (parse_trace_header(self.headers.get(TRACE_HEADER))
+                     or parse_trace_header(req.get("trace")))
+            accept_s = _time.perf_counter() - t0
             if stream:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
@@ -655,12 +754,14 @@ def make_handler(front, pool, hub, autoscaler=None):
                 try:
                     front.generate_stream(tokens, max_new,
                                           session=session, emit=emit,
-                                          lane=lane)
+                                          lane=lane, trace=trace,
+                                          accept_s=accept_s)
                 except (BrokenPipeError, ConnectionResetError):
                     pass    # client went away; nothing left to relay to
                 return
             code, body = front.generate(tokens, max_new, session=session,
-                                        lane=lane)
+                                        lane=lane, trace=trace,
+                                        accept_s=accept_s)
             self._json(code, body)
 
     return Handler

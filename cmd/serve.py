@@ -42,6 +42,15 @@ HTTP surface (stdlib ThreadingHTTPServer, JSON):
   queue-wait / occupancy / KV-utilization histograms —
   docs/observability.md's workload-telemetry catalog). ``--trace-log``
   additionally appends one ``serve-step`` span per batcher step.
+- ``GET  /requests``  → the request flight recorder's ring + aggregate
+  (obs/reqtrace.py; docs/observability.md "Request tracing &
+  servebench"). Every request carries an ``X-TPU-Trace`` context (also
+  the ``"trace"`` payload field on /generate and /adopt) so one trace
+  id spans router → replica → migration peer; a garbled header degrades
+  to a fresh root trace, never an error.
+- ``GET  /trace?rid=N`` → one request's stage timeline (400 without a
+  parseable rid, 404 for an unknown one) — rendered by
+  ``cmd/status.py --request N`` against a router or replica URL.
 
 One background stepper thread owns the batcher (submit/poll are guarded
 by a lock — the batcher itself is deliberately single-threaded);
@@ -97,10 +106,25 @@ class ServingRuntime:
 
     def __init__(self, params, cfg, max_slots, capacity, block_size,
                  chunk, shared_prefix=None, hub=None, tracer=None,
-                 draft=None, spec_k=4):
+                 draft=None, spec_k=4, clock=None, drain_hold_s=2.0):
         from k8s_operator_libs_tpu.models.serve import ContinuousBatcher
         from k8s_operator_libs_tpu.obs import MetricsHub
+        from k8s_operator_libs_tpu.obs.reqtrace import (
+            RequestTraceRecorder)
+        from k8s_operator_libs_tpu.utils.clock import RealClock
         self.hub = hub if hub is not None else MetricsHub()
+        self._clock = clock or RealClock()
+        # drain barrier: once draining, the stepper pauses streamed
+        # in-flight decode for up to this long so the router's /export
+        # wins the race against completion (a migration the request
+        # finished under is wasted work and a replayed stream). Bounded:
+        # with no router attached the requests still complete.
+        self.drain_hold_s = drain_hold_s
+        self._drain_hold_until = None
+        # per-request stage timelines + trace context (no metrics hub:
+        # this replica's hop contributes no new tpu_workload families;
+        # the router owns the histograms)
+        self.reqtrace = RequestTraceRecorder(clock=self._clock)
         self.srv = ContinuousBatcher(params, cfg, max_slots=max_slots,
                                      capacity_per_slot=capacity,
                                      block_size=block_size,
@@ -122,7 +146,7 @@ class ServingRuntime:
         self._stop = threads.make_event("serve-stepper-stop")
         self.thread = threads.spawn("serve-stepper", self._loop)
 
-    def submit(self, tokens, max_new, stream=False):
+    def submit(self, tokens, max_new, stream=False, trace=None):
         import numpy as np
         with self.lock:
             if self.draining or self.failed:
@@ -133,6 +157,13 @@ class ServingRuntime:
             if stream:
                 self.streams[rid] = []
                 self._stream_seq[rid] = 0
+        # this hop's span: joins the router's trace when a context was
+        # propagated (payload "trace" / X-TPU-Trace), else a fresh root.
+        # The batcher admits from its own queue, so queue/placement are
+        # one edge here — the fine-grained decomposition is the router's.
+        self.reqtrace.begin(rid, parent=trace)
+        for stage in ("queued", "assigned", "prefill"):
+            self.reqtrace.stage(rid, stage)
         return rid, ev
 
     def result(self, rid):
@@ -146,6 +177,7 @@ class ServingRuntime:
         continues it via :meth:`adopt`. KeyError if ``rid`` is not
         running here."""
         from k8s_operator_libs_tpu.models.paged import encode_kv_payload
+        self.reqtrace.stage(rid, "drain")
         with self.lock:
             payload = self.srv.export_slot(rid)
             payload["kv"] = encode_kv_payload(payload["kv"])
@@ -153,6 +185,13 @@ class ServingRuntime:
             ev = self.events.pop(rid, None)
             if ev:
                 ev.set()
+        self.reqtrace.stage(rid, "export")
+        ctx = self.reqtrace.context(rid)
+        if ctx is not None:
+            # the trace context rides the migration payload so the
+            # adopting peer's span joins the SAME trace (one trace_id
+            # spans donor -> peer -> splice)
+            payload["trace"] = ctx.encode()
         return payload
 
     def adopt(self, obj):
@@ -161,10 +200,14 @@ class ServingRuntime:
         draining/failed; adoption rejections raise (409 at the HTTP
         surface)."""
         from k8s_operator_libs_tpu.models.paged import decode_kv_payload
+        from k8s_operator_libs_tpu.obs.reqtrace import parse_trace_header
         with self.lock:
             if self.draining or self.failed:
                 return None
             payload = dict(obj)
+            # the donor's trace context (garbled/missing degrades to a
+            # fresh root — parse returns None, never an error)
+            parent = parse_trace_header(payload.pop("trace", None))
             payload["kv"] = decode_kv_payload(payload["kv"])
             rid = self.srv.adopt_slot(payload)
             generated = [int(t) for t in payload["generated"]]
@@ -172,6 +215,9 @@ class ServingRuntime:
             self.streams[rid] = []
             # sequence numbers continue from the donor's splice point
             self._stream_seq[rid] = len(generated)
+        self.reqtrace.begin(rid, parent=parent)
+        for stage in ("queued", "assigned", "prefill"):
+            self.reqtrace.stage(rid, stage)
         return rid, generated
 
     def stream_state(self, rid):
@@ -198,6 +244,12 @@ class ServingRuntime:
         with self.lock:
             if self.handoff is None:
                 self.draining = True
+                if self.streams:
+                    # hold the stepper (bounded) so the router's export
+                    # beats the decode to the finish line — a migration
+                    # is pointless after the request completes
+                    self._drain_hold_until = (self._clock.now()
+                                              + self.drain_hold_s)
                 self.srv.drain()
                 self.handoff = [(rid, [int(t) for t in prompt], max_new)
                                 for rid, prompt, max_new
@@ -253,8 +305,17 @@ class ServingRuntime:
         import time
         while not self._stop.is_set():
             try:
+                completed = []
                 with self.lock:
-                    if not self.srv.idle:
+                    if (self.draining and self.streams
+                            and self._drain_hold_until is not None
+                            and self._clock.now()
+                            < self._drain_hold_until):
+                        # drain barrier: streamed in-flight requests
+                        # freeze at a step boundary until the router
+                        # exports them (or the bounded deadline passes)
+                        pass
+                    elif not self.srv.idle:
                         self.srv.step(self.chunk)
                         if self.streams:
                             for rid, toks in self.srv.poll_stream().items():
@@ -267,11 +328,15 @@ class ServingRuntime:
                                                 "token": int(tok)})
                                     seq += 1
                                 self._stream_seq[rid] = seq
+                                self.reqtrace.token_appended(rid)
                         for rid, toks in self.srv.poll().items():
                             self.results[rid] = [int(t) for t in toks]
+                            completed.append(rid)
                             ev = self.events.pop(rid, None)
                             if ev:
                                 ev.set()
+                        for rid in completed:
+                            self.reqtrace.stage(rid, "completed")
                         continue
             except Exception:  # exc: allow — a dead stepper must flip unhealthy and release every waiter, not hang them
                 # a dead stepper with no diagnosis would leave every
@@ -325,7 +390,11 @@ def make_handler(rt: ServingRuntime):
             undelivered."""
             import time
             try:
-                self._sse({"rid": rid})
+                ctx = rt.reqtrace.context(rid)
+                head = {"rid": rid}
+                if ctx is not None:
+                    head["trace"] = ctx.encode()
+                self._sse(head)
                 sent = 0
                 while True:
                     buf, done = rt.stream_state(rid)
@@ -362,6 +431,24 @@ def make_handler(rt: ServingRuntime):
                     return
                 self._sse_open()
                 self._sse_pump(rid)
+                return
+            if self.path == "/requests":
+                self._json(200, {"kind": "requests",
+                                 "data": rt.reqtrace.payload()})
+                return
+            if self.path.startswith("/trace"):
+                from urllib.parse import parse_qs, urlparse
+                query = parse_qs(urlparse(self.path).query)
+                try:
+                    rid = int(query["rid"][0])
+                except (KeyError, ValueError, IndexError):
+                    self._json(400, {"error": "want /trace?rid=N"})
+                    return
+                timeline = rt.reqtrace.trace_payload(rid)
+                if timeline is None:
+                    self._json(404, {"error": f"no trace for rid {rid}"})
+                    return
+                self._json(200, {"kind": "trace", "data": timeline})
                 return
             if self.path == "/healthz":
                 if rt.failed:
@@ -422,9 +509,12 @@ def make_handler(rt: ServingRuntime):
                                               "adopt on a peer"})
                     return
                 rid, generated = adopted
+                ctx = rt.reqtrace.context(rid)
                 self._json(200, {"kind": "adopted",
                                  "data": {"rid": rid,
-                                          "generated": generated}})
+                                          "generated": generated,
+                                          "trace": (None if ctx is None
+                                                    else ctx.encode())}})
                 return
             try:
                 tokens = [int(t) for t in req["tokens"]]
@@ -436,8 +526,14 @@ def make_handler(rt: ServingRuntime):
                 # connection
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
+            from k8s_operator_libs_tpu.obs.reqtrace import (
+                TRACE_HEADER, parse_trace_header)
+            # a missing/garbled context parses to None = fresh root
+            trace = (parse_trace_header(req.get("trace"))
+                     or parse_trace_header(self.headers.get(TRACE_HEADER)))
             try:
-                sub = rt.submit(tokens, max_new, stream=stream)
+                sub = rt.submit(tokens, max_new, stream=stream,
+                                trace=trace)
             except (ValueError, TypeError) as exc:  # over capacity etc.
                 self._json(422, {"error": str(exc)})
                 return

@@ -710,6 +710,58 @@ def run_replicas_view(args, fetch=fetch_view) -> int:
     return 0
 
 
+def render_request(data) -> str:
+    """One request's stage timeline from the router's /trace?rid= view:
+    the trace context it carries across hops, then one row per stage
+    with the dwell time spent there (the rows partition the request's
+    measured latency — docs/observability.md)."""
+    rid = data.get("rid", "?")
+    state = "open" if data.get("open") else "closed"
+    lines = [f"request {rid} ({state})  lane: {data.get('lane', '?')}",
+             f"trace: {data.get('trace_id', '?')}  "
+             f"span: {data.get('span_id', '?')}  "
+             f"hop: {data.get('hop', '?')}"]
+    stages = data.get("stages") or []
+    durations = data.get("durations") or {}
+    headers = ("SEQ", "STAGE", "AT", "DWELL")
+    table = []
+    for seq, stage, ts in stages:
+        dwell = durations.get(stage)
+        table.append((str(seq), stage, f"{ts:.6f}",
+                      "-" if dwell is None else _fmt_ms(dwell)))
+    if not table:
+        lines.append("  (no stages recorded)")
+        return "\n".join(lines)
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    lines.append(f"{len(stages)} stages, "
+                 f"{_fmt_ms(data.get('latency_s', 0.0))} measured latency "
+                 f"(stage dwells partition it exactly)")
+    overhead = (data.get("self") or {})
+    if overhead:
+        parts = ", ".join(f"{k} {_fmt_ms(v)}"
+                          for k, v in sorted(overhead.items()))
+        lines.append(f"router self-time: {parts}")
+    return "\n".join(lines)
+
+
+def run_request_view(args, fetch=fetch_view) -> int:
+    try:
+        env = fetch(args.router_url, f"/trace?rid={args.request}")
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
+        print(f"error: cannot read {args.router_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_request(env.get("data") or {}))
+    return 0
+
+
 def render_timeline(component: str, node_name: str, rows, stuck,
                     truncated: int = 0) -> str:
     lines = [f"component: {component}  node: {node_name}"]
@@ -798,12 +850,21 @@ def main(argv=None, client=None, now=None) -> int:
     p.add_argument("--replicas", action="store_true",
                    help="render the serving router's replica registry "
                         "from a running cmd/router.py")
+    p.add_argument("--request", default=None, metavar="RID",
+                   help="render one request's flight-recorder stage "
+                        "timeline from a running cmd/router.py's "
+                        "/trace?rid= endpoint "
+                        "(docs/observability.md)")
     p.add_argument("--router-url", default="http://127.0.0.1:8300",
                    metavar="URL",
-                   help="router endpoint for --replicas "
+                   help="router endpoint for --replicas/--request "
                         "(default %(default)s)")
     args = p.parse_args(argv)
 
+    if args.request is not None:
+        # the flight recorder lives in the router process; one request's
+        # timeline is its HTTP view (GET /trace?rid=)
+        return run_request_view(args)
     if args.replicas:
         # the replica registry is the router's HTTP view, never the
         # cluster's (the router owns the authoritative in-memory state)

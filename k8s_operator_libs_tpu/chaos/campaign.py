@@ -47,6 +47,7 @@ from ..obs.profile import TickProfiler, counting_client
 from ..obs.slo import SLOOptions
 from ..obs.trace import Tracer
 from ..serving.pool import DRAIN_STATES, Replica, ReplicaPool
+from ..obs.reqtrace import RequestTraceRecorder
 from ..serving.router import LANES, RequestRouter
 from ..serving.sim import SimReplicaRuntime, sim_tokens
 from ..tpu.operator import ManagedComponent, TPUOperator
@@ -110,6 +111,10 @@ class CampaignResult:
     # (None otherwise) — the profiler-determinism test compares these
     # across reruns of the same seed
     profile_payloads: Optional[Dict[str, dict]] = None
+    # the serving tier's request flight recorder payload when run with
+    # reqtrace=True (None otherwise) — the timeline-determinism test
+    # compares these across reruns of the same seed
+    reqtrace_payload: Optional[dict] = None
 
     @property
     def failed(self) -> bool:
@@ -283,7 +288,7 @@ class ServingTier:
     SHED_HIGH = 48
 
     def __init__(self, cluster: FakeCluster, clock, injector: ChaosInjector,
-                 fleet, seed: int):
+                 fleet, seed: int, reqtrace: bool = False):
         self.cluster = cluster
         self.injector = injector
         self.rng = random.Random((seed << 8) ^ 0x5EED)
@@ -292,9 +297,17 @@ class ServingTier:
                                 component=COMPONENT, metrics=self.metrics,
                                 clock=clock)
         self.pool.scrape_gate = self._scrape_gate
+        # the request flight recorder (obs/reqtrace.py) rides the same
+        # injected clock and mints ids from a counter — pure accounting,
+        # so a reqtrace=False run of the same seed is byte-identical
+        # (tests/test_reqtrace.py pins it, like run_scenario(profile=...))
+        recorder = RequestTraceRecorder(clock=clock,
+                                        metrics=self.metrics) \
+            if reqtrace else None
         self.router = RequestRouter(self.pool, metrics=self.metrics,
                                     clock=clock,
-                                    shed_high=self.SHED_HIGH)
+                                    shed_high=self.SHED_HIGH,
+                                    reqtrace=recorder)
         # live-migration transfer gate: the kv-transfer-flake fault
         # fails payload transfers touching its target nodes, driving
         # the router's bounded retry/backoff and the degraded fallback
@@ -496,6 +509,7 @@ def run_scenario(scenario: Scenario, seed: int,
                  hooks: Optional[List[Callable]] = None,
                  stop_on_violation: bool = True,
                  profile: bool = False,
+                 reqtrace: bool = True,
                  cached_reads: bool = False,
                  shard_workers: int = 0,
                  write_gate=None) -> CampaignResult:
@@ -509,6 +523,16 @@ def run_scenario(scenario: Scenario, seed: int,
     client) — pure accounting, so every invariant outcome, journey
     annotation, and router stat must be IDENTICAL to a profile=False run
     of the same seed; tests/test_obs_profile.py pins exactly that.
+
+    ``reqtrace=True`` (the default — it is fixed-memory accounting on
+    the injected clock) attaches the request flight recorder
+    (obs/reqtrace.py) to the serving tier's router, so the
+    request-trace-integrity invariant checks every recorded stage
+    timeline each tick. Like ``profile``, it is provably free:
+    ``router_stats``, sim tokens, and every invariant outcome must be
+    IDENTICAL to a reqtrace=False run of the same seed, and same-seed
+    reruns must replay identical timelines — tests/test_reqtrace.py
+    pins both.
 
     ``cached_reads=True`` gives each candidate the PR 14 informer read
     path: a pumped (synchronous, deterministic) CachedClient stacked on
@@ -589,7 +613,8 @@ def run_scenario(scenario: Scenario, seed: int,
     # workloads on one host
     job = SimJob(os.path.join(workdir, "goodput.jsonl"),
                  scenario.fleet.slice_hosts(0)[-1], clock)
-    tier = ServingTier(cluster, clock, injector, scenario.fleet, seed)
+    tier = ServingTier(cluster, clock, injector, scenario.fleet, seed,
+                       reqtrace=reqtrace)
     checks = invariants if invariants is not None else default_invariants()
     budget = scaled_int_or_percent(scenario.max_unavailable,
                                    len(fleet_nodes), round_up=True)
@@ -745,7 +770,8 @@ def run_scenario(scenario: Scenario, seed: int,
                 elif arb is not leader_arbiter:
                     arb.standby()
             for hook in hooks or []:
-                hook(cluster=cluster, clock=clock, keys=keys, tick=tick)
+                hook(cluster=cluster, clock=clock, keys=keys, tick=tick,
+                     router=tier.router)
             nodes = {n.metadata.name: n
                      for n in cluster.client.direct().list_nodes()}
             view = CampaignView(
@@ -762,7 +788,8 @@ def run_scenario(scenario: Scenario, seed: int,
                                  if identity not in dead}},
                 ledger_path=job.path, workload_node=job.node_name,
                 tick_seconds=scenario.tick_seconds,
-                router=tier.router, market=leader_arbiter)
+                router=tier.router, market=leader_arbiter,
+                reqtrace=tier.router.reqtrace)
             for inv in checks:
                 violations.extend(inv.check(view))
             if violations and stop_on_violation:
@@ -809,7 +836,9 @@ def run_scenario(scenario: Scenario, seed: int,
             "market_returns": sum(a.returns for a in arbiters.values()),
         },
         profile_payloads={identity: p.payload()
-                          for identity, p in profilers.items()} or None)
+                          for identity, p in profilers.items()} or None,
+        reqtrace_payload=(tier.router.reqtrace.payload()
+                          if tier.router.reqtrace is not None else None))
 
 
 def _converged(cluster: FakeCluster, keys: KeyFactory,

@@ -51,6 +51,17 @@ The catalog (:data:`INVARIANT_NAMES`):
                       equals its delivered result, and no replayed
                       token ever differed from what the client already
                       saw.
+``request-trace-integrity``  every request timeline the flight
+                      recorder closed is a legal walk of
+                      ``LEGAL_STAGE_TRANSITIONS`` (obs/reqtrace.py):
+                      starts at ``admitted``, gapless stage seqs,
+                      monotone timestamps, exactly one terminal stage
+                      per rid (the last), stage durations partitioning
+                      the measured latency; open timelines carry no
+                      terminal; and migration stages appear iff the
+                      router's own ledger counted a migration (splice
+                      transitions == migration successes, fallback
+                      transitions == migration fallbacks).
 ``market-conservation``  every slice the capacity arbiter manages is
                       owned by exactly one of training / serving /
                       draining / quarantined each tick, owner labels on
@@ -88,6 +99,7 @@ INVARIANT_NAMES = (
     "router-admission",
     "market-conservation",
     "router-stream-integrity",
+    "request-trace-integrity",
 )
 
 # fault type -> invariants that fault is designed to stress; CHS001
@@ -106,12 +118,15 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
     "eviction-storm": ("budget", "journey", "attribution"),
     "spot-reclaim": ("attribution", "event-dedup",
                      "router-exactly-once", "router-admission"),
-    "replica-kill": ("router-exactly-once", "router-stream-integrity"),
+    "replica-kill": ("router-exactly-once", "router-stream-integrity",
+                     "request-trace-integrity"),
     "metrics-flake": ("router-admission", "router-exactly-once"),
     "mid-stream-kill": ("router-exactly-once",
-                        "router-stream-integrity"),
+                        "router-stream-integrity",
+                        "request-trace-integrity"),
     "kv-transfer-flake": ("router-stream-integrity",
-                          "router-exactly-once"),
+                          "router-exactly-once",
+                          "request-trace-integrity"),
     "flash-crowd": ("market-conservation", "router-exactly-once",
                     "router-admission"),
     # fail-static: during the blackout the operator must take NOTHING
@@ -198,6 +213,10 @@ class CampaignView:
     # no candidate holds the lease this tick); the market-conservation
     # invariant reads its ownership ledger
     market: Optional[object] = None
+    # the router's RequestTraceRecorder (None when the scenario runs no
+    # serving tier or tracing is off); the request-trace-integrity
+    # invariant replays its closed + open timelines
+    reqtrace: Optional[object] = None
 
 
 class Invariant:
@@ -670,6 +689,77 @@ class RouterStreamIntegrityInvariant(Invariant):
         return out
 
 
+class RequestTraceIntegrityInvariant(Invariant):
+    """Every timeline the request flight recorder holds is internally
+    legal, and the recorder's migration accounting reconciles with the
+    router's own ledger. Four checks:
+
+    - every CLOSED timeline passes :func:`obs.reqtrace.validate_timeline`
+      — starts at ``admitted``, gapless stage seqs, transitions legal
+      per ``LEGAL_STAGE_TRANSITIONS``, monotone timestamps, exactly one
+      terminal stage (the last), and stage durations that partition the
+      measured latency (the attribution sums-to-the-window law);
+    - every OPEN timeline passes the same walk minus the terminal
+      requirement (and must not already contain a terminal stage);
+    - cumulative splice transitions equal the router's counted
+      migrations (migration stages present IFF a migration happened);
+    - cumulative fallback transitions equal the router's counted
+      migration fallbacks.
+
+    Stateful so each defect is reported once, at the tick it first
+    appears: closed timelines are checked once per rid, open timelines
+    re-checked each tick but deduplicated per (rid, defect)."""
+
+    name = "request-trace-integrity"
+
+    def __init__(self):
+        self._checked_closed: set = set()
+        self._reported: set = set()
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        recorder = view.reqtrace
+        router = view.router
+        if recorder is None or router is None:
+            return []
+        from ..obs.reqtrace import validate_timeline
+        out: List[Violation] = []
+        for timeline in recorder.timelines():
+            rid = timeline.get("rid")
+            if rid in self._checked_closed:
+                continue
+            self._checked_closed.add(rid)
+            for msg in validate_timeline(timeline, closed=True):
+                out.append(self._v(view, msg))
+        for timeline in recorder.open_timelines():
+            rid = timeline.get("rid")
+            for msg in validate_timeline(timeline, closed=False):
+                key = (rid, msg)
+                if key in self._reported:
+                    continue
+                self._reported.add(key)
+                out.append(self._v(view, msg))
+        migrations = router.migration_successes
+        if recorder.splices != migrations:
+            key = ("splices", recorder.splices, migrations)
+            if key not in self._reported:
+                self._reported.add(key)
+                out.append(self._v(
+                    view, f"recorder saw {recorder.splices} splice "
+                    f"transition(s) but the router counted {migrations} "
+                    f"migration(s) — migration stages must appear iff a "
+                    f"migration was counted"))
+        fallbacks = router.migration_fallbacks
+        if recorder.fallbacks != fallbacks:
+            key = ("fallbacks", recorder.fallbacks, fallbacks)
+            if key not in self._reported:
+                self._reported.add(key)
+                out.append(self._v(
+                    view, f"recorder saw {recorder.fallbacks} fallback "
+                    f"transition(s) but the router counted {fallbacks} "
+                    f"migration fallback(s)"))
+        return out
+
+
 def default_invariants() -> List[Invariant]:
     alerts = AlertTransitionInvariant()
     return [
@@ -683,4 +773,5 @@ def default_invariants() -> List[Invariant]:
         RouterAdmissionInvariant(),
         MarketConservationInvariant(),
         RouterStreamIntegrityInvariant(),
+        RequestTraceIntegrityInvariant(),
     ]

@@ -337,6 +337,30 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_router_lane_queue_wait_seconds":
         "Seconds a request waited at the router before its first "
         "placement, by QoS lane (the per-tenant queueing SLI)",
+    # request flight-recorder families (obs/reqtrace.py — OBS003 closes
+    # these over the REQTRACE_*_FAMILIES tables both ways)
+    "tpu_router_request_stage_seconds":
+        "Seconds one request dwelt in one lifecycle stage (admitted / "
+        "queued / prefill / streaming / drain / splice / ...), by stage "
+        "and QoS lane; per request the stage dwells partition the "
+        "measured latency exactly (docs/observability.md \"Request "
+        "tracing & servebench\")",
+    "tpu_router_proxy_overhead_seconds":
+        "REAL router self-time per completed request — the accept / "
+        "route / relay / reseq / splice segments measured on a "
+        "performance counter, by QoS lane (the servebench "
+        "proxy_overhead_p99_ms headline; SERVE_r01 budget-gated)",
+    "tpu_router_traces_open":
+        "Request trace timelines currently open in the flight "
+        "recorder's bounded table",
+    "tpu_router_traces_closed":
+        "Request trace timelines closed (terminal stage reached) since "
+        "router start; the last ring_capacity of them serve /requests "
+        "and /trace?rid=",
+    "tpu_router_traces_dropped":
+        "Open trace timelines evicted by the fixed-memory bound before "
+        "reaching a terminal stage (cumulative migration counters stay "
+        "truthful anyway)",
     # capacity-market families (market/arbiter.py — the SLO-priced
     # exchange between training and serving; OBS003 closes these over
     # the MARKET_GAUGE_FAMILIES table both ways)

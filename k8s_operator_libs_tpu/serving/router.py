@@ -124,10 +124,16 @@ class RequestRouter:
                  transfer_retries: int = 3,
                  transfer_backoff_s: float = 0.25,
                  transfer_backoff_cap_s: float = 2.0,
-                 shed_high: Optional[float] = None):
+                 shed_high: Optional[float] = None,
+                 reqtrace=None):
         self.pool = pool
         self._metrics = metrics
         self._clock = clock or RealClock()
+        # optional request flight recorder (obs/reqtrace.py): purely
+        # observational stage-timeline hooks at every lifecycle edge —
+        # None keeps the pre-tracing router byte-for-byte (the
+        # transparency pin tests/test_reqtrace.py enforces)
+        self.reqtrace = reqtrace
         self.queue_high = float(queue_high)
         # live-migration transfer budget: total adoption attempts per
         # request across peers, with exponential backoff (clock-injected
@@ -202,6 +208,9 @@ class RequestRouter:
         req.wfq_tag = tag
         self.requests[rid] = req
         self._queue.append(rid)
+        if self.reqtrace is not None:
+            self.reqtrace.begin(rid, lane=lane)
+            self.reqtrace.stage(rid, "queued")
         self._place_queued()
         return rid
 
@@ -373,6 +382,8 @@ class RequestRouter:
                                replica.node_name, exc_info=True)
         for rid in rids:
             req = self.requests[rid]
+            if self.reqtrace is not None:
+                self.reqtrace.stage(rid, "drain")
             # sync the client stream to the donor's cursor BEFORE the
             # export freezes the slot (tokens decoded since last tick)
             try:
@@ -388,6 +399,8 @@ class RequestRouter:
                 self._fallback(rid)
                 continue
             self._local2global.pop((replica.id, req.local_rid), None)
+            if self.reqtrace is not None:
+                self.reqtrace.stage(rid, "export")
             if not self._transfer(rid, req, payload, donor=replica):
                 self._fallback(rid)
 
@@ -414,6 +427,8 @@ class RequestRouter:
         rejected = set()
         attempts = 0
         nbytes = _payload_nbytes(payload)
+        if self.reqtrace is not None:
+            self.reqtrace.stage(rid, "transfer")
         while attempts < self.transfer_retries:
             peers = [r for r in self.pool.admitting()
                      if r.id != donor.id and r.id not in rejected
@@ -448,6 +463,9 @@ class RequestRouter:
             req.replica_id = peer.id
             req.local_rid = local
             req.migrations += 1
+            if self.reqtrace is not None:
+                self.reqtrace.stage(rid, "adopt")
+                self.reqtrace.stage(rid, "splice")
             self._local2global[(peer.id, local)] = rid
             if req.session is not None:
                 self._session_map[req.session] = peer.id
@@ -479,6 +497,8 @@ class RequestRouter:
         req.priority = DEGRADED
         req.replay_skip = len(req.stream)
         self.migration_fallbacks += 1
+        if self.reqtrace is not None:
+            self.reqtrace.stage(rid, "fallback")
         self._requeue(rid)
         logger.warning("request %d falls back to re-prefill at degraded "
                        "priority (%d tokens already streamed)", rid,
@@ -530,6 +550,8 @@ class RequestRouter:
         req.handoffs += 1
         self._rerouted += 1
         self._queue.append(rid)
+        if self.reqtrace is not None:
+            self.reqtrace.stage(rid, "queued")
 
     # --------------------------------------------------------- streaming
 
@@ -553,6 +575,8 @@ class RequestRouter:
                 continue
             req.stream_log.append((len(req.stream), replica_id))
             req.stream.append(tok)
+            if self.reqtrace is not None:
+                self.reqtrace.token_appended(req.rid)
 
     def _collect_streams(self) -> None:
         """Pull every streaming runtime's new tokens and splice them
@@ -600,6 +624,8 @@ class RequestRouter:
                 req.tokens = [int(t) for t in tokens]
                 req.completed_t = self._clock.now()
                 self._lane_completed[req.lane] += 1
+                if self.reqtrace is not None:
+                    self.reqtrace.stage(rid, "completed")
 
     # --------------------------------------------------------- placement
 
@@ -649,7 +675,11 @@ class RequestRouter:
             req = self.requests[rid]
             if req.state != QUEUED:
                 continue        # completed/assigned through another path
-            target = self._pick(req)
+            if self.reqtrace is not None:
+                with self.reqtrace.timer(rid, "route"):
+                    target = self._pick(req)
+            else:
+                target = self._pick(req)
             if target is None:
                 remaining.append(rid)
                 continue
@@ -665,6 +695,9 @@ class RequestRouter:
             req.state = ASSIGNED
             req.replica_id = target.id
             req.local_rid = local
+            if self.reqtrace is not None:
+                self.reqtrace.stage(rid, "assigned")
+                self.reqtrace.stage(rid, "prefill")
             self._vclock = max(self._vclock, req.wfq_tag)
             self._local2global[(target.id, local)] = rid
             self.assignments_this_tick.append(
@@ -713,6 +746,8 @@ class RequestRouter:
                 req.shed_t = self._clock.now()
                 self._queue.remove(rid)
                 self._lane_shed[lane] += 1
+                if self.reqtrace is not None:
+                    self.reqtrace.stage(rid, "shed")
                 excess -= 1
                 logger.warning("overload: shed request %d (lane %s, "
                                "%d queued > shed_high %g)", rid, lane,

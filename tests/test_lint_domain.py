@@ -602,7 +602,7 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
               obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH,
               obs_check.PROFILE_PATH, obs_check.MARKET_METRICS_PATH,
-              obs_check.RESILIENCE_PATH]
+              obs_check.RESILIENCE_PATH, obs_check.REQTRACE_PATH]
 
 
 def _obs3_root(tmp_path, mutate=None, skip=()):
@@ -882,8 +882,8 @@ def test_chs001_orphan_invariant_fails(tmp_path):
     """An invariant no fault stresses is a checker that rots silently."""
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "router-stream-integrity",\n)',
-            '    "router-stream-integrity",\n    "entropy",\n)')})
+            '    "request-trace-integrity",\n)',
+            '    "request-trace-integrity",\n    "entropy",\n)')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "entropy" in msgs and "stressed by no fault" in msgs
@@ -1629,6 +1629,55 @@ def test_obs003_resilience_help_covered_by_either_table(tmp_path):
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "tpu_operator_apiserver_breaker_state" in msgs
     assert "RESILIENCE_*_FAMILIES" in msgs
+
+
+# ----------------------------------------------- OBS003 (reqtrace half)
+
+
+def test_obs003_reqtrace_family_without_help_fails(tmp_path):
+    """A new request-trace family in obs/reqtrace.py's emitted tables
+    with no HELP_TEXTS entry would render with the fallback HELP."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.REQTRACE_PATH: lambda s: s.replace(
+            '    "tpu_router_traces_dropped",',
+            '    "tpu_router_traces_dropped",\n'
+            '    "tpu_router_traces_phantom",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "tpu_router_traces_phantom" in msgs
+    assert "emitted request-trace family" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+
+
+def test_obs003_reqtrace_help_covered_by_either_table(tmp_path):
+    """The tpu_router_ prefix is shared by the router tier and the
+    request flight recorder: renaming a family inside the REQTRACE
+    tables makes the old HELP entry stale (matched by NEITHER module's
+    emitted set) AND leaves the new name without a HELP entry — both
+    directions fire from one mutation."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.REQTRACE_PATH: lambda s: s.replace(
+            '    "tpu_router_request_stage_seconds",',
+            '    "tpu_router_request_stage_secondz",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "tpu_router_request_stage_secondz" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+    assert "tpu_router_request_stage_seconds'" in msgs
+    assert "REQTRACE_*_FAMILIES" in msgs
+
+
+def test_obs003_reqtrace_table_gutted_fails(tmp_path):
+    """Renaming a reqtrace emitted-family table away is parse drift,
+    not a silent pass (mirrors the router-table rule)."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.REQTRACE_PATH: lambda s: s.replace(
+            "REQTRACE_GAUGE_FAMILIES = (",
+            "REQTRACE_GAUGE_TABLES = (")})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "REQTRACE_GAUGE_FAMILIES" in msgs
 
 
 # ------------------------------------------------ CRS001 (scratch roots)

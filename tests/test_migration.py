@@ -647,3 +647,174 @@ def test_cmd_serve_sse_stream_and_export_endpoints(params):
     finally:
         httpd.shutdown()
         rt.stop()
+
+
+def test_cmd_trace_spans_live_migration(params):
+    """Satellite of the flight recorder (docs/observability.md): ONE
+    trace_id follows a live-migrated request across every hop — router
+    root span, donor replica, adopting peer — with the hop counter
+    incrementing at each handoff, and every recorded stage timeline is
+    gapless and legal per the stage state machine."""
+    from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+    from k8s_operator_libs_tpu.obs.reqtrace import validate_timeline
+    from k8s_operator_libs_tpu.serving.pool import Replica, ReplicaPool
+    serve = _load_cmd("serve")
+    routercli = _load_cmd("router")
+
+    servers = []
+    for _ in range(2):
+        rt = serve.ServingRuntime(params, CFG, 2, 64, 8, chunk=1)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    serve.make_handler(rt))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append((rt, httpd,
+                        f"http://127.0.0.1:{httpd.server_address[1]}"))
+    pool = ReplicaPool(component="libtpu")
+    for i, (_rt, _httpd, url) in enumerate(servers):
+        pool.register(Replica(f"r{i}", f"node-{i}",
+                              routercli.HTTPRuntime(url), url=url))
+    front = routercli.RouterFront(pool, metrics=MetricsHub(),
+                                  proxy_timeout=60.0)
+    front.tick()
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        n = 24
+        events = []
+        drained = {}
+
+        def emit(event):
+            events.append(event)
+            if "token" in event and event["seq"] == 2 and not drained:
+                with front.lock:
+                    sid = max(front._outstanding,
+                              key=lambda k: front._outstanding[k])
+                drained["id"] = sid
+                idx = int(sid[1])
+                urllib.request.urlopen(urllib.request.Request(
+                    servers[idx][2] + "/drain", data=b"{}",
+                    method="POST"), timeout=10).read()
+
+        code = front.generate_stream(prompt, n, emit=emit)
+        assert code == 200
+        assert front._migrations == 1
+
+        # the router's root timeline: closed, legal, and carrying the
+        # full migration arc drain->export->transfer->adopt->splice
+        root = front.reqtrace.trace_payload(1)
+        assert root is not None and not root["open"]
+        assert validate_timeline(root) == []
+        staged = [s for _, s, _ in root["stages"]]
+        for want in ("drain", "export", "transfer", "adopt", "splice",
+                     "completed"):
+            assert want in staged, (want, staged)
+        trace_id = root["trace_id"]
+        assert root["hop"] == 0
+        # the SSE preamble advertised the same trace context
+        assert events[0]["trace"].startswith(trace_id + "/")
+
+        donor_idx = int(drained["id"][1])
+        donor_rt = servers[donor_idx][0]
+        peer_rt = servers[1 - donor_idx][0]
+        donor_tl = [t for t in (donor_rt.reqtrace.open_timelines()
+                                + donor_rt.reqtrace.timelines())
+                    if t["trace_id"] == trace_id]
+        peer_tl = [t for t in (peer_rt.reqtrace.open_timelines()
+                               + peer_rt.reqtrace.timelines())
+                   if t["trace_id"] == trace_id]
+        # one trace id spans donor and adopting peer; the hop counter
+        # increments router(0) -> donor(1) -> peer(2)
+        assert len(donor_tl) == 1 and donor_tl[0]["hop"] == 1
+        assert len(peer_tl) == 1 and peer_tl[0]["hop"] == 2
+        # the donor's timeline parked at the export handoff (open: the
+        # request finished elsewhere), the peer's closed at completed
+        donor_stages = [s for _, s, _ in donor_tl[0]["stages"]]
+        assert donor_stages[-1] == "export"
+        assert validate_timeline(donor_tl[0], closed=False) == []
+        assert validate_timeline(peer_tl[0]) == []
+        assert peer_tl[0]["stages"][-1][1] == "completed"
+    finally:
+        for rt, httpd, _url in servers:
+            httpd.shutdown()
+            rt.stop()
+
+
+def test_cmd_router_trace_header_and_endpoints(params):
+    """The router's HTTP trace surface: a well-formed ``X-TPU-Trace``
+    header joins the caller's trace (hop+1); a garbled or absent header
+    degrades to a fresh root trace — always 200, never an error; and
+    /requests + /trace?rid= expose the recorder (404 unknown rid, 400
+    missing rid)."""
+    from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+    from k8s_operator_libs_tpu.serving.pool import Replica, ReplicaPool
+    serve = _load_cmd("serve")
+    routercli = _load_cmd("router")
+
+    rt = serve.ServingRuntime(params, CFG, 2, 64, 8, chunk=2)
+    shttpd = ThreadingHTTPServer(("127.0.0.1", 0), serve.make_handler(rt))
+    threading.Thread(target=shttpd.serve_forever, daemon=True).start()
+    surl = f"http://127.0.0.1:{shttpd.server_address[1]}"
+    pool = ReplicaPool(component="libtpu")
+    pool.register(Replica("r0", "node-0", routercli.HTTPRuntime(surl),
+                          url=surl))
+    hub = MetricsHub()
+    front = routercli.RouterFront(pool, metrics=hub, proxy_timeout=60.0)
+    front.tick()
+    rhttpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), routercli.make_handler(front, pool, hub))
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+    def post(headers=None):
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": [2, 7, 1], "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # hop join: a valid caller context is inherited, hop increments
+        code, _body = post({"X-TPU-Trace": "abc123/s00beef/3"})
+        assert code == 200
+        tl = get("/trace?rid=1")
+        assert tl["kind"] == "trace"
+        assert tl["data"]["trace_id"] == "abc123"
+        assert tl["data"]["hop"] == 4
+        assert not tl["data"]["open"]
+
+        # garbled header: fresh root trace, never a 4xx/5xx
+        code, _body = post({"X-TPU-Trace": "!!! not // a trace !!!"})
+        assert code == 200
+        tl2 = get("/trace?rid=2")
+        assert tl2["data"]["trace_id"] != "abc123"
+        assert tl2["data"]["hop"] == 0
+
+        # dropped header: same degradation
+        code, _body = post()
+        assert code == 200
+        assert get("/trace?rid=3")["data"]["hop"] == 0
+
+        # the recorder's summary view
+        reqs = get("/requests")
+        assert reqs["kind"] == "requests"
+        assert reqs["data"]["closed"] == 3
+        assert reqs["data"]["open"] == 0
+        assert len(reqs["data"]["last"]) == 3
+
+        # unknown rid -> 404; missing rid -> 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/trace?rid=999", timeout=10)
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/trace", timeout=10)
+        assert exc.value.code == 400
+    finally:
+        rhttpd.shutdown()
+        shttpd.shutdown()
+        rt.stop()

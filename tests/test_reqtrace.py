@@ -1,0 +1,383 @@
+"""obs/reqtrace.py — the request flight recorder.
+
+Acceptance bars pinned here (ISSUE 18):
+
+- stage timelines PARTITION the request's measured latency (the
+  sums-to-the-window law from obs/attribution.py, by construction);
+- the trace context survives encode/parse round-trips and every
+  garbled form degrades to None (fresh root trace, never an error);
+- tracing is provably free: the chaos campaign behaves IDENTICALLY
+  (router stats, per-tick sim token streams, invariants, fault trace)
+  with the recorder on and off on the same seed, and the same seed
+  replays byte-identical timelines twice;
+- the request-trace-integrity invariant actually catches corruption:
+  illegal transitions, terminal-in-open timelines, and recorder/router
+  migration-ledger mismatches all produce violations;
+- memory is fixed: the closed ring and the open table are bounded and
+  the eviction counters stay truthful.
+"""
+
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.chaos.campaign import run_scenario
+from k8s_operator_libs_tpu.chaos.invariants import (
+    CampaignView, RequestTraceIntegrityInvariant)
+from k8s_operator_libs_tpu.chaos.scenario import parse_scenario
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.obs.reqtrace import (
+    LEGAL_STAGE_TRANSITIONS, MIGRATION_STAGES, STAGES, TERMINAL_STAGES,
+    RequestTraceRecorder, TraceContext, durations_partition_latency,
+    parse_trace_header, stage_durations, validate_timeline)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+# ------------------------------------------------------------ wire format
+
+
+def test_trace_context_roundtrip():
+    ctx = TraceContext(trace_id="t00000001", span_id="s000001", hop=2)
+    parsed = parse_trace_header(ctx.encode())
+    assert parsed == ctx
+
+
+@pytest.mark.parametrize("garbled", [
+    None, "", "   ", "t1/s1", "t1/s1/2/9", "t1//0", "/s1/0",
+    "t1/s1/x", "t1/s1/-1", "t1/s1/1000", "t~1/s1/0", "t1/s 1/0",
+    "x" * 65 + "/s1/0", "t1/s1/0/",
+])
+def test_parse_trace_header_garbled_degrades_to_none(garbled):
+    """A dropped or corrupted X-TPU-Trace header must yield None — the
+    caller mints a fresh root trace and serves the request anyway."""
+    assert parse_trace_header(garbled) is None
+
+
+def test_stage_catalog_closed_over_transitions():
+    """Every stage appears in the transition table, every successor is a
+    known stage, and terminals have no successors."""
+    assert set(LEGAL_STAGE_TRANSITIONS) == set(STAGES)
+    for stage, nxt in LEGAL_STAGE_TRANSITIONS.items():
+        assert set(nxt) <= set(STAGES), stage
+        if stage in TERMINAL_STAGES:
+            assert nxt == ()
+
+
+# ------------------------------------------------- the partition law
+
+
+def test_stage_durations_partition_latency():
+    stages = [(0, "admitted", 10.0), (1, "queued", 10.5),
+              (2, "assigned", 12.0), (3, "prefill", 12.0),
+              (4, "first_token", 14.5), (5, "streaming", 14.5),
+              (6, "completed", 20.0)]
+    durations = stage_durations(stages)
+    assert durations["admitted"] == pytest.approx(0.5)
+    assert durations["queued"] == pytest.approx(1.5)
+    assert durations["prefill"] == pytest.approx(2.5)
+    assert durations["streaming"] == pytest.approx(5.5)
+    assert "completed" not in durations    # terminal dwells zero
+    assert sum(durations.values()) == pytest.approx(10.0)
+    assert durations_partition_latency({"stages": stages})
+
+
+def test_stage_durations_accumulate_revisits():
+    """A crash requeue visits queued twice — both dwells count, and the
+    telescoping sum still equals the window."""
+    stages = [(0, "admitted", 0.0), (1, "queued", 1.0),
+              (2, "assigned", 3.0), (3, "prefill", 3.0),
+              (4, "queued", 5.0), (5, "assigned", 9.0),
+              (6, "prefill", 9.0), (7, "completed", 12.0)]
+    durations = stage_durations(stages)
+    assert durations["queued"] == pytest.approx(2.0 + 4.0)
+    assert sum(durations.values()) == pytest.approx(12.0)
+
+
+def test_validate_timeline_flags_each_defect():
+    ok = {"rid": 1, "stages": [[0, "admitted", 0.0], [1, "queued", 1.0],
+                               [2, "shed", 2.0]]}
+    assert validate_timeline(ok, closed=True) == []
+    bad_start = {"rid": 2, "stages": [[0, "queued", 0.0],
+                                      [1, "shed", 1.0]]}
+    assert any("not 'admitted'" in m
+               for m in validate_timeline(bad_start))
+    illegal = {"rid": 3, "stages": [[0, "admitted", 0.0],
+                                    [1, "streaming", 1.0],
+                                    [2, "completed", 2.0]]}
+    assert any("illegal stage transition" in m
+               for m in validate_timeline(illegal))
+    gap = {"rid": 4, "stages": [[0, "admitted", 0.0], [2, "queued", 1.0],
+                                [3, "shed", 2.0]]}
+    assert any("gap or duplicate" in m for m in validate_timeline(gap))
+    regress = {"rid": 5, "stages": [[0, "admitted", 5.0],
+                                    [1, "queued", 4.0],
+                                    [2, "shed", 6.0]]}
+    assert any("regressed" in m for m in validate_timeline(regress))
+    open_terminal = {"rid": 6, "stages": [[0, "admitted", 0.0],
+                                          [1, "queued", 1.0],
+                                          [2, "shed", 2.0]]}
+    assert any("open timeline" in m
+               for m in validate_timeline(open_terminal, closed=False))
+    not_closed = {"rid": 7, "stages": [[0, "admitted", 0.0],
+                                       [1, "queued", 1.0]]}
+    assert any("non-terminal" in m for m in validate_timeline(not_closed))
+    lying = dict(ok, durations={"admitted": 40.0}, latency_s=2.0)
+    assert any("attribution law" in m for m in validate_timeline(lying))
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_recorder_happy_path_closes_and_observes():
+    clock = FakeClock(100.0)
+    hub = MetricsHub()
+    rec = RequestTraceRecorder(clock=clock, metrics=hub)
+    ctx = rec.begin(1, lane="interactive")
+    assert ctx.hop == 0 and ctx.trace_id.startswith("t")
+    rec.stage(1, "queued")
+    clock.advance(2.0)
+    rec.stage(1, "assigned")
+    rec.stage(1, "prefill")
+    clock.advance(1.0)
+    rec.token_appended(1)          # prefill -> first_token -> streaming
+    clock.advance(3.0)
+    rec.token_appended(1)          # already streaming: no-op
+    rec.stage(1, "completed")
+    assert rec.open_count() == 0 and rec.closed == 1
+    timeline = rec.timeline(1)
+    assert [s for _, s, _ in timeline["stages"]] == \
+        ["admitted", "queued", "assigned", "prefill", "first_token",
+         "streaming", "completed"]
+    assert timeline["latency_s"] == pytest.approx(6.0)
+    assert durations_partition_latency(timeline)
+    assert validate_timeline(timeline) == []
+    text = hub.render(prefix="tpu_router")
+    assert ('tpu_router_request_stage_seconds_count'
+            '{lane="interactive",stage="queued"} 1') in text
+    assert "tpu_router_traces_closed 1" in text
+    assert "tpu_router_traces_open 0" in text
+    # no selfclock -> the overhead histogram is never observed
+    assert "tpu_router_proxy_overhead_seconds" not in text
+
+
+def test_recorder_stage_edges_are_noops_when_unknown_or_repeated():
+    rec = RequestTraceRecorder(clock=FakeClock(0.0))
+    rec.stage(99, "queued")        # never begun: no-op
+    rec.token_appended(99)
+    assert rec.open_count() == 0
+    rec.begin(1)
+    rec.stage(1, "queued")
+    rec.stage(1, "queued")         # same-stage repeat: no transition
+    assert [s for _, s, _ in rec.open_timelines()[0]["stages"]] == \
+        ["admitted", "queued"]
+
+
+def test_recorder_splice_resumes_streaming_on_token():
+    clock = FakeClock(0.0)
+    rec = RequestTraceRecorder(clock=clock)
+    rec.begin(1)
+    for s in ("queued", "assigned", "prefill"):
+        rec.stage(1, s)
+    rec.token_appended(1)
+    for s in ("drain", "export", "transfer", "adopt", "splice"):
+        clock.advance(0.5)
+        rec.stage(1, s)
+    clock.advance(0.5)
+    rec.token_appended(1)          # splice -> streaming
+    rec.stage(1, "completed")
+    timeline = rec.timeline(1)
+    names = [s for _, s, _ in timeline["stages"]]
+    assert names[-3:] == ["splice", "streaming", "completed"]
+    assert all(m in names for m in MIGRATION_STAGES)
+    assert rec.spliced == 1 and rec.splices == 1
+    assert validate_timeline(timeline) == []
+
+
+def test_recorder_parent_context_joins_trace():
+    rec = RequestTraceRecorder(clock=FakeClock(0.0))
+    root = rec.begin(1)
+    child = rec.begin(2, parent=root)
+    assert child.trace_id == root.trace_id
+    assert child.hop == root.hop + 1
+    assert child.span_id != root.span_id
+    # re-begin keeps the first timeline and returns its context
+    again = rec.begin(1)
+    assert again == root
+    assert rec.open_count() == 2
+
+
+def test_recorder_fixed_memory_bounds():
+    clock = FakeClock(0.0)
+    rec = RequestTraceRecorder(clock=clock, max_closed=2, max_open=3)
+    for rid in range(5):
+        rec.begin(rid)
+    assert rec.open_count() == 3 and rec.dropped == 2
+    for rid in (2, 3, 4):
+        rec.stage(rid, "queued")
+        rec.stage(rid, "shed")
+    assert rec.open_count() == 0 and rec.closed == 3
+    ring = rec.timelines()
+    assert [t["rid"] for t in ring] == [3, 4]    # last-2 retained
+    payload = rec.payload(last=1)
+    assert payload["closed"] == 3 and payload["dropped"] == 2
+    assert payload["ring_capacity"] == 2
+    assert [t["rid"] for t in payload["last"]] == [4]
+    assert payload["stage_totals"]["queued"]["count"] == 3
+
+
+def test_recorder_selfclock_measures_overhead():
+    clock = FakeClock(0.0)
+    hub = MetricsHub()
+    ticks = iter(x * 0.001 for x in range(100))
+    rec = RequestTraceRecorder(clock=clock, metrics=hub,
+                               selfclock=lambda: next(ticks))
+    rec.begin(1)
+    with rec.timer(1, "route"):
+        pass                        # one selfclock tick = 1 ms
+    rec.stage(1, "queued")
+    rec.stage(1, "shed")
+    timeline = rec.timeline(1)
+    assert timeline["overhead_s"] == pytest.approx(0.001)
+    assert timeline["self"]["route"] == pytest.approx(0.001)
+    text = hub.render(prefix="tpu_router")
+    assert ('tpu_router_proxy_overhead_seconds_count'
+            '{lane="interactive"} 1') in text
+
+
+def test_trace_payload_open_and_closed():
+    clock = FakeClock(0.0)
+    rec = RequestTraceRecorder(clock=clock)
+    rec.begin(1)
+    rec.stage(1, "queued")
+    clock.advance(1.0)
+    open_view = rec.trace_payload(1)
+    assert open_view["open"] is True
+    assert open_view["durations"] == {"admitted": 0.0}
+    rec.stage(1, "shed")
+    closed_view = rec.trace_payload(1)
+    assert closed_view["open"] is False
+    assert closed_view["terminal"] == "shed"
+    assert rec.trace_payload(404) is None
+
+
+# ------------------------------------- the integrity invariant bites
+
+
+class _StubRouter:
+    def __init__(self, successes=0, fallbacks=0):
+        self.migration_successes = successes
+        self.migration_fallbacks = fallbacks
+        self.requests = {}
+
+
+def _view(recorder, router):
+    return CampaignView(tick=1, t=15.0, nodes={}, keys=None, budget=1,
+                        fault_notready=set(), leaders=[],
+                        recorder_events=[], alert_status={},
+                        router=router, reqtrace=recorder)
+
+
+def test_invariant_skips_without_recorder():
+    inv = RequestTraceIntegrityInvariant()
+    assert inv.check(_view(None, _StubRouter())) == []
+
+
+def test_invariant_catches_illegal_transition_once():
+    rec = RequestTraceRecorder(clock=FakeClock(0.0))
+    rec.begin(1)
+    rec.stage(1, "streaming")      # admitted -> streaming: illegal
+    rec.stage(1, "completed")
+    inv = RequestTraceIntegrityInvariant()
+    out = inv.check(_view(rec, _StubRouter()))
+    assert len(out) == 1 and "illegal stage transition" in out[0].detail
+    # stateful: the same closed timeline is not re-reported
+    assert inv.check(_view(rec, _StubRouter())) == []
+
+
+def test_invariant_reconciles_migration_ledgers():
+    rec = RequestTraceRecorder(clock=FakeClock(0.0))
+    inv = RequestTraceIntegrityInvariant()
+    # recorder saw no splice but the router counted a migration
+    out = inv.check(_view(rec, _StubRouter(successes=1)))
+    assert len(out) == 1 and "migration" in out[0].detail
+    # reported once per distinct mismatch
+    assert inv.check(_view(rec, _StubRouter(successes=1))) == []
+    out = inv.check(_view(rec, _StubRouter(fallbacks=2)))
+    assert len(out) == 1 and "fallback" in out[0].detail
+
+
+# ------------------------------------------- campaign: provably free
+
+
+REQTRACE_SCENARIO = {
+    "name": "reqtrace-invariance",
+    "max_ticks": 300,
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 0},
+    "upgrade_at": 30.0,
+    "faults": [
+        {"type": "mid-stream-kill", "at": 60.0, "duration": 90.0,
+         "slices": [0]},
+        {"type": "kv-transfer-flake", "at": 150.0, "duration": 120.0,
+         "rate": 0.6, "slices": [0, 1]},
+    ],
+}
+
+
+def _token_capture(store):
+    """Per-tick snapshot of every request's client-visible token stream
+    — the 'sim tokens byte-identical' half of the transparency pin."""
+    def hook(router=None, tick=None, **kw):
+        store.append({rid: list(req.stream)
+                      for rid, req in router.requests.items()})
+    return hook
+
+
+def test_campaign_identical_with_reqtrace_on_and_off(tmp_path):
+    """ACCEPTANCE: tracing is free — the same seed converges identically
+    (router stats, per-tick sim token streams, invariants, fault trace)
+    with the request recorder wired in and without it."""
+    sc = parse_scenario(REQTRACE_SCENARIO)
+    tokens_off, tokens_on = [], []
+    off = run_scenario(sc, seed=13, workdir=str(tmp_path / "off"),
+                       hooks=[_token_capture(tokens_off)],
+                       reqtrace=False)
+    on = run_scenario(sc, seed=13, workdir=str(tmp_path / "on"),
+                      hooks=[_token_capture(tokens_on)])
+    assert off.violations == [] and on.violations == []
+    assert off.converged and on.converged
+    assert (off.ticks, off.failovers, off.modelled_s) == \
+        (on.ticks, on.failovers, on.modelled_s)
+    assert off.trace == on.trace
+    assert off.router_stats == on.router_stats
+    assert tokens_off == tokens_on
+    assert off.reqtrace_payload is None
+    assert on.reqtrace_payload is not None
+    assert on.reqtrace_payload["closed"] > 0
+
+
+def test_campaign_reqtrace_deterministic_per_seed(tmp_path):
+    """Same seed → byte-identical timelines (ids, stages, FakeClock
+    stamps, aggregates) across two runs."""
+    sc = parse_scenario(REQTRACE_SCENARIO)
+    r1 = run_scenario(sc, seed=9, workdir=str(tmp_path / "a"))
+    r2 = run_scenario(sc, seed=9, workdir=str(tmp_path / "b"))
+    assert r1.reqtrace_payload is not None
+    assert json.dumps(r1.reqtrace_payload, sort_keys=True) == \
+        json.dumps(r2.reqtrace_payload, sort_keys=True)
+
+
+def test_campaign_timelines_survive_migration_faults(tmp_path):
+    """Under mid-stream kills and KV-transfer flakes every closed
+    timeline stays a legal walk, migration stages appear iff the router
+    counted a migration, and the per-stage durations partition each
+    request's latency (the invariant asserts all of this every tick —
+    this test additionally checks the final ring directly)."""
+    sc = parse_scenario(REQTRACE_SCENARIO)
+    res = run_scenario(sc, seed=13, workdir=str(tmp_path))
+    assert res.violations == [], "\n".join(map(str, res.violations))
+    payload = res.reqtrace_payload
+    assert payload["closed"] >= res.router_stats["completed"] > 0
+    for timeline in payload["last"]:
+        assert validate_timeline(timeline, closed=True) == []
+    if res.router_stats["migrations"] > 0:
+        assert payload["spliced"] > 0

@@ -651,7 +651,7 @@ def test_blackout_mid_upgrade_e2e():
     rolling upgrade completes."""
     captured = {"cluster": None, "cordons": [], "quarantines": 0}
 
-    def capture(cluster=None, clock=None, keys=None, tick=None):
+    def capture(cluster=None, clock=None, keys=None, tick=None, **kw):
         captured["cluster"] = cluster
         t = clock.now() - 10_000.0
         nodes = cluster.client.direct().list_nodes()

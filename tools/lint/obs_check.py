@@ -350,6 +350,12 @@ PROFILE_FAMILY_PREFIXES = ("tpu_operator_apiserver_",
 # recorder, so the profile reverse-check treats both tables as the
 # emitted set for that prefix; same absent-module skip rule
 RESILIENCE_PATH = "k8s_operator_libs_tpu/core/resilience.py"
+# the request flight recorder's emitted-family tables
+# (REQTRACE_GAUGE_FAMILIES / REQTRACE_HISTOGRAM_FAMILIES) — its families
+# share the tpu_router_ prefix with the router tier, so the router
+# reverse-check treats the union of both modules' tables as the emitted
+# set for that prefix; same absent-module skip rule
+REQTRACE_PATH = "k8s_operator_libs_tpu/obs/reqtrace.py"
 
 
 def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
@@ -487,6 +493,30 @@ def run_slo(root) -> List[Finding]:
                  f"SLO_GAUGE_FAMILIES ({SLO_PATH}) or ALERT_GAUGE_FAMILIES "
                  f"({ALERTS_PATH}) (renamed or removed gauge?)"))
 
+    # request flight recorder: obs/reqtrace.py's emitted-family tables
+    # close over HELP_TEXTS both ways (skipped when the checkout carries
+    # no reqtrace module). Collected BEFORE the router block so the
+    # shared tpu_router_ prefix check can treat the union of both
+    # modules' tables as the emitted set.
+    reqtrace_emitted: Dict[str, int] = {}
+    if index.exists(REQTRACE_PATH):
+        reqtrace_tree = index.tree(REQTRACE_PATH)
+        for table in ("REQTRACE_GAUGE_FAMILIES",
+                      "REQTRACE_HISTOGRAM_FAMILIES"):
+            fams, fams_line = _string_tuple(reqtrace_tree, table)
+            if fams_line == 0:
+                findings.append(
+                    (REQTRACE_PATH, 1, "OBS003",
+                     f"{table} table not found (parse drift?)"))
+                continue
+            reqtrace_emitted.update(fams)
+        for family, lineno in sorted(reqtrace_emitted.items()):
+            if family not in help_keys:
+                findings.append(
+                    (REQTRACE_PATH, lineno, "OBS003",
+                     f"emitted request-trace family {family!r} has no "
+                     f"HELP_TEXTS entry ({METRICS_PATH})"))
+
     # router tier: the serving/metrics.py emitted-family tables close
     # over HELP_TEXTS exactly like the slo/alert tables (skipped when
     # the checkout carries no serving package)
@@ -510,13 +540,16 @@ def run_slo(root) -> List[Finding]:
                      f"HELP_TEXTS entry ({METRICS_PATH})"))
         for key, lineno in sorted(help_keys.items()):
             if (key.startswith(ROUTER_FAMILY_PREFIX)
-                    and key not in router_emitted):
+                    and key not in router_emitted
+                    and key not in reqtrace_emitted):
                 findings.append(
                     (METRICS_PATH, lineno, "OBS003",
                      f"HELP_TEXTS entry {key!r} matches no emitted "
                      f"family in ROUTER_GAUGE_FAMILIES or "
-                     f"ROUTER_HISTOGRAM_FAMILIES ({ROUTER_METRICS_PATH})"
-                     f" (renamed or removed router metric?)"))
+                     f"ROUTER_HISTOGRAM_FAMILIES ({ROUTER_METRICS_PATH}) "
+                     f"or the REQTRACE_*_FAMILIES tables "
+                     f"({REQTRACE_PATH}) (renamed or removed router "
+                     f"metric?)"))
 
     # capacity market: the market/metrics.py emitted-family table closes
     # over HELP_TEXTS both ways like the router tables (skipped when the

@@ -1,0 +1,352 @@
+#!/usr/bin/env python3
+"""Serving-plane benchmark: the SERVE_r01 baseline the async router
+must beat (docs/observability.md "Request tracing & servebench").
+
+Stands up the REAL :class:`RequestRouter` over seeded
+``serving/sim.py`` replicas on a :class:`BenchClock` (real Python
+compute, free modelled waits — the fleetbench basis), drives a seeded
+open-loop Poisson arrival process per QoS lane, and sweeps the offered
+rate up a ladder to the knee: the highest RPS at which TTFT p99 still
+meets the ``serving-ttft-p99`` SLO (2.5 s, read from
+``obs/slo.py DEFAULT_SLOS`` — the bench names the SLO, it does not
+restate it). Every request's stage timeline comes from the request
+flight recorder (``obs/reqtrace.py``), so the bench gets, for free:
+
+- ``router_rps_at_slo`` — the knee, the headline a future async router
+  round (SERVE_r02+) must move;
+- ``proxy_overhead_p99_ms`` — REAL router self-time per request
+  (accept/route/relay/reseq/splice segments on a performance counter),
+  the "tracing + routing must stay cheap" headline;
+- the per-stage decomposition at the knee — queued/prefill/streaming/…
+  dwell, which MUST partition the measured latency exactly (the
+  sums-to-the-window law; asserted in-bench on every closed timeline
+  via :func:`validate_timeline` and again in aggregate);
+- per-lane shed rates at the knee (interactive never sheds; the
+  sheddable lanes price the overload).
+
+Run ``make servebench`` for the full ladder (writes ``SERVE_r01.json``
+at the repo root; SERVE_RPS/SERVE_LANES/SERVE_SEED env knobs) or
+``make servebench-smoke`` for the budgeted CI gate
+(``tools/servebench_budget.json``: proxy-overhead ceiling + the closed
+set of budgeted stages — an unbudgeted stage in the decomposition
+fails the gate, mirroring fleetbench's unbudgeted-verb rule). Exit
+code is non-zero when any assertion fails; the artifact still records
+what was measured.
+"""
+
+import argparse
+import json
+import math
+import random
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub  # noqa: E402
+from k8s_operator_libs_tpu.obs.reqtrace import (  # noqa: E402
+    RequestTraceRecorder, validate_timeline)
+from k8s_operator_libs_tpu.obs.slo import DEFAULT_SLO_SPECS  # noqa: E402
+from k8s_operator_libs_tpu.serving import (Replica,  # noqa: E402
+                                           ReplicaPool, RequestRouter,
+                                           SimReplicaRuntime)
+from k8s_operator_libs_tpu.serving.router import LANES  # noqa: E402
+from k8s_operator_libs_tpu.utils import threads  # noqa: E402
+from k8s_operator_libs_tpu.utils.clock import Clock  # noqa: E402
+
+SLO_NAME = "serving-ttft-p99"
+# seeded lane mix for the arrival process (restricted to --lanes)
+LANE_MIX = {"interactive": 0.6, "batch": 0.3, "best-effort": 0.1}
+
+
+class BenchClock(Clock):
+    """Real compute, free waits — the fleetbench basis: ``now()`` is
+    real monotonic time plus a modelled-sleep offset, so stage
+    timestamps measure modelled queueing/decode time PLUS the router's
+    actual Python work, while ``sleep()`` makes the modelled tick
+    interval free."""
+
+    def __init__(self):
+        self._offset = 0.0
+        self._lock = threads.make_lock("servebench-clock")
+
+    def now(self) -> float:
+        with self._lock:
+            return time.monotonic() + self._offset
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self._offset += max(0.0, seconds)
+
+
+def percentile(values, q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def ttft_slo_threshold() -> float:
+    slo = next(s for s in DEFAULT_SLO_SPECS if s["name"] == SLO_NAME)
+    return float(slo["threshold"])
+
+
+def run_point(rps: float, args) -> dict:
+    """One ladder point: a fresh sim tier at offered rate ``rps`` for
+    ``args.duration`` modelled seconds, then a bounded cool-down so
+    every admitted (non-shed) request reaches a terminal stage."""
+    clock = BenchClock()
+    pool = ReplicaPool(component="libtpu", clock=clock)
+    runtimes = []
+    for i in range(args.replicas):
+        rt = SimReplicaRuntime(max_slots=args.slots,
+                               tokens_per_step=args.tokens_per_step)
+        pool.register(Replica(f"r{i}", f"node-{i}", rt))
+        runtimes.append(rt)
+    arrivals_cap = int(rps * args.duration) + 64
+    recorder = RequestTraceRecorder(
+        clock=clock, metrics=MetricsHub(),
+        max_closed=max(4096, 2 * arrivals_cap),
+        max_open=max(4096, 2 * arrivals_cap),
+        selfclock=time.perf_counter)
+    router = RequestRouter(pool, clock=clock, shed_high=args.shed_high,
+                           reqtrace=recorder)
+    rng = random.Random((args.seed * 1_000_003) ^ int(rps * 1000))
+    lanes = [ln for ln in LANES if ln in args.lanes]
+    weights = [LANE_MIX.get(ln, 0.1) for ln in lanes]
+
+    t = 0.0
+    next_arrival = rng.expovariate(rps)
+    submitted = 0
+    ticks = int(math.ceil(args.duration / args.tick))
+    cooldown = 0
+    for i in range(ticks + args.max_cooldown_ticks):
+        for rt in runtimes:
+            rt.step()
+        router.tick()
+        # arrivals land after this window's decode step and collection:
+        # a request admitted in window i sees its first token no earlier
+        # than the i+1 boundary, so TTFT is never sub-tick by accident
+        if i < ticks:
+            while next_arrival <= t + args.tick:
+                lane = rng.choices(lanes, weights=weights)[0]
+                prompt = [rng.randrange(1, 256)
+                          for _ in range(args.prompt_len)]
+                router.submit(prompt, args.max_new, lane=lane)
+                submitted += 1
+                next_arrival += rng.expovariate(rps)
+        clock.sleep(args.tick)
+        t += args.tick
+        if i >= ticks:
+            cooldown += 1
+            if recorder.open_count() == 0:
+                break
+
+    timelines = recorder.timelines()
+    errors = []
+    for tl in timelines:
+        errors.extend(validate_timeline(tl))
+    if recorder.open_count():
+        errors.append(f"{recorder.open_count()} requests never reached "
+                      f"a terminal stage within the cool-down")
+    ttfts = []
+    overheads = []
+    latencies = []
+    stage_totals = {}
+    completed = shed = 0
+    for tl in timelines:
+        stages = {s: ts for _, s, ts in tl["stages"]}
+        if tl["terminal"] == "shed":
+            shed += 1
+            continue
+        completed += 1
+        first = stages.get("first_token", stages.get("streaming"))
+        if first is not None:
+            ttfts.append(first - tl["stages"][0][2])
+        overheads.append(tl["overhead_s"])
+        latencies.append(tl["latency_s"])
+        for stage, dur in tl["durations"].items():
+            stage_totals.setdefault(
+                stage, {"count": 0, "total_s": 0.0})
+            stage_totals[stage]["count"] += 1
+            stage_totals[stage]["total_s"] += dur
+    # the aggregate form of the sums-to-the-window law: stage dwell
+    # totals across completed requests re-add to the summed latency
+    dwell = math.fsum(v["total_s"] for v in stage_totals.values())
+    lat = math.fsum(latencies)
+    if lat > 0 and abs(dwell - lat) > 1e-6 * max(1.0, lat):
+        errors.append(f"stage dwell sum {dwell} != latency sum {lat}")
+    lane_shed = {ln: s["shed"] for ln, s in router.lane_stats().items()
+                 if s["shed"]}
+    return {
+        "rps": rps,
+        "submitted": submitted,
+        "completed": completed,
+        "shed": shed,
+        "ttft_s_p50": round(percentile(ttfts, 0.5), 4),
+        "ttft_s_p99": round(percentile(ttfts, 0.99), 4),
+        "proxy_overhead_ms_p99": round(
+            1000.0 * percentile(overheads, 0.99), 4),
+        "lane_shed": lane_shed,
+        "stage_totals": {s: {"count": v["count"],
+                             "total_s": round(v["total_s"], 4)}
+                         for s, v in sorted(stage_totals.items())},
+        "cooldown_ticks": cooldown,
+        "timeline_errors": errors[:10],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rps-start", type=float, default=2.0)
+    p.add_argument("--rps-step", type=float, default=1.0)
+    p.add_argument("--rps-max", type=float, default=16.0,
+                   help="ladder ceiling (make servebench: SERVE_RPS)")
+    p.add_argument("--lanes", default="interactive,batch,best-effort",
+                   help="comma list of QoS lanes in the arrival mix "
+                        "(make servebench: SERVE_LANES)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="arrival-process seed (make servebench: "
+                        "SERVE_SEED)")
+    p.add_argument("--duration", type=float, default=60.0,
+                   help="modelled seconds of offered load per point")
+    p.add_argument("--tick", type=float, default=0.25,
+                   help="modelled seconds per router/replica step")
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--tokens-per-step", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--shed-high", type=float, default=64.0)
+    p.add_argument("--max-cooldown-ticks", type=int, default=4000)
+    p.add_argument("--round", default="r01")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="artifact path (default SERVE_<round>.json)")
+    p.add_argument("--budget", default=None, metavar="PATH",
+                   help="JSON gate (tools/servebench_budget.json): "
+                        "proxy-overhead p99 ceiling + the closed set of "
+                        "budgeted stages — an unbudgeted stage fails")
+    p.add_argument("--smoke", action="store_true",
+                   help="small CI preset: 2 replicas, short duration, "
+                        "coarse ladder")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.replicas = 2
+        args.slots = 2
+        args.duration = 12.0
+        args.rps_start = 1.0
+        args.rps_step = 2.0
+        args.rps_max = 9.0
+    args.lanes = [ln.strip() for ln in args.lanes.split(",") if ln.strip()]
+    bad = [ln for ln in args.lanes if ln not in LANES]
+    if bad:
+        print(f"unknown lanes {bad}; known: {list(LANES)}",
+              file=sys.stderr)
+        return 2
+
+    threshold = ttft_slo_threshold()
+    print(f"servebench: {args.replicas} sim replicas x {args.slots} "
+          f"slots, {args.tokens_per_step} tok/step, max_new "
+          f"{args.max_new}; SLO {SLO_NAME} wants TTFT p99 <= "
+          f"{threshold}s")
+    ladder = []
+    knee = None
+    crossed = False
+    rps = args.rps_start
+    while rps <= args.rps_max + 1e-9:
+        point = run_point(rps, args)
+        ladder.append(point)
+        print(f"  {rps:6.2f} rps: ttft p99 {point['ttft_s_p50']:.3f}/"
+              f"{point['ttft_s_p99']:.3f}s p50/p99, "
+              f"{point['completed']} completed, {point['shed']} shed, "
+              f"proxy overhead p99 {point['proxy_overhead_ms_p99']}ms")
+        if point["ttft_s_p99"] <= threshold:
+            knee = point
+        else:
+            crossed = True
+            break
+        rps = round(rps + args.rps_step, 6)
+
+    timeline_errors = [e for pt in ladder for e in pt["timeline_errors"]]
+    overhead_p99_ms = max(
+        (pt["proxy_overhead_ms_p99"] for pt in ladder), default=0.0)
+
+    # ------------------------------------------------------- budget gate
+    budget_ok = True
+    budget_detail = {}
+    if args.budget:
+        with open(args.budget, encoding="utf-8") as f:
+            budget = json.load(f)
+        cap = budget.get("proxy_overhead_p99_ms_max")
+        if cap is not None and overhead_p99_ms > cap:
+            budget_ok = False
+            budget_detail["proxy_overhead"] = (
+                f"{overhead_p99_ms}ms p99 > cap {cap}ms")
+        allowed = set(budget.get("budgeted_stages", []))
+        seen = {s for pt in ladder for s in pt["stage_totals"]}
+        for stage in sorted(seen - allowed):
+            budget_ok = False
+            budget_detail[stage] = (
+                "unbudgeted stage in the decomposition — add it to "
+                f"{args.budget} deliberately or kill the stage")
+
+    assertions = {
+        "timelines_valid_and_partition_latency": not timeline_errors,
+        "knee_bracketed": knee is not None and crossed,
+        "budget": budget_ok,
+    }
+    artifact = {
+        "bench": "serving-plane servebench (docs/observability.md)",
+        "round": args.round,
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+        "slo": {"name": SLO_NAME, "ttft_p99_threshold_s": threshold},
+        "config": {
+            "replicas": args.replicas, "slots": args.slots,
+            "tokens_per_step": args.tokens_per_step,
+            "prompt_len": args.prompt_len, "max_new": args.max_new,
+            "duration_s": args.duration, "tick_s": args.tick,
+            "lanes": args.lanes, "seed": args.seed,
+            "shed_high": args.shed_high,
+            "rps_ladder": [pt["rps"] for pt in ladder],
+            "python": sys.version.split()[0],
+        },
+        "headline": {
+            # the number the async-router rounds (SERVE_r02+) must move:
+            # highest offered RPS at which TTFT p99 still meets the SLO
+            "router_rps_at_slo": None if knee is None else knee["rps"],
+            "ttft_s_p99_at_knee": (None if knee is None
+                                   else knee["ttft_s_p99"]),
+            # and the number they must NOT regress while doing it
+            "proxy_overhead_p99_ms": overhead_p99_ms,
+        },
+        "decomposition_at_knee": (None if knee is None
+                                  else knee["stage_totals"]),
+        "lane_shed_at_knee": None if knee is None else knee["lane_shed"],
+        "ladder": ladder,
+        "budget_violations": budget_detail,
+        "assertions": assertions,
+    }
+    out = args.out or f"SERVE_{args.round}.json"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"\nwrote {out}")
+    if knee is not None:
+        print(f"knee: {knee['rps']} rps at SLO (ttft p99 "
+              f"{knee['ttft_s_p99']}s <= {threshold}s); proxy overhead "
+              f"p99 {overhead_p99_ms}ms")
+    failed = [name for name, ok in assertions.items() if not ok]
+    if failed:
+        print(f"FAILED assertions: {', '.join(failed)}", file=sys.stderr)
+        if timeline_errors:
+            for e in timeline_errors[:5]:
+                print(f"  timeline: {e}", file=sys.stderr)
+        return 1
+    print("all assertions hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
