@@ -12,7 +12,8 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
   docker-lint docker-lint-domain docker-cov-report docker-bench docker-dryrun
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
-  test-obs-slo test-obs-profile test-obs-request test-delta test-chaos \
+  test-obs-slo test-obs-profile test-obs-request test-obs-causes \
+  test-delta test-chaos \
   test-router test-migration test-market test-race test-resilience \
   health-sim chaos chaos-market-smoke crash crash-smoke race race-smoke \
   fleetbench fleetbench-smoke servebench servebench-smoke lint \
@@ -81,6 +82,9 @@ servebench-smoke:  ## budgeted CI gate (like fleetbench-smoke): the same harness
 	  --seed $(SERVE_SEED) --budget tools/servebench_budget.json \
 	  --out /tmp/serve_smoke.json
 
+test-obs-causes:  ## fleet black box + root-cause engine: closed event catalog, fixed-memory ring at 10k-node scale, pinned cause-ranking scenarios, chaos ground-truth recall/precision + byte-identical seed replay, /causes + status --incident over real HTTP (docs/observability.md "Incident timeline & root-cause")
+	$(PYTHON) -m pytest tests/test_causes.py -q
+
 test-delta:  ## PR 14 delta-driven reconcile: dirty-set drain vs snapshot equivalence under randomized mutations (incl. watch-lag + re-list gap), incremental BuildState oracle, no-op patch dedupe call-count pins, shard runner / budget accountant, parallel-vs-serial rollout equivalence, quiet-tick near-zero-calls pin, cached+sharded chaos seed
 	$(PYTHON) -m pytest tests/test_deltacache.py -q
 
@@ -101,7 +105,7 @@ health-sim:  ## replay the canned fault-injection scenario on the fake cluster
 
 SEEDS ?= 20
 CHAOS_FLAGS ?=
-chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). The catalog includes apiserver-blackout (fail-static degraded mode) and operator-crash (fresh-process reboot) faults, and every candidate runs behind the resilient client boundary. Runs with the informer-cached read path and the sharded reconcile ON (deterministic serial shard execution — real interleavings are `make race`'s job). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
+chaos:  ## seeded chaos campaign: N random scenarios to convergence, standing invariants asserted every tick; failures report seed + shrunk reproducer (docs/chaos.md). Every run additionally scores the alert root-cause engine against injected-fault ground truth and fails on recall < 1.0 per seed or a quiet-period page blaming a fault kind (docs/observability.md "Incident timeline & root-cause"). The catalog includes apiserver-blackout (fail-static degraded mode) and operator-crash (fresh-process reboot) faults, and every candidate runs behind the resilient client boundary. Runs with the informer-cached read path and the sharded reconcile ON (deterministic serial shard execution — real interleavings are `make race`'s job). CHAOS_FLAGS="--require-market-trade" additionally asserts >= 1 capacity-market trade across the run
 	$(PYTHON) tools/chaos_campaign.py --seeds $(SEEDS) --cached-reads \
 	  --shard-workers 2 $(CHAOS_FLAGS)
 
@@ -146,7 +150,7 @@ lint:  ## generic static analysis (tools/lint package, pyflakes-class codes — 
 # ProjectIndex parse per file (tools/lint/index.py).
 LINT_FLAGS ?=
 
-lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-003 journey/attribution/SLO closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, THR001/GRD001 thread discipline, ARC001 import layering, EXC001-003 interprocedural exception contracts, STL001 stale-read taint (docs/static-analysis.md)
+lint-domain:  ## domain-aware passes off the shared ProjectIndex: JAX001-004 jit hygiene, LCK001-004 lock discipline + cross-function lock order, DET001/002 determinism, STM001 state-machine exhaustiveness, OBS001-004 journey/attribution/SLO/timeline closure, CHS001 chaos closure, WIRE001 wire-key closure, SYN001 host-sync hygiene, THR001/GRD001 thread discipline, ARC001 import layering, EXC001-003 interprocedural exception contracts, STL001 stale-read taint (docs/static-analysis.md)
 	$(PYTHON) -m tools.lint --domain $(LINT_FLAGS)
 
 LINT_BUDGET ?= 60

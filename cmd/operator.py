@@ -48,6 +48,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import DriverUpgradePolicySpec  # noqa: 
 from k8s_operator_libs_tpu.health import metrics as health_metrics  # noqa: E402
 from k8s_operator_libs_tpu.health.monitor import HealthOptions  # noqa: E402
 from k8s_operator_libs_tpu.obs import JsonlSink, MetricsHub, Tracer  # noqa: E402
+from k8s_operator_libs_tpu.obs.causes import causes_payload  # noqa: E402
 from k8s_operator_libs_tpu.obs.profile import (TickProfiler,  # noqa: E402
                                                counting_client)
 from k8s_operator_libs_tpu.obs.slo import SLOOptions  # noqa: E402
@@ -279,7 +280,8 @@ class MetricsServer:
     def __init__(self, port: int):
         self.snapshot = {"text": "", "healthy": False,
                          "slo": None, "alerts": None, "profile": None,
-                         "market": None, "resilience": None}
+                         "market": None, "resilience": None,
+                         "causes": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -296,7 +298,7 @@ class MetricsServer:
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
                 elif self.path in ("/slo", "/alerts", "/profile",
-                                   "/market", "/resilience"):
+                                   "/market", "/resilience", "/causes"):
                     payload = snapshot[self.path[1:]]
                     if payload is None:
                         body = {
@@ -305,6 +307,8 @@ class MetricsServer:
                                 b'{"error": "market arbiter disabled"}',
                             "/resilience":
                                 b'{"error": "resilience disabled"}',
+                            "/causes":
+                                b'{"error": "no tick completed yet"}',
                         }.get(self.path,
                               b'{"error": "slo engine disabled"}')
                         ctype, code = "application/json", 404
@@ -651,6 +655,13 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if operator.slo_engine is not None:
                     server.snapshot["slo"] = slo_payload(operator)
                     server.snapshot["alerts"] = alerts_payload(operator)
+                # the fleet black box is always on (like journey
+                # annotations); without the SLO engine the reports list
+                # is empty but the timeline still serves
+                server.snapshot["causes"] = json.dumps(
+                    {"kind": "causes",
+                     "data": causes_payload(operator.cause_analyzer,
+                                            operator.timeline)})
                 if profiler is not None:
                     server.snapshot["profile"] = json.dumps(
                         {"kind": "profile", "data": profiler.payload()})

@@ -51,6 +51,11 @@ HTTP surface (stdlib ThreadingHTTPServer; every JSON endpoint speaks the
   across hops. Requests inherit an ``X-TPU-Trace`` header (or a
   ``"trace"`` field in the POST body) when the caller supplies one;
   a garbled header degrades to a fresh root trace, never an error.
+- ``GET  /causes``    → the router-side fleet black box
+  (obs/timeline.py): drain/shed/migration/requeue events with ring
+  accounting, in the same ``{"kind": "causes"}`` envelope the operator
+  serves (the router evaluates no alerts, so its reports list is
+  empty) — docs/observability.md "Incident timeline & root-cause".
 - ``GET  /healthz``   → 200 while at least one replica admits, else 503.
 
 The queue-depth half of the autoscaler runs in-process (scale decisions
@@ -73,6 +78,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
 from k8s_operator_libs_tpu.core.client import ApiError  # noqa: E402
+from k8s_operator_libs_tpu.obs.causes import causes_payload  # noqa: E402
 from k8s_operator_libs_tpu.obs.reqtrace import (  # noqa: E402
     TRACE_HEADER, parse_trace_header)
 from k8s_operator_libs_tpu.utils import threads  # noqa: E402
@@ -180,6 +186,7 @@ class RouterFront:
                  selfclock=None):
         from k8s_operator_libs_tpu.obs.reqtrace import (
             RequestTraceRecorder)
+        from k8s_operator_libs_tpu.obs.timeline import FleetTimeline
         from k8s_operator_libs_tpu.serving.router import PREFIX_KEY_TOKENS
         from k8s_operator_libs_tpu.utils.clock import RealClock
         self.pool = pool
@@ -212,9 +219,13 @@ class RouterFront:
         # (self-time measured on a real performance counter, separate
         # from the injected stage clock so virtual-clock harnesses stay
         # deterministic)
+        # the router-side fleet black box: drain/shed/migration/requeue
+        # edges land here via the recorder, served by GET /causes
+        self.timeline = FleetTimeline(clock=self._clock)
         self.reqtrace = RequestTraceRecorder(
             clock=self._clock, metrics=metrics,
-            selfclock=selfclock or _time.perf_counter)
+            selfclock=selfclock or _time.perf_counter,
+            timeline=self.timeline)
         self._rid_counter = 0
 
     def _mint_rid(self):
@@ -683,6 +694,10 @@ def make_handler(front, pool, hub, autoscaler=None):
             elif self.path == "/requests":
                 self._json(200, {"kind": "requests",
                                  "data": front.reqtrace.payload()})
+            elif self.path == "/causes":
+                self._json(200, {"kind": "causes",
+                                 "data": causes_payload(
+                                     None, front.timeline)})
             elif self.path.startswith("/trace"):
                 query = urllib.parse.urlparse(self.path).query
                 params = urllib.parse.parse_qs(query)

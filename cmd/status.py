@@ -61,15 +61,31 @@ last N arbiter decisions with their burn-vs-goodput rationale
 
     python cmd/status.py --market --operator-url http://operator:8080
 
+``--incident <alert-or-rule>`` renders the ROOT-CAUSE ENGINE's newest
+CauseReport for that alert rule (or SLO name) fetched from the
+operator's ``/causes`` endpoint: the ranked candidate causes (score =
+burn-window overlap × entity-distance decay × kind prior) with the raw
+fleet-timeline evidence behind the leading cause
+(docs/observability.md "Incident timeline & root-cause"):
+
+    python cmd/status.py --incident serving-ttft-p99 \
+        --operator-url http://operator:8080
+
+The ``--watch`` dashboard additionally shows the top firing alert's
+leading cause as a one-line banner (next to the DEGRADED banner), e.g.
+``PAGE serving-ttft-p99 ← health-verdict node/v5p-7 (crashloop)``.
+
 ``--json`` always emits one ``{"kind": <view>, "data": ...}`` envelope
 (kinds: ``timeline``, ``goodput``, ``slo``, ``alerts``, ``replicas``,
-``profile``, ``market``).
+``profile``, ``market``; ``--incident`` emits the operator's
+``causes`` envelope verbatim).
 
 Exit code: 0 when every managed node is upgrade-done (or unmanaged), 3
 while an upgrade is in flight, 4 if any node is upgrade-failed — so CI
 gates and scripts can wait on it. ``--timeline``, ``--goodput``,
-``--slo``, ``--alerts``, ``--replicas``, ``--profile``, and
-``--market`` always exit 0 (except 2 when the endpoint is unreachable).
+``--slo``, ``--alerts``, ``--replicas``, ``--profile``, ``--market``,
+and ``--incident`` always exit 0 (except 2 when the endpoint is
+unreachable).
 """
 
 import argparse
@@ -410,13 +426,42 @@ def run_resilience_view(args, fetch=fetch_view) -> int:
     return 0
 
 
+def cause_banner(alerts_data, operator_url: str, fetch=fetch_view):
+    """The root-cause banner: one line naming the top firing alert's
+    leading cause from the operator's /causes report ring (the server
+    pre-sorts alerts firing-first, so the first firing row IS the top
+    one). Best-effort exactly like degraded_banner — unreachable
+    endpoint, no report, or an empty cause list just means no banner."""
+    firing = [a for a in alerts_data or [] if a.get("state") == "firing"]
+    if not firing:
+        return None
+    rule = firing[0].get("rule")
+    try:
+        data = fetch(operator_url, "/causes").get("data") or {}
+    except Exception:  # exc: allow — the banner is best-effort; unreachable just means no banner
+        return None
+    for report in reversed(data.get("reports") or []):
+        if report.get("rule") != rule:
+            continue
+        causes = report.get("causes") or []
+        if not causes:
+            return None
+        top = causes[0]
+        return (f"{(report.get('severity') or 'page').upper()} "
+                f"{report.get('slo') or rule} ← {top['kind']} "
+                f"{top['entity']} ({(top.get('detail') or '-')[:60]})")
+    return None
+
+
 def render_dashboard(slo_data, alerts_data, operator_url: str,
                      fetch=fetch_view) -> str:
     stamp = datetime.datetime.now(tz=datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M:%S UTC")
     banner = degraded_banner(operator_url, fetch=fetch)
+    cause = cause_banner(alerts_data, operator_url, fetch=fetch)
     return "\n".join(
         ([banner] if banner else [])
+        + ([cause] if cause else [])
         + [
             f"tpu-operator fleet SLOs  ({operator_url}, {stamp})",
             "",
@@ -655,6 +700,89 @@ def run_market_view(args, fetch=fetch_view) -> int:
     return 0
 
 
+def render_incident(report) -> str:
+    """One CauseReport: the header (rule/slo/severity/burn window), the
+    ranked candidate-cause table (score = overlap × distance decay ×
+    kind prior — docs/observability.md "Incident timeline &
+    root-cause"), then the raw timeline evidence behind the leading
+    cause."""
+    fired = datetime.datetime.fromtimestamp(
+        report["fired_at"], tz=datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%S UTC")
+    lines = [f"incident {report['id']}  "
+             f"({report['severity'].upper()} {report['rule']})",
+             f"slo: {report['slo']}  fired: {fired}  burn window: "
+             f"{_fmt_duration(report['window_s'])}  families: "
+             f"{', '.join(report.get('families') or []) or '-'}"]
+    causes = report.get("causes") or []
+    if not causes:
+        lines.append("no candidate causes inside the burn window "
+                     "(timeline empty, or nothing touched the alert's "
+                     "entity scope)")
+        return "\n".join(lines)
+    headers = ("RANK", "SCORE", "KIND", "ENTITY", "OVERLAP", "HOPS",
+               "DETAIL")
+    table = []
+    for c in causes:
+        hops = "-" if c.get("distance", -1) < 0 else str(c["distance"])
+        table.append((str(c["rank"]), f"{c['score']:.3f}", c["kind"],
+                      c["entity"], f"{c['overlap']:.2f}", hops,
+                      (c.get("detail") or "-")[:48]))
+    widths = [max(len(h), *(len(t[i]) for t in table))
+              for i, h in enumerate(headers)]
+    lines.append("")
+    lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for t in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(t, widths)))
+    evidence = causes[0].get("evidence") or []
+    if evidence:
+        lines.append("")
+        lines.append(f"evidence behind the leading cause "
+                     f"({causes[0]['kind']} {causes[0]['entity']}):")
+        for ev in evidence:
+            stamp = datetime.datetime.fromtimestamp(
+                ev["t"], tz=datetime.timezone.utc).strftime(
+                "%Y-%m-%d %H:%M:%S")
+            span = ("" if ev.get("until") is None else
+                    f" (+{_fmt_duration(ev['until'] - ev['t'])})")
+            lines.append(f"  {stamp}{span}  {ev['kind']:<18} "
+                         f"{ev['entity']}  {ev.get('detail') or '-'}")
+    return "\n".join(lines)
+
+
+def run_incident_view(args, fetch=fetch_view) -> int:
+    """--incident: fetch the operator's /causes envelope and render the
+    newest CauseReport whose rule or SLO matches the query (exit 2 when
+    the endpoint is unreachable, like the other HTTP views; exit 0 with
+    a hint when no report matches — an incident view must not fail the
+    pipeline that is trying to debug one)."""
+    try:
+        env = fetch(args.operator_url, "/causes")
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
+        print(f"error: cannot read {args.operator_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+        return 0
+    reports = (env.get("data") or {}).get("reports") or []
+    query = args.incident
+    match = None
+    for report in reversed(reports):
+        if (query in (report.get("rule"), report.get("slo"))
+                or (report.get("rule") or "").startswith(query + ":")):
+            match = report
+            break
+    if match is None:
+        known = sorted({r["rule"] for r in reports if r.get("rule")})
+        print(f"no cause report for {query!r}"
+              + (f" (rules with reports: {', '.join(known)})" if known
+                 else " (no alert has fired yet)"))
+        return 0
+    print(render_incident(match))
+    return 0
+
+
 def render_replicas(data) -> str:
     """One row per serving replica from the router's /replicas view."""
     replicas = data.get("replicas") or []
@@ -847,6 +975,10 @@ def main(argv=None, client=None, now=None) -> int:
                    help="render the capacity arbiter's lane depths, "
                         "slice ownership and recent decisions from a "
                         "running operator's /market endpoint")
+    p.add_argument("--incident", default=None, metavar="ALERT",
+                   help="render the root-cause engine's newest "
+                        "CauseReport for this alert rule or SLO name "
+                        "from a running operator's /causes endpoint")
     p.add_argument("--replicas", action="store_true",
                    help="render the serving router's replica registry "
                         "from a running cmd/router.py")
@@ -877,6 +1009,10 @@ def main(argv=None, client=None, now=None) -> int:
         # breaker state + degraded-mode posture: the operator's HTTP
         # view (docs/resilience.md)
         return run_resilience_view(args)
+    if args.incident is not None:
+        # the cause engine lives in the operator process; its report
+        # ring is the authoritative state, so this is an HTTP view too
+        return run_incident_view(args)
     if args.profile:
         # the flight recorder lives in the operator process; its ring is
         # the authoritative state, so this is an HTTP view too

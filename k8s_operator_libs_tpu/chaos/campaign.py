@@ -41,10 +41,12 @@ from ..health.monitor import HealthOptions
 from ..health.remediation import RemediationPolicy
 from ..market import (SERVING, TRAINING, CapacityArbiter, ManagedSlice,
                       MarketConfig)
+from ..obs.causes import CauseAnalyzer
 from ..obs.goodput import GoodputLedger
 from ..obs.metrics import MetricsHub
 from ..obs.profile import TickProfiler, counting_client
 from ..obs.slo import SLOOptions
+from ..obs.timeline import FleetEvent, FleetTimeline
 from ..obs.trace import Tracer
 from ..serving.pool import DRAIN_STATES, Replica, ReplicaPool
 from ..obs.reqtrace import RequestTraceRecorder
@@ -57,7 +59,7 @@ from ..upgrade.consts import UpgradeState
 from ..upgrade.util import KeyFactory
 from ..utils.clock import FakeClock
 from ..wire import MARKET_OWNER_LABEL, QUARANTINE_LABEL
-from .faults import RECLAIM_TAINT_KEY
+from .faults import RECLAIM_TAINT_KEY, fault_entities
 from .injector import ChaosInjector
 from .invariants import (CampaignView, Invariant, Violation,
                          default_invariants)
@@ -115,6 +117,16 @@ class CampaignResult:
     # reqtrace=True (None otherwise) — the timeline-determinism test
     # compares these across reruns of the same seed
     reqtrace_payload: Optional[dict] = None
+    # per-incarnation CauseReport lists (identity#incarnation ->
+    # reports), frozen at kill time like final_alert_status — the
+    # attribution-determinism test compares these across same-seed
+    # reruns byte for byte
+    cause_reports: Optional[Dict[str, list]] = None
+    # the root-cause engine scored against injected-fault ground truth:
+    # recall (fault-overlapped pages must rank the faulted entity in
+    # their top 3) and precision (quiet-period pages must not blame
+    # chaos-fault) — tools/chaos_campaign.py gates on this
+    attribution: Optional[dict] = None
 
     @property
     def failed(self) -> bool:
@@ -126,6 +138,14 @@ class CampaignResult:
                  f"ticks={self.ticks} modelled={self.modelled_s:.0f}s "
                  f"failovers={self.failovers} crashes={self.crashes} "
                  f"violations={len(self.violations)}"]
+        if self.attribution is not None:
+            a = self.attribution
+            lines.append(
+                f"  attribution: pages={a['pages']} "
+                f"fault-overlapped={a['fault_pages']} "
+                f"recall={a['recall']:.2f} quiet={a['quiet_pages']} "
+                f"precision={'ok' if a['precision_ok'] else 'VIOLATED'}")
+            lines += [f"    MISS {m}" for m in a["misses"]]
         if self.failed:
             if not self.converged:
                 lines.append("  did NOT converge")
@@ -301,8 +321,13 @@ class ServingTier:
         # injected clock and mints ids from a counter — pure accounting,
         # so a reqtrace=False run of the same seed is byte-identical
         # (tests/test_reqtrace.py pins it, like run_scenario(profile=...))
+        # It feeds the router-side fleet black box (obs/timeline.py):
+        # drain/shed/migration/requeue edges become timeline events,
+        # exactly like cmd/router.py wires them in production.
+        self.timeline = FleetTimeline(clock=clock) if reqtrace else None
         recorder = RequestTraceRecorder(clock=clock,
-                                        metrics=self.metrics) \
+                                        metrics=self.metrics,
+                                        timeline=self.timeline) \
             if reqtrace else None
         self.router = RequestRouter(self.pool, metrics=self.metrics,
                                     clock=clock,
@@ -598,6 +623,10 @@ def run_scenario(scenario: Scenario, seed: int,
         op = _make_operator(client, cluster.recorder, clock,
                             scenario.max_unavailable, tracer=tracer,
                             shard_workers=shard_workers, resilience=res)
+        # every candidate's fleet black box sees every injected fault —
+        # the labeled ground truth its cause reports are scored against
+        # (a reboot gets already-applied faults replayed in, backdated)
+        injector.attach_timeline(identity, op.timeline)
         return elector, op
 
     candidates: Dict[str, tuple] = {
@@ -615,6 +644,10 @@ def run_scenario(scenario: Scenario, seed: int,
                  scenario.fleet.slice_hosts(0)[-1], clock)
     tier = ServingTier(cluster, clock, injector, scenario.fleet, seed,
                        reqtrace=reqtrace)
+    if tier.timeline is not None:
+        # the router-side black box sees the injected faults too, like
+        # the operator candidates' timelines
+        injector.attach_timeline("router", tier.timeline)
     checks = invariants if invariants is not None else default_invariants()
     budget = scaled_int_or_percent(scenario.max_unavailable,
                                    len(fleet_nodes), round_up=True)
@@ -629,6 +662,10 @@ def run_scenario(scenario: Scenario, seed: int,
             vacated=lambda ms: not job.running,
             grant=tier.grant_burst, revoke=tier.revoke_burst,
             recorder=cluster.recorder, clock=clock,
+            # trade decisions land in the candidate's own black box —
+            # the arbiter only ticks under the current leader, so the
+            # leader's timeline carries the market-trade events
+            timeline=candidates[identity][1].timeline,
             config=MarketConfig(preempt_rate=1.5, return_rate=0.4,
                                 sustain_ticks=3, cooldown_seconds=60.0,
                                 budget=budget))
@@ -659,6 +696,10 @@ def run_scenario(scenario: Scenario, seed: int,
     # transitions (and the Events they emitted) must still be observed
     # exactly once by the alert/event-dedup invariants
     final_alert_status: Dict[str, list] = {}
+    # likewise its final cause reports: every firing edge an
+    # incarnation attributed must still be scored (and replay
+    # byte-identically), crashes included
+    final_cause_reports: Dict[str, list] = {}
 
     def kill(identity: str, reason: str) -> None:
         nonlocal crashes
@@ -668,6 +709,10 @@ def run_scenario(scenario: Scenario, seed: int,
             final_alert_status[
                 f"{identity}#{incarnations[identity]}"] = \
                 dying.alert_manager.status()
+        if dying.cause_analyzer is not None:
+            final_cause_reports[
+                f"{identity}#{incarnations[identity]}"] = \
+                list(dying.cause_analyzer.reports)
         incarnations[identity] += 1
         dead.add(identity)
         injector.trace.append(
@@ -816,6 +861,12 @@ def run_scenario(scenario: Scenario, seed: int,
         job.close()
         if tmp is not None:
             tmp.cleanup()
+    cause_reports = {
+        **final_cause_reports,
+        **{f"{identity}#{incarnations[identity]}":
+           list(op.cause_analyzer.reports)
+           for identity, (_, op) in candidates.items()
+           if identity not in dead and op.cause_analyzer is not None}}
     return CampaignResult(
         scenario=scenario.name, seed=seed, converged=converged,
         ticks=tick + 1, modelled_s=clock.now() - 10_000.0,
@@ -838,7 +889,9 @@ def run_scenario(scenario: Scenario, seed: int,
         profile_payloads={identity: p.payload()
                           for identity, p in profilers.items()} or None,
         reqtrace_payload=(tier.router.reqtrace.payload()
-                          if tier.router.reqtrace is not None else None))
+                          if tier.router.reqtrace is not None else None),
+        cause_reports=cause_reports,
+        attribution=_score_attribution(cause_reports, injector))
 
 
 def _converged(cluster: FakeCluster, keys: KeyFactory,
@@ -869,6 +922,68 @@ def _converged(cluster: FakeCluster, keys: KeyFactory,
                 "controller-revision-hash") != "v2":
             return False
     return job.running
+
+
+def _score_attribution(cause_reports: Dict[str, list],
+                       injector: ChaosInjector) -> dict:
+    """Score the cause engine against injected-fault ground truth.
+
+    RECALL: every PAGE report whose burn window overlaps an injected
+    fault window must rank an event on one of that fault's entities
+    (:func:`~.faults.fault_entities`) among its top-3 causes.
+    PRECISION: a page with NO overlapping fault must not rank
+    ``chaos-fault`` in its top 3.  "Overlaps" is decided by the cause
+    engine's own overlap arithmetic (a synthetic chaos-fault event over
+    the fault window), so ground truth and engine can never disagree
+    about edge-grazing windows.  Everything runs on the injected clock
+    over deterministic inputs, so the stats replay byte-identically."""
+    windows = [(injector.t0 + ev.at, injector.t0 + ev.until, ev)
+               for ev in injector.events]
+    pages = fault_pages = hits = quiet = 0
+    misses: List[str] = []
+    precision_ok = True
+    for key in sorted(cause_reports):
+        for report in cause_reports[key]:
+            if report["severity"] != "page":
+                continue
+            pages += 1
+            fired_at = report["fired_at"]
+            since = fired_at - report["window_s"]
+            overlapping = [
+                ev for start, end, ev in windows
+                if CauseAnalyzer._overlap(
+                    FleetEvent(seq=0, kind="chaos-fault", entity="",
+                               t=start, until=end),
+                    since, fired_at) > 0.0]
+            top = {c["entity"] for c in report["causes"][:3]}
+            if overlapping:
+                fault_pages += 1
+                if any(set(fault_entities(ev)) & top
+                       for ev in overlapping):
+                    hits += 1
+                else:
+                    misses.append(
+                        f"{key} {report['id']}: top-3 causes "
+                        f"{sorted(top)} name no faulted entity of "
+                        + "; ".join(ev.describe() for ev in overlapping))
+            else:
+                quiet += 1
+                blamed = [c["entity"] for c in report["causes"][:3]
+                          if c["kind"] == "chaos-fault"]
+                if blamed:
+                    precision_ok = False
+                    misses.append(
+                        f"{key} {report['id']}: quiet-period page "
+                        f"blames chaos-fault on {blamed}")
+    return {
+        "pages": pages,
+        "fault_pages": fault_pages,
+        "recall_hits": hits,
+        "recall": round(hits / fault_pages, 6) if fault_pages else 1.0,
+        "quiet_pages": quiet,
+        "precision_ok": precision_ok,
+        "misses": misses,
+    }
 
 
 def shrink_failure(scenario: Scenario, seed: int,

@@ -115,7 +115,31 @@ FAULT_TYPES = (
 RECLAIM_TAINT_EFFECT = "NoSchedule"
 
 __all__ = ["FAULT_TYPES", "FaultEvent", "RECLAIM_DEADLINE_ANNOTATION",
-           "RECLAIM_TAINT_EFFECT", "RECLAIM_TAINT_KEY"]
+           "RECLAIM_TAINT_EFFECT", "RECLAIM_TAINT_KEY", "fault_entities"]
+
+# fault types that hit the whole control/data plane rather than listed
+# nodes — mapped to the fleet-global timeline entities the attribution
+# scorer matches against (obs/causes.py ALWAYS_SCOPES)
+_GLOBAL_FAULT_ENTITIES = {
+    "apiserver-latency": ("apiserver/cluster",),
+    "apiserver-flake": ("apiserver/cluster",),
+    "conflict-storm": ("apiserver/cluster",),
+    "watch-lag": ("apiserver/cluster",),
+    "apiserver-blackout": ("apiserver/cluster",),
+    "leader-loss": ("operator/leader",),
+    "operator-crash": ("operator/leader",),
+    "flash-crowd": ("lane/fleet",),
+}
+
+
+def fault_entities(ev: "FaultEvent") -> List[str]:
+    """The timeline entities an injected fault acts on — the GROUND
+    TRUTH side of the attribution score (chaos/campaign.py): a page
+    whose burn window overlaps ``ev`` must rank an event on one of
+    these entities (or a descendant) in its top causes."""
+    if ev.targets:
+        return [f"node/{t}" for t in ev.targets]
+    return list(_GLOBAL_FAULT_ENTITIES.get(ev.type, ("operator/self",)))
 
 
 @dataclasses.dataclass

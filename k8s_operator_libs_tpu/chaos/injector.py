@@ -33,7 +33,8 @@ from typing import Dict, List, Optional, Set
 from ..core.client import ConflictError, ServerError
 from ..utils.clock import Clock
 from .faults import (FAULT_TYPES, RECLAIM_DEADLINE_ANNOTATION,
-                     RECLAIM_TAINT_EFFECT, RECLAIM_TAINT_KEY, FaultEvent)
+                     RECLAIM_TAINT_EFFECT, RECLAIM_TAINT_KEY, FaultEvent,
+                     fault_entities)
 
 logger = logging.getLogger(__name__)
 
@@ -115,6 +116,10 @@ class ChaosInjector:
         # crash-restart explorer hook (tools/crash): object with
         # before_write/after_write, installed by run_scenario
         self.write_gate = None
+        # fleet black boxes (obs/timeline.py) by candidate identity:
+        # every applied fault is recorded as a chaos-fault event — the
+        # labeled ground truth the attribution score is computed against
+        self.timelines: Dict[str, object] = {}
         # operator-crash kills due this tick: identity, or None for
         # "whoever currently leads" — the campaign drains these after
         # injector.tick() and reboots the victim as a fresh process
@@ -124,6 +129,31 @@ class ChaosInjector:
 
     def client(self, identity: str = "") -> ChaosClient:
         return ChaosClient(self, self.cluster.client, identity)
+
+    @property
+    def t0(self) -> float:
+        """Campaign start on the injected clock; ``self.events`` fault
+        times are modelled seconds relative to this (the attribution
+        scorer rebases them to absolute timeline time)."""
+        return self._t0
+
+    def attach_timeline(self, identity: str, timeline) -> None:
+        """Attach a candidate operator's FleetTimeline. Faults already
+        applied are replayed in, backdated to their injection time — a
+        rebooted operator's fresh timeline must still see the fault
+        that predates it, or its post-reboot pages would attribute to
+        nothing (the labels-survive-a-crash discipline, applied to the
+        black box)."""
+        self.timelines[identity] = timeline
+        for i in sorted(self._applied):
+            self._record_fault(timeline, self.events[i])
+
+    def _record_fault(self, timeline, ev: FaultEvent) -> None:
+        for entity in fault_entities(ev):
+            timeline.record_event(kind="chaos-fault", entity=entity,
+                                  t=self._t0 + ev.at,
+                                  until=self._t0 + ev.until,
+                                  detail=ev.describe())
 
     def _log(self, msg: str) -> None:
         self.trace.append(f"t={self.clock.now() - self._t0:7.1f}s  {msg}")
@@ -280,6 +310,8 @@ class ChaosInjector:
 
     def _apply(self, idx: int, ev: FaultEvent) -> None:
         self._log(f"INJECT {ev.describe()}")
+        for timeline in self.timelines.values():
+            self._record_fault(timeline, ev)
         if ev.type == "driver-crashloop":
             restarts = int(ev.params.get("restart_count", 12))
             broken: List[str] = []

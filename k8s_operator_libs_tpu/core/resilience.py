@@ -120,6 +120,11 @@ class CircuitBreaker:
         self.open_seconds = open_seconds
         self.half_open_successes = max(1, half_open_successes)
         self._metrics = metrics
+        # duck-typed fleet black box (obs/timeline.py FleetTimeline —
+        # not imported: core sits below obs in the layering); bound by
+        # the operator so breaker open/close edges land on the unified
+        # timeline the root-cause engine walks
+        self._timeline = None
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -187,12 +192,29 @@ class CircuitBreaker:
         if state != self._state:
             logger.info("apiserver circuit breaker %s -> %s",
                         self._state, state)
+            if self._timeline is not None and state in (OPEN, CLOSED):
+                # half-open probing is internal churn; only the outage
+                # edges matter for root-cause attribution
+                if state == OPEN:
+                    self._timeline.record_event(
+                        kind="breaker-open", entity="breaker/apiserver",
+                        detail=f"after {self._consecutive_failures} "
+                               f"consecutive failures")
+                else:
+                    self._timeline.record_event(
+                        kind="breaker-close",
+                        entity="breaker/apiserver",
+                        detail="probe succeeded; traffic restored")
         self._state = state
         self._publish()
 
     def bind_metrics(self, metrics) -> None:
         self._metrics = metrics
         self._publish()
+
+    def bind_timeline(self, timeline) -> None:
+        """Late-bind a FleetTimeline (duck-typed — see ctor note)."""
+        self._timeline = timeline
 
     def _publish(self) -> None:
         if self._metrics is not None:
@@ -312,6 +334,11 @@ class ResilientClient:
         self._metrics = metrics
         self.breaker.bind_metrics(metrics)
         self.limiter.bind_metrics(metrics)
+
+    def bind_timeline(self, timeline) -> None:
+        """Late-bind the fleet timeline onto the breaker (the operator
+        calls this; core never imports obs)."""
+        self.breaker.bind_timeline(timeline)
 
     def probe(self) -> bool:
         """One cheap gated read (a label-scoped node LIST matching

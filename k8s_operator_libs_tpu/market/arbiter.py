@@ -185,7 +185,8 @@ class CapacityArbiter:
                  returned: Optional[Callable] = None,
                  recorder=None, metrics=None,
                  clock: Optional[Clock] = None,
-                 config: Optional[MarketConfig] = None):
+                 config: Optional[MarketConfig] = None,
+                 timeline=None):
         from ..upgrade.util import KeyFactory
         self.supply = list(supply)
         self._client = client
@@ -199,6 +200,10 @@ class CapacityArbiter:
         self._recorder = recorder
         self._metrics = metrics
         self._clock = clock or RealClock()
+        # fleet black box (obs/timeline.py): every trade-phase decision
+        # is a timeline event (entity trade/<id>, linked to its slice) —
+        # a deliberate capacity move is a prime root-cause candidate
+        self._timeline = timeline
         self.config = config or MarketConfig()
         self.decisions: List[Dict] = []
         self.trades = 0
@@ -356,6 +361,12 @@ class CapacityArbiter:
                     "reason": reason}
         self.decisions.append(decision)
         del self.decisions[:-self.config.decisions_kept]
+        if self._timeline is not None:
+            entity = f"trade/{ms.decision_id}"
+            self._timeline.link(entity, f"slice/{ms.slice_id}")
+            self._timeline.record_event(
+                kind="market-trade", entity=entity,
+                detail=f"{action} {ms.slice_id}: {reason}")
         self._last_decision_t = self._clock.now()
         self._stamp(ms)
         if action == "preempt":

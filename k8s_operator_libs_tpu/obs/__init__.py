@@ -42,7 +42,19 @@ al., 2010) and the time-series-first philosophy of Borgmon/Prometheus:
                     rate evaluation;
 - :mod:`.alerts`  — ``for:``-duration pending→firing→resolved alert
                     rules with dedup, Kubernetes Events, and the
-                    ``alert_firing`` gauge.
+                    ``alert_firing`` gauge;
+- :mod:`.timeline` — the fleet BLACK BOX: one fixed-memory, clock-
+                    injected event store ingesting every state
+                    transition (journeys, health verdicts, alerts,
+                    trades, router drains/sheds/migrations, breaker
+                    flips, DEGRADED mode, chaos faults) as
+                    ``FleetEvent``s over the closed ``EVENT_KINDS``
+                    catalog, plus the entity graph linking them;
+- :mod:`.causes`  — the ROOT-CAUSE engine: on every alert firing edge,
+                    walk the entity graph over the burn window and rank
+                    candidate causes by overlap × distance decay × kind
+                    prior into a ``CauseReport`` (scored against chaos
+                    ground truth — docs/observability.md).
 
 Layering: ``obs`` sits BELOW ``upgrade``/``health``/``tpu`` (they import
 it, never the reverse), so the journey thresholds are keyed by the state
@@ -51,6 +63,7 @@ WIRE VALUES — the OBS001 lint pass proves that table stays closed over
 """
 
 from .alerts import AlertManager, AlertRule
+from .causes import CAUSE_PRIORS, CauseAnalyzer, causes_payload
 from .attribution import (WINDOW_PHASES, WindowBreakdown,
                           attribute_downtime, downtime_summary,
                           slice_window, windows_from_journey)
@@ -63,6 +76,7 @@ from .profile import (HANDLER_STATES, TickProfiler, build_profile,
                       counting_client)
 from .slo import (DEFAULT_BURN_WINDOWS, DEFAULT_SLO_SPECS, BurnWindow,
                   SLOEngine, SLOOptions, SLOSpec, parse_duration)
+from .timeline import EVENT_KINDS, FleetEvent, FleetTimeline
 from .trace import JsonlSink, ListSink, NullSink, Span, Tracer
 from .tsdb import TimeSeriesStore, quantile_from_buckets
 
@@ -77,5 +91,7 @@ __all__ = [
     "DEFAULT_BURN_WINDOWS", "DEFAULT_SLO_SPECS", "BurnWindow",
     "SLOEngine", "SLOOptions", "SLOSpec", "parse_duration",
     "AlertManager", "AlertRule",
+    "EVENT_KINDS", "FleetEvent", "FleetTimeline",
+    "CAUSE_PRIORS", "CauseAnalyzer", "causes_payload",
     "HANDLER_STATES", "TickProfiler", "build_profile", "counting_client",
 ]

@@ -42,6 +42,7 @@ RESOLVED = "resolved"
 
 FIRING_EVENT_REASON = "SLOAlertFiring"
 RESOLVED_EVENT_REASON = "SLOAlertResolved"
+ATTRIBUTED_EVENT_REASON = "SLOAlertAttributed"
 
 # gauge families emitted through the hub (full exposed names; literal —
 # OBS003 closes this over HELP_TEXTS in both directions)
@@ -88,11 +89,27 @@ class AlertManager:
     writer, HTTP handlers only read :meth:`status`)."""
 
     def __init__(self, clock: Optional[Clock] = None, metrics=None,
-                 recorder=None):
+                 recorder=None, causes=None, timeline=None):
         self._clock = clock or RealClock()
         self._metrics = metrics
         self._recorder = recorder
+        # optional black-box wiring (obs/timeline.py, obs/causes.py):
+        # every state transition is recorded on the timeline, and each
+        # pending→firing edge triggers exactly one root-cause
+        # attribution — the same structural dedup the Events use
+        self._causes = causes
+        self._timeline = timeline
         self._states: Dict[str, Dict[str, Any]] = {}
+
+    def _alert_entity(self, rule: AlertRule) -> str:
+        """Timeline entity for a rule, linked alert→SLO in the entity
+        graph (the causes engine walks the other direction, SLO→metric
+        families, but the link makes ``--incident`` renders coherent)."""
+        entity = f"alert/{rule.name}"
+        slo = rule.labels.get("slo")
+        if slo:
+            self._timeline.link(entity, f"slo/{slo}")
+        return entity
 
     # --------------------------------------------------------- evaluation
 
@@ -117,6 +134,7 @@ class AlertManager:
                     "resolved_at": None,
                     "message": "",
                     "events_emitted": 0,
+                    "cause_id": None,
                 }
             st["for_s"] = rule.for_s
             if active:
@@ -124,6 +142,11 @@ class AlertManager:
                 if st["state"] in (INACTIVE, RESOLVED):
                     st["state"] = PENDING
                     st["pending_since"] = now
+                    if self._timeline is not None:
+                        self._timeline.record_event(
+                            kind="alert-pending",
+                            entity=self._alert_entity(rule),
+                            detail=st["message"])
                 if (st["state"] == PENDING
                         and now - st["pending_since"] >= rule.for_s):
                     st["state"] = FIRING
@@ -135,6 +158,12 @@ class AlertManager:
                                f"alert {rule.name} firing")
                     logger.warning("alert %s FIRING: %s", rule.name,
                                    st["message"])
+                    if self._timeline is not None:
+                        self._timeline.record_event(
+                            kind="alert-firing",
+                            entity=self._alert_entity(rule),
+                            detail=st["message"])
+                    self._attribute(rule, st, now)
             else:
                 if st["state"] == PENDING:
                     # never fired: no event owed, drop back silently
@@ -143,10 +172,21 @@ class AlertManager:
                 elif st["state"] == FIRING:
                     st["state"] = RESOLVED
                     st["resolved_at"] = now
+                    # a resolved incident is self-describing: firing
+                    # duration plus the attributed cause id, so nobody
+                    # has to re-query /causes from `kubectl get events`
+                    resolved_msg = (f"alert {rule.name} resolved after "
+                                    f"{now - st['firing_since']:.0f}s")
+                    if st.get("cause_id"):
+                        resolved_msg += f" (cause {st['cause_id']})"
                     self._emit(rule, "Normal", RESOLVED_EVENT_REASON,
-                               f"alert {rule.name} resolved after "
-                               f"{now - st['firing_since']:.0f}s")
+                               resolved_msg)
                     logger.info("alert %s resolved", rule.name)
+                    if self._timeline is not None:
+                        self._timeline.record_event(
+                            kind="alert-resolved",
+                            entity=self._alert_entity(rule),
+                            detail=resolved_msg)
         if self._metrics is not None:
             for st in self._states.values():
                 self._metrics.set_gauge(
@@ -154,6 +194,35 @@ class AlertManager:
                     1.0 if st["state"] == FIRING else 0.0,
                     labels={"rule": st["rule"],
                             "severity": st["severity"]})
+
+    def _attribute(self, rule: AlertRule, st: Dict[str, Any],
+                   now: float) -> None:
+        """Exactly one root-cause attribution per pending→firing edge
+        (the same structural dedup as the firing Event — this runs only
+        inside that transition): build the CauseReport, stamp its id on
+        the rule state, and emit one ``SLOAlertAttributed`` Event naming
+        the leading cause with its evidence pointer."""
+        if self._causes is None:
+            return
+        try:
+            report = self._causes.on_firing(rule, now)
+        except Exception:  # exc: allow — attribution is observability-on-observability; a causes bug must never break alert evaluation
+            logger.exception("cause attribution failed for %s", rule.name)
+            return
+        st["cause_id"] = report["id"]
+        causes = report.get("causes") or []
+        if causes:
+            top = causes[0]
+            message = (f"alert {rule.name} attributed to {top['kind']} "
+                       f"on {top['entity']} (score {top['score']:g}"
+                       f"{': ' + top['detail'] if top['detail'] else ''}"
+                       f") — report {report['id']}, "
+                       f"{len(causes)} candidate(s)")
+        else:
+            message = (f"alert {rule.name} attributed to no candidate "
+                       f"cause in the {report['window_s']:.0f}s burn "
+                       f"window — report {report['id']}")
+        self._emit(rule, "Warning", ATTRIBUTED_EVENT_REASON, message)
 
     def _emit(self, rule: AlertRule, event_type: str, reason: str,
               message: str) -> None:

@@ -191,6 +191,10 @@ HELP_TEXTS: Dict[str, str] = {
     "tpu_operator_alert_firing":
         "1 while the burn-rate alert rule is firing (past its for: "
         "duration), else 0",
+    "tpu_operator_alert_attributed_total":
+        "Firing alerts root-caused by the cause engine, by rule and "
+        "top-ranked cause kind (kind=\"none\" when the burn window "
+        "held no candidate events)",
     # workload families (obs/goodput.py ledger + models/serve.py batcher,
     # exposed by cmd/train.py and cmd/serve.py under the tpu_workload
     # prefix — distinct from the operator's so a combined scrape never

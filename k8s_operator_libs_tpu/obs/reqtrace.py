@@ -222,9 +222,17 @@ class RequestTraceRecorder:
     def __init__(self, clock: Optional[Clock] = None, metrics=None,
                  max_closed: int = DEFAULT_TRACE_RING,
                  max_open: int = DEFAULT_MAX_OPEN_TRACES,
-                 selfclock: Optional[Callable[[], float]] = None):
+                 selfclock: Optional[Callable[[], float]] = None,
+                 timeline=None):
         self._clock = clock or RealClock()
         self._metrics = metrics
+        # fleet black box (obs/timeline.py): the disruption edges —
+        # drain, shed, migration splice, crash requeue — are recorded as
+        # FleetEvents under this recorder's own lock (the timeline
+        # itself is lock-free single-writer). Happy-path stage churn
+        # stays out: only the edges that can CAUSE a latency burn
+        # matter to the root-cause engine.
+        self._timeline = timeline
         self._max_closed = int(max_closed)
         self._max_open = int(max_open)
         self._selfclock = selfclock
@@ -293,11 +301,38 @@ class RequestTraceRecorder:
             stages = entry["stages"]
             if stages[-1][1] == stage:
                 return
+            prev = stages[-1][1]
             stages.append((len(stages), stage, self._clock.now()))
             if stage == "splice":
                 self.splices += 1
             elif stage == "fallback":
                 self.fallbacks += 1
+            if self._timeline is not None:
+                entity = f"request/{rid}"
+                lane = entry["lane"]
+                if stage == "drain":
+                    self._timeline.link(entity, f"lane/{lane}")
+                    self._timeline.record_event(
+                        kind="router-drain", entity=entity,
+                        detail=f"lane {lane}: donor draining")
+                elif stage == "shed":
+                    self._timeline.link(entity, f"lane/{lane}")
+                    self._timeline.record_event(
+                        kind="router-shed", entity=entity,
+                        detail=f"lane {lane}: shed at {prev}")
+                elif stage == "splice":
+                    self._timeline.link(entity, f"lane/{lane}")
+                    self._timeline.record_event(
+                        kind="router-migration", entity=entity,
+                        detail=f"lane {lane}: stream spliced")
+                elif stage == "queued" and prev in ("prefill",
+                                                    "streaming",
+                                                    "drain", "splice"):
+                    self._timeline.link(entity, f"lane/{lane}")
+                    self._timeline.record_event(
+                        kind="router-requeue", entity=entity,
+                        detail=f"lane {lane}: crash requeue "
+                               f"from {prev}")
             if stage in TERMINAL_STAGES:
                 self._close_locked(rid, entry)
 

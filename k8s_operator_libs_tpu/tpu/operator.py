@@ -35,9 +35,11 @@ from ..health.consts import HealthVerdict
 from ..health.monitor import (FleetHealthMonitor, HealthOptions,
                               HealthReport)
 from ..obs.alerts import AlertManager
+from ..obs.causes import CauseAnalyzer
 from ..obs.journey import StuckNodeDetector
 from ..obs.metrics import API_LATENCY_BUCKETS
 from ..obs.slo import SLOEngine, SLOOptions
+from ..obs.timeline import FleetTimeline
 from ..obs.tsdb import TimeSeriesStore
 from ..upgrade import metrics as upgrade_metrics
 from ..upgrade.groups import GroupPolicy
@@ -46,7 +48,7 @@ from ..upgrade.util import KeyFactory, log_event
 from ..utils.clock import Clock, RealClock
 from .device_plugin import tpu_workload_deletion_filter
 from .scheduler import Placement, SliceScheduler, TPUWorkload
-from .topology import TPUSliceGrouper
+from .topology import GKE_NODEPOOL_LABEL, TPUSliceGrouper
 
 logger = logging.getLogger(__name__)
 
@@ -74,7 +76,8 @@ class TPUOperator:
                  slo: Optional[SLOOptions] = None,
                  shard_workers: int = 0, shard_parallel: bool = True,
                  verify_incremental: bool = False,
-                 resilience: Optional[ResilientClient] = None):
+                 resilience: Optional[ResilientClient] = None,
+                 timeline: Optional[FleetTimeline] = None):
         self.client = client
         self.components = components
         self.clock = clock or RealClock()
@@ -86,6 +89,11 @@ class TPUOperator:
         # journey annotations themselves, which are always recorded.
         self.tracer = tracer
         self.metrics = metrics
+        # fleet black box (obs/timeline.py): one unified causal event
+        # store every subsystem records into at its choke point. Always
+        # on (like the journey annotations) — it is fixed-memory and
+        # lock-free, so a library consumer pays one bounded ring.
+        self.timeline = timeline or FleetTimeline(clock=self.clock)
         self.scheduler = SliceScheduler(client, metrics=metrics,
                                         clock=self.clock)
         self._pending: List[TPUWorkload] = []
@@ -101,6 +109,11 @@ class TPUOperator:
         # masks health verdicts, and keeps retrying only the in-flight
         # safety writes until the breaker closes again
         self.resilience = resilience
+        if resilience is not None:
+            bind = getattr(resilience, "bind_timeline", None)
+            if bind is not None:
+                # breaker open/close edges land on the same timeline
+                bind(self.timeline)
         self.degraded = False
         self.degraded_since: Optional[float] = None
         self._last_fresh = self.clock.now()
@@ -117,7 +130,8 @@ class TPUOperator:
                 sibling_keys=[k for name, k in all_keys.items()
                               if name != comp.name],
                 metrics=metrics, tracer=tracer,
-                shard_workers=shard_workers, shard_parallel=shard_parallel)
+                shard_workers=shard_workers, shard_parallel=shard_parallel,
+                timeline=self.timeline)
             mgr.verify_incremental = verify_incremental
             if comp.policy.pod_deletion is not None:
                 # delete exactly the pods holding TPU chips before drain
@@ -160,6 +174,7 @@ class TPUOperator:
         self.tsdb: Optional[TimeSeriesStore] = None
         self.slo_engine: Optional[SLOEngine] = None
         self.alert_manager: Optional[AlertManager] = None
+        self.cause_analyzer: Optional[CauseAnalyzer] = None
         self.last_slo: Dict[str, dict] = {}
         self._slo_options = slo
         if slo is not None:
@@ -169,9 +184,17 @@ class TPUOperator:
                 coarse_points=slo.coarse_points)
             self.slo_engine = SLOEngine(self.tsdb, slo.specs,
                                         clock=self.clock, metrics=metrics)
+            # root-cause engine (obs/causes.py): the alert manager hands
+            # it every pending→firing edge; it walks the timeline + the
+            # entity graph backwards over the burn window
+            self.cause_analyzer = CauseAnalyzer(
+                self.timeline, specs=self.slo_engine.specs,
+                clock=self.clock, metrics=metrics)
             self.alert_manager = AlertManager(clock=self.clock,
                                               metrics=metrics,
-                                              recorder=recorder)
+                                              recorder=recorder,
+                                              causes=self.cause_analyzer,
+                                              timeline=self.timeline)
 
     # ---------------------------------------------------------- workloads
 
@@ -356,6 +379,10 @@ class TPUOperator:
             "retrying)", self.resilience.breaker.state)
         if self.metrics is not None:
             self.metrics.set_gauge("degraded", 1.0)
+        self.timeline.record_event(
+            kind="degraded-enter", entity="operator/self",
+            detail=f"breaker {self.resilience.breaker.state}: "
+                   f"fail-static, writes suspended")
         log_event(self.recorder, self._operator_obj(), "Warning",
                   "OperatorDegraded",
                   "apiserver unreachable (circuit breaker open): "
@@ -385,6 +412,9 @@ class TPUOperator:
         logger.warning("apiserver circuit breaker closed after %.0fs: "
                        "resyncing informers and resuming with a full "
                        "BuildState rebuild", outage_s)
+        self.timeline.record_event(
+            kind="degraded-exit", entity="operator/self",
+            detail=f"recovered after {outage_s:.0f}s; informers resynced")
         log_event(self.recorder, self._operator_obj(), "Normal",
                   "OperatorRecovered",
                   f"apiserver reachable again after {outage_s:.0f}s "
@@ -564,6 +594,15 @@ class TPUOperator:
                 continue
             nodes = [ns.node for bucket in state.node_states.values()
                      for ns in bucket]
+            # entity graph upkeep (node ∈ slice) off the nodes this tick
+            # already joined — the causes engine walks these links and
+            # `status --incident` renders them; link() is a bounded
+            # last-write-wins dict set, safe to re-assert every tick
+            for node in nodes:
+                slice_id = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+                if slice_id:
+                    self.timeline.link(f"node/{node.metadata.name}",
+                                       f"slice/{slice_id}")
             try:
                 self.last_stuck[comp.name] = \
                     self.stuck_detectors[comp.name].check(nodes)
@@ -579,19 +618,23 @@ class TPUOperator:
             return
         current = {name: nh.verdict
                    for name, nh in self.last_health.node_health.items()}
-        if self.recorder is not None:
-            for name, verdict in current.items():
-                prev = self._prev_verdicts.get(name, HealthVerdict.HEALTHY)
-                if prev == verdict:
-                    continue
-                escalated = HealthVerdict.worst([prev, verdict]) == verdict
-                try:
-                    node = self.client.direct().get_node(name)
-                except (ApiError, TimeoutError):
-                    continue  # node gone mid-tick; next tick re-evaluates
-                log_event(self.recorder, node,
-                          "Warning" if escalated else "Normal",
-                          "FleetHealthVerdict",
-                          f"Health verdict of node {name} changed "
-                          f"{prev} -> {verdict}")
+        for name, verdict in current.items():
+            prev = self._prev_verdicts.get(name, HealthVerdict.HEALTHY)
+            if prev == verdict:
+                continue
+            escalated = HealthVerdict.worst([prev, verdict]) == verdict
+            self.timeline.record_event(
+                kind="health-verdict", entity=f"node/{name}",
+                detail=f"{prev} -> {verdict}")
+            if self.recorder is None:
+                continue
+            try:
+                node = self.client.direct().get_node(name)
+            except (ApiError, TimeoutError):
+                continue  # node gone mid-tick; next tick re-evaluates
+            log_event(self.recorder, node,
+                      "Warning" if escalated else "Normal",
+                      "FleetHealthVerdict",
+                      f"Health verdict of node {name} changed "
+                      f"{prev} -> {verdict}")
         self._prev_verdicts = current

@@ -47,7 +47,8 @@ class NodeUpgradeStateProvider:
                  clock: Optional[Clock] = None,
                  sync_timeout: float = consts.CACHE_SYNC_TIMEOUT_SECONDS,
                  sync_poll: float = consts.CACHE_SYNC_POLL_SECONDS,
-                 metrics=None, journey: Optional[JourneyRecorder] = None):
+                 metrics=None, journey: Optional[JourneyRecorder] = None,
+                 timeline=None):
         self._client = client
         self._keys = keys
         self._recorder = recorder
@@ -55,6 +56,12 @@ class NodeUpgradeStateProvider:
         self._sync_timeout = sync_timeout
         self._sync_poll = sync_poll
         self._mutex = KeyedMutex()
+        # fleet black box (obs/timeline.py): the same choke point that
+        # persists the journey annotation also records the transition as
+        # a timeline event — one write path, one event trail, no second
+        # source of truth. Public so the operator can late-bind its
+        # process-wide timeline onto an injected provider.
+        self.timeline = timeline
         # THE journey choke point (obs/journey.py): every state-label write
         # goes through this provider, so folding the journey annotations
         # into the same patch keeps timeline and label atomically coherent.
@@ -131,6 +138,13 @@ class NodeUpgradeStateProvider:
                 new = label_value or ""
                 if old != new:
                     annos.update(self._journey.record(node, old, new))
+                    if self.timeline is not None:
+                        self.timeline.record_event(
+                            kind="journey-transition",
+                            entity=f"node/{node.metadata.name}",
+                            detail=f"{self._keys.component}: "
+                                   f"{old or 'unknown'} -> "
+                                   f"{new or 'unknown'}")
             per_node_annos[node.metadata.name] = annos
             # No-op dedupe: when the caller's view already shows every
             # value this write would set AND the cached object agrees, the

@@ -265,7 +265,8 @@ class ClusterUpgradeStateManager:
                  safe_load_manager: Optional[SafeDriverLoadManager] = None,
                  sibling_keys: Optional[List[KeyFactory]] = None,
                  metrics=None, tracer=None,
-                 shard_workers: int = 0, shard_parallel: bool = True):
+                 shard_workers: int = 0, shard_parallel: bool = True,
+                 timeline=None):
         self.client = client
         self.keys = keys
         self.recorder = recorder
@@ -293,7 +294,12 @@ class ClusterUpgradeStateManager:
         # consumers.
         self._tracer = tracer
         self.node_upgrade_state_provider = state_provider or NodeUpgradeStateProvider(
-            client, keys, recorder, self.clock, metrics=metrics)
+            client, keys, recorder, self.clock, metrics=metrics,
+            timeline=timeline)
+        if timeline is not None and \
+                self.node_upgrade_state_provider.timeline is None:
+            # injected provider: late-bind the process-wide timeline
+            self.node_upgrade_state_provider.timeline = timeline
         self.cordon_manager = cordon_manager or CordonManager(client)
         self.drain_manager = drain_manager or DrainManager(
             client, self.node_upgrade_state_provider, keys, recorder, self.clock,

@@ -1,0 +1,364 @@
+"""Fleet black box + root-cause engine — obs/timeline.py, obs/causes.py.
+
+Unit side: the closed EVENT_KINDS catalog rejects unknown kinds, the
+ring + entity graph stay fixed-memory at 10k-node scale, and three
+pinned incident scenarios rank the right cause (crashloop-quarantine
+TTFT page → the faulted node/slice; flash-crowd page → the crowd, not a
+concurrent benign upgrade; blackout → the breaker event). System side:
+the chaos campaign's attribution scoring (recall/precision against
+injected-fault ground truth) is byte-deterministic under seed replay,
+and the /causes envelope + `status --incident` + the --watch cause
+banner are proven over real HTTP (docs/observability.md "Incident
+timeline & root-cause")."""
+
+import json
+import urllib.error
+import urllib.request
+import pytest
+
+from k8s_operator_libs_tpu.chaos.campaign import run_scenario
+from k8s_operator_libs_tpu.chaos.scenario import random_scenario
+from k8s_operator_libs_tpu.obs.causes import (CAUSE_PRIORS, CauseAnalyzer,
+                                              causes_payload)
+from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+from k8s_operator_libs_tpu.obs.timeline import (DEFAULT_TIMELINE_RING,
+                                                EVENT_KINDS, FleetEvent,
+                                                FleetTimeline)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+from tests.test_router import _load_cmd  # noqa: F401  (cmd loader)
+
+
+# ------------------------------------------------------- timeline store
+
+
+def test_event_kinds_catalog_is_closed():
+    """Unknown kinds raise at the choke point; every cataloged kind is
+    accepted. CAUSE_PRIORS is vocabulary over the same catalog (the
+    OBS004 lint proves the static side; this is the runtime side)."""
+    tl = FleetTimeline(clock=FakeClock(100.0))
+    for kind in EVENT_KINDS:
+        tl.record_event(kind=kind, entity="node/n0")
+    with pytest.raises(ValueError):
+        tl.record_event(kind="made-up-kind", entity="node/n0")
+    assert set(CAUSE_PRIORS) <= set(EVENT_KINDS)
+    assert tl.counts_by_kind()["journey-transition"] == 1
+
+
+def test_timeline_ring_fixed_memory_at_10k_nodes():
+    """30k events over 10k entities: retained is capped at the ring
+    size, the per-entity index holds only live events, and the link
+    table is bounded — the black box can idle for months on a big
+    fleet without growing."""
+    clock = FakeClock(1000.0)
+    tl = FleetTimeline(clock=clock)
+    for i in range(10_000):
+        tl.link(f"node/n{i}", f"slice/s{i % 128}")
+        for _ in range(3):
+            clock.advance(0.01)
+            tl.record_event(kind="journey-transition",
+                            entity=f"node/n{i}", detail="edge")
+    pay = tl.payload()
+    assert pay["recorded"] == 30_000
+    assert pay["retained"] == DEFAULT_TIMELINE_RING
+    assert pay["dropped"] == 30_000 - DEFAULT_TIMELINE_RING
+    assert len(tl.events()) == DEFAULT_TIMELINE_RING
+    # entity index pruned with the ring: only entities with live events
+    assert pay["entities"] <= DEFAULT_TIMELINE_RING
+    # link table bounded (10k links under the 32k cap, all retained)
+    assert pay["links"] == 10_000 and pay["dropped_links"] == 0
+    assert len(pay["events"]) <= 256  # payload ships a tail preview
+
+
+def test_timeline_link_cap_bounds_entity_graph():
+    tl = FleetTimeline(clock=FakeClock(), link_cap=64)
+    for i in range(200):
+        tl.link(f"request/r{i}", "replica/rep0")
+    pay = tl.payload()
+    assert pay["links"] == 64
+    assert pay["dropped_links"] == 200 - 64
+
+
+# ------------------------------------------------- pinned cause ranking
+
+
+TTFT_SPEC = {"name": "serving-ttft-p99",
+             "metric": "tpu_workload_serve_ttft_seconds"}
+
+
+def _analyzer(clock, specs=(TTFT_SPEC,), metrics=None):
+    tl = FleetTimeline(clock=clock)
+    return tl, CauseAnalyzer(tl, specs=list(specs), clock=clock,
+                             metrics=metrics)
+
+
+def test_crashloop_quarantine_page_blames_faulted_slice():
+    """A TTFT page during a crashloop quarantine ranks the health
+    verdict on the faulted node above the background upgrade churn,
+    and its evidence chain cites the raw events."""
+    clock = FakeClock(10_000.0)
+    tl, an = _analyzer(clock)
+    tl.link("node/n7", "slice/slice-7")
+    # background: routine journey edges on other nodes
+    for i in range(4):
+        clock.advance(30.0)
+        tl.record_event(kind="journey-transition", entity=f"node/n{i}",
+                        detail="draining->upgrading")
+    clock.advance(30.0)
+    tl.record_event(kind="health-verdict", entity="node/n7",
+                    detail="crashloop -> quarantine slice-7")
+    clock.advance(60.0)
+    report = an.attribute(rule="serving-ttft-p99:burn:page",
+                          slo="serving-ttft-p99", severity="page",
+                          fired_at=clock.now())
+    top = report["causes"][0]
+    assert top["kind"] == "health-verdict"
+    assert top["entity"] == "node/n7"
+    assert "crashloop" in top["detail"]
+    assert top["evidence"] and top["evidence"][0]["entity"] == "node/n7"
+    # the faulted entity outranks every benign journey edge
+    journeys = [c for c in report["causes"]
+                if c["kind"] == "journey-transition"]
+    assert all(top["score"] > c["score"] for c in journeys)
+
+
+def test_flash_crowd_page_beats_concurrent_benign_upgrade():
+    """A flash crowd (sheds at admission + an emergency capacity trade)
+    concurrent with a routine upgrade: the page blames the crowd, not
+    the upgrade."""
+    clock = FakeClock(20_000.0)
+    tl, an = _analyzer(clock)
+    tl.link("trade/1", "slice/slice-2")
+    for i in range(3):
+        clock.advance(10.0)
+        tl.record_event(kind="journey-transition", entity=f"node/up{i}",
+                        detail="cordoned->draining")
+    clock.advance(10.0)
+    tl.record_event(kind="router-shed", entity="lane/batch",
+                    detail="flash crowd: queue past high watermark")
+    clock.advance(5.0)
+    tl.record_event(kind="market-trade", entity="trade/1",
+                    detail="serving borrows slice-2 (pressure spike)")
+    clock.advance(30.0)
+    report = an.attribute(rule="serving-ttft-p99:burn:page",
+                          slo="serving-ttft-p99", severity="page",
+                          fired_at=clock.now())
+    kinds_ranked = [c["kind"] for c in report["causes"]]
+    assert kinds_ranked[0] in ("market-trade", "router-shed")
+    crowd_best = min(kinds_ranked.index("market-trade"),
+                     kinds_ranked.index("router-shed"))
+    assert crowd_best < kinds_ranked.index("journey-transition")
+
+
+def test_blackout_page_blames_breaker_event():
+    """Pages during an apiserver blackout rank the breaker-open (and
+    the DEGRADED entry) above everything else on the timeline."""
+    clock = FakeClock(30_000.0)
+    tl, an = _analyzer(clock)
+    clock.advance(10.0)
+    tl.record_event(kind="journey-transition", entity="node/n1",
+                    detail="upgrading->restarting")
+    clock.advance(10.0)
+    tl.record_event(kind="breaker-open", entity="breaker/apiserver",
+                    detail="5 consecutive failures")
+    clock.advance(1.0)
+    tl.record_event(kind="degraded-enter", entity="operator/self",
+                    detail="fail-static")
+    clock.advance(120.0)
+    report = an.attribute(rule="drain-latency:burn:page",
+                          slo="drain-latency", severity="page",
+                          fired_at=clock.now())
+    assert report["causes"][0]["kind"] == "breaker-open"
+    assert report["causes"][0]["entity"] == "breaker/apiserver"
+    assert report["causes"][1]["kind"] == "degraded-enter"
+
+
+def test_still_burning_fault_counts_fully():
+    """Elapsed-portion overlap: an event still spanning the firing edge
+    scores 1.0 (its scheduled future is irrelevant); history predating
+    the window discounts."""
+    ev = FleetEvent(seq=0, kind="chaos-fault", entity="node/n0",
+                    t=100.0, until=10_000.0)
+    assert CauseAnalyzer._overlap(ev, since=50.0, until=200.0) == 1.0
+    half = FleetEvent(seq=1, kind="chaos-fault", entity="node/n0",
+                      t=0.0, until=100.0)
+    assert CauseAnalyzer._overlap(half, since=50.0, until=200.0) \
+        == pytest.approx(0.5)
+    assert CauseAnalyzer._overlap(half, since=150.0, until=200.0) == 0.0
+
+
+def test_attribution_counter_and_report_ring():
+    clock = FakeClock(5_000.0)
+    hub = MetricsHub()
+    tl = FleetTimeline(clock=clock)
+    an = CauseAnalyzer(tl, specs=[TTFT_SPEC], clock=clock, metrics=hub,
+                       report_ring=2)
+    tl.record_event(kind="breaker-open", entity="breaker/apiserver")
+    for _ in range(3):
+        clock.advance(10.0)
+        an.attribute(rule="serving-ttft-p99:burn:page",
+                     slo="serving-ttft-p99", severity="page",
+                     fired_at=clock.now())
+    assert len(an.reports) == 2 and an.dropped_reports == 1
+    assert an.attributed_total == 3
+    text = hub.render()
+    assert ('tpu_operator_alert_attributed_total{kind="breaker-open",'
+            'rule="serving-ttft-p99:burn:page"} 3') in text
+    assert an.latest_for("serving-ttft-p99")["id"] == \
+        "serving-ttft-p99:burn:page#3"
+
+
+# ------------------------------------ chaos ground truth + determinism
+
+
+def test_campaign_attribution_recall_and_replay_byte_identical():
+    """Pinned ground-truth gate: seed 0 produces at least one fault-
+    overlapped page, every such page names a faulted entity in its top
+    3 (recall 1.0), no quiet-period page blames a fault kind, and the
+    whole CauseReport set replays byte-identically under the same
+    seed."""
+    r1 = run_scenario(random_scenario(0), 0)
+    r2 = run_scenario(random_scenario(0), 0)
+    assert r1.attribution is not None
+    assert r1.attribution["fault_pages"] >= 1, r1.attribution
+    assert r1.attribution["recall"] == 1.0, r1.attribution["misses"]
+    assert r1.attribution["precision_ok"], r1.attribution["misses"]
+    assert json.dumps(r1.cause_reports, sort_keys=True) \
+        == json.dumps(r2.cause_reports, sort_keys=True)
+    assert r1.attribution == r2.attribution
+    # the report lines carry the score for humans replaying the seed
+    assert "attribution:" in r1.report()
+
+
+def test_campaign_quiet_seed_stays_precise():
+    """Seed 2's page fires outside any fault window: precision holds
+    (no chaos-fault blame) and the scorer counts it as quiet."""
+    res = run_scenario(random_scenario(2), 2)
+    assert res.attribution is not None
+    assert res.attribution["precision_ok"], res.attribution["misses"]
+    assert res.attribution["recall"] == 1.0, res.attribution["misses"]
+
+
+# ------------------------------------------- HTTP + CLI surfaces (e2e)
+
+
+def _serving_analyzer():
+    clock = FakeClock(10_000.0)
+    tl, an = _analyzer(clock)
+    tl.link("node/n7", "slice/slice-7")
+    clock.advance(30.0)
+    tl.record_event(kind="health-verdict", entity="node/n7",
+                    detail="crashloop -> quarantine slice-7")
+    clock.advance(60.0)
+    an.attribute(rule="serving-ttft-p99:burn:page",
+                 slo="serving-ttft-p99", severity="page",
+                 fired_at=clock.now())
+    return tl, an
+
+
+def test_causes_endpoint_and_status_incident_over_http(capsys):
+    """/causes serves the {kind, data} envelope (404 before the first
+    tick), `status --incident` renders the matching report over real
+    HTTP, and --json emits the envelope verbatim."""
+    op_cli = _load_cmd("operator")
+    status_cli = _load_cmd("status")
+    tl, an = _serving_analyzer()
+    server = op_cli.MetricsServer(0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/causes", timeout=5)
+        assert err.value.code == 404
+        server.snapshot["causes"] = json.dumps(
+            {"kind": "causes", "data": causes_payload(an, tl)})
+        with urllib.request.urlopen(f"{base}/causes", timeout=5) as resp:
+            env = json.loads(resp.read().decode())
+        assert env["kind"] == "causes"
+        assert env["data"]["reports"][0]["id"] \
+            == "serving-ttft-p99:burn:page#1"
+        assert env["data"]["timeline"]["counts"]["health-verdict"] == 1
+
+        rc = status_cli.main(["--incident", "serving-ttft-p99",
+                              "--operator-url", base])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "incident serving-ttft-p99:burn:page#1" in out
+        assert "health-verdict" in out and "node/n7" in out
+        assert "evidence" in out
+
+        rc = status_cli.main(["--incident", "no-such-alert",
+                              "--operator-url", base])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "no cause report for 'no-such-alert'" in out
+        assert "serving-ttft-p99:burn:page" in out  # the hint lists rules
+
+        rc = status_cli.main(["--incident", "serving-ttft-p99", "--json",
+                              "--operator-url", base])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == env
+    finally:
+        server.stop()
+    # unreachable endpoint: exit 2 like every HTTP view
+    rc = status_cli.main(["--incident", "x",
+                          "--operator-url", "http://127.0.0.1:1"])
+    assert rc == 2
+
+
+def test_router_causes_endpoint_serves_timeline():
+    """The router's /causes carries its own timeline (drain/shed/
+    migration events) with an empty reports list — it evaluates no
+    alerts."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from k8s_operator_libs_tpu.serving import ReplicaPool
+
+    router_cli = _load_cmd("router")
+    hub = MetricsHub()
+    pool = ReplicaPool()
+    front = router_cli.RouterFront(pool, metrics=hub)
+    front.timeline.record_event(kind="router-shed", entity="lane/batch",
+                                detail="test shed")
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", 0), router_cli.make_handler(front, pool, hub))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        with urllib.request.urlopen(f"{base}/causes", timeout=10) as r:
+            env = json.loads(r.read())
+        assert env["kind"] == "causes"
+        assert env["data"]["reports"] == []
+        assert env["data"]["timeline"]["counts"]["router-shed"] == 1
+    finally:
+        httpd.shutdown()
+
+
+def test_watch_dashboard_shows_cause_banner():
+    """The --watch dashboard leads with the top firing alert's leading
+    cause next to the DEGRADED banner; both stay best-effort."""
+    status_cli = _load_cmd("status")
+    tl, an = _serving_analyzer()
+
+    def fetch(url, path):
+        if path == "/causes":
+            return {"kind": "causes", "data": causes_payload(an, tl)}
+        raise OSError("resilience endpoint down")
+
+    alerts = [{"rule": "serving-ttft-p99:burn:page", "severity": "page",
+               "state": "firing", "firing_since": 10_090.0,
+               "message": "burning"}]
+    banner = status_cli.cause_banner(alerts, "http://op", fetch=fetch)
+    assert banner == ("PAGE serving-ttft-p99 ← health-verdict node/n7 "
+                      "(crashloop -> quarantine slice-7)")
+    body = status_cli.render_dashboard(
+        {"slos": [], "history": {}}, alerts, "http://op", fetch=fetch)
+    assert body.splitlines()[0] == banner  # leads the frame
+    # no firing alert, or an unreachable /causes -> no banner, no crash
+    assert status_cli.cause_banner([], "http://op", fetch=fetch) is None
+
+    def broken(url, path):
+        raise OSError("down")
+
+    assert status_cli.cause_banner(alerts, "http://op",
+                                   fetch=broken) is None

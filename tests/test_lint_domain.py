@@ -1,6 +1,6 @@
 """tools/lint domain passes — JAX001–JAX004 jit-hygiene, LCK001–LCK004
 lock discipline + cross-function lock order, DET001/DET002 determinism,
-STM001 state-machine exhaustiveness, OBS001–OBS003 observability
+STM001 state-machine exhaustiveness, OBS001–OBS004 observability
 closure, CHS001 chaos-catalog closure, WIRE001 wire-key closure, SYN001
 host-sync hygiene, ARC001 import layering. Every code must fire on its
 module's offender fixture and stay silent on the clean idiom; the
@@ -43,7 +43,7 @@ def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "lock-order",
             "determinism", "state-machine", "obs-journey",
-            "obs-attribution", "obs-slo", "chaos-closure",
+            "obs-attribution", "obs-slo", "obs-timeline", "chaos-closure",
             "crash-closure", "wire-closure",
             "sync-hygiene", "thread-discipline", "import-layering",
             "exc-contracts", "exc-swallow", "exc-kill",
@@ -51,7 +51,8 @@ def test_registry_has_all_passes():
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
             "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
-            "OBS002", "OBS003", "CHS001", "CRS001", "WIRE001", "SYN001",
+            "OBS002", "OBS003", "OBS004", "CHS001", "CRS001", "WIRE001",
+            "SYN001",
             "THR001", "GRD001", "ARC001", "EXC001", "EXC002", "EXC003",
             "STL001"} <= set(all_codes)
     # codes are globally unique across checks
@@ -602,7 +603,8 @@ def test_obs002_unknown_segment_name_fails(tmp_path):
 OBS3_FILES = [obs_check.SLO_PATH, obs_check.ALERTS_PATH,
               obs_check.METRICS_PATH, obs_check.ROUTER_METRICS_PATH,
               obs_check.PROFILE_PATH, obs_check.MARKET_METRICS_PATH,
-              obs_check.RESILIENCE_PATH, obs_check.REQTRACE_PATH]
+              obs_check.RESILIENCE_PATH, obs_check.REQTRACE_PATH,
+              obs_check.SLO_CAUSES_PATH]
 
 
 def _obs3_root(tmp_path, mutate=None, skip=()):
@@ -1666,6 +1668,135 @@ def test_obs003_reqtrace_help_covered_by_either_table(tmp_path):
     assert "no HELP_TEXTS entry" in msgs
     assert "tpu_router_request_stage_seconds'" in msgs
     assert "REQTRACE_*_FAMILIES" in msgs
+
+
+# ----------------------------------------------- OBS003 (causes half)
+
+
+def test_obs003_causes_counter_joins_alert_closure(tmp_path):
+    """The cause engine's counter shares the tpu_operator_alert_ prefix
+    with the alert manager: renaming it inside CAUSES_COUNTER_FAMILIES
+    makes the old HELP entry stale AND leaves the new name without a
+    HELP entry — both directions fire from one mutation."""
+    root = _obs3_root(tmp_path, mutate={
+        obs_check.SLO_CAUSES_PATH: lambda s: s.replace(
+            '    "tpu_operator_alert_attributed_total",',
+            '    "tpu_operator_alert_attributed_totalz",')})
+    findings = obs_check.run_slo(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS003" for (_, _, c, _) in findings)
+    assert "tpu_operator_alert_attributed_totalz" in msgs
+    assert "no HELP_TEXTS entry" in msgs
+    assert "tpu_operator_alert_attributed_total'" in msgs
+    assert "CAUSES_COUNTER_FAMILIES" in msgs
+
+
+# ------------------------------------- OBS004 (fleet timeline, mutated)
+
+OBS4_FILES = [obs_check.TIMELINE_PATH, obs_check.CAUSES_PATH,
+              obs_check.ALERTS_PATH, obs_check.REQTRACE_PATH,
+              obs_check.RESILIENCE_PATH,
+              "k8s_operator_libs_tpu/tpu/operator.py",
+              "k8s_operator_libs_tpu/upgrade/node_state_provider.py",
+              "k8s_operator_libs_tpu/market/arbiter.py",
+              "k8s_operator_libs_tpu/chaos/injector.py"]
+
+
+def _obs4_root(tmp_path, mutate=None, skip=()):
+    root = tmp_path / "repo4"
+    for rel in OBS4_FILES:
+        if rel in skip:
+            continue
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_obs004_real_repo_files_pass(tmp_path):
+    assert obs_check.run_timeline(_obs4_root(tmp_path)) == []
+
+
+def test_obs004_real_repo_passes():
+    assert obs_check.run_timeline(REPO) == []
+
+
+def test_obs004_uncataloged_emitter_kind_fails(tmp_path):
+    """A typo'd record_event() kind literal would raise ValueError on
+    the first emit — the pass fails naming the kind and the file, and
+    the orphaned catalog entry fires from the other direction."""
+    root = _obs4_root(tmp_path, mutate={
+        obs_check.REQTRACE_PATH: lambda s: s.replace(
+            'kind="router-shed", entity=entity,',
+            'kind="router-zhed", entity=entity,')})
+    findings = obs_check.run_timeline(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS004" for (_, _, c, _) in findings)
+    assert "router-zhed" in msgs and "not in the EVENT_KINDS" in msgs
+    assert "'router-shed'" in msgs and "no record_event() emitter" in msgs
+
+
+def test_obs004_catalog_kind_without_emitter_fails(tmp_path):
+    """A cataloged kind nothing emits is dead vocabulary the cause
+    priors and docs still pretend exists."""
+    root = _obs4_root(tmp_path, mutate={
+        obs_check.TIMELINE_PATH: lambda s: s.replace(
+            '    "chaos-fault",',
+            '    "ghost-kind",\n    "chaos-fault",')})
+    findings = obs_check.run_timeline(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS004" for (_, _, c, _) in findings)
+    assert "'ghost-kind'" in msgs and "no record_event() emitter" in msgs
+
+
+def test_obs004_hatched_catalog_kind_stays_silent(tmp_path):
+    """`# obs: allow — <why>` on the catalog line is the escape hatch
+    for kinds a checkout legitimately catalogs without an in-tree
+    emitter."""
+    root = _obs4_root(tmp_path, mutate={
+        obs_check.TIMELINE_PATH: lambda s: s.replace(
+            '    "chaos-fault",',
+            '    "ghost-kind",  # obs: allow — reserved for plugins\n'
+            '    "chaos-fault",')})
+    assert obs_check.run_timeline(root) == []
+
+
+def test_obs004_non_literal_kind_fails(tmp_path):
+    """A computed kind= defeats the catalog closure even when it happens
+    to be valid at runtime — only literals keep the pass exhaustive."""
+    root = _obs4_root(tmp_path, mutate={
+        "k8s_operator_libs_tpu/chaos/injector.py": lambda s: s.replace(
+            'kind="chaos-fault", entity=entity,',
+            'kind=str("chaos-" + "fault"), entity=entity,')})
+    findings = obs_check.run_timeline(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS004" for (_, _, c, _) in findings)
+    assert "string literal" in msgs
+    # ...and the kind simultaneously loses its only emitter
+    assert "'chaos-fault'" in msgs and "no record_event() emitter" in msgs
+
+
+def test_obs004_cause_prior_outside_catalog_fails(tmp_path):
+    """A CAUSE_PRIORS key naming no cataloged kind is a prior for an
+    event that can never be recorded."""
+    root = _obs4_root(tmp_path, mutate={
+        obs_check.CAUSES_PATH: lambda s: s.replace(
+            '    "breaker-open": 0.9,',
+            '    "breaker-opened": 0.9,')})
+    findings = obs_check.run_timeline(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS004" for (_, _, c, _) in findings)
+    assert "'breaker-opened'" in msgs and "CAUSE_PRIORS" in msgs
+
+
+def test_obs004_no_timeline_module_skips(tmp_path):
+    """A checkout without obs/timeline.py (older fixture scratch roots)
+    must not fire at all — the closure needs the catalog side present."""
+    root = _obs4_root(tmp_path, skip={obs_check.TIMELINE_PATH})
+    assert obs_check.run_timeline(root) == []
 
 
 def test_obs003_reqtrace_table_gutted_fails(tmp_path):

@@ -77,12 +77,20 @@ def main(argv=None) -> int:
                            cached_reads=args.cached_reads,
                            shard_workers=args.shard_workers)
     failed = [r for r in results if r.failed]
+    # attribution gate: every fault-overlapped page must have named the
+    # faulted entity in its top-3 causes (recall 1.0 PER SEED), and no
+    # quiet-period page may blame chaos-fault (precision) — the cause
+    # engine is scored, not trusted (docs/observability.md)
+    misattributed = [r for r in results if r.attribution is not None
+                     and (r.attribution["recall"] < 1.0
+                          or not r.attribution["precision_ok"])]
     if args.as_json:
         print(json.dumps([{
             "scenario": r.scenario, "seed": r.seed,
             "converged": r.converged, "ticks": r.ticks,
             "modelled_s": r.modelled_s, "failovers": r.failovers,
             "violations": [str(v) for v in r.violations],
+            "attribution": r.attribution,
             "trace": r.trace,
         } for r in results], indent=2))
     else:
@@ -93,10 +101,17 @@ def main(argv=None) -> int:
                 print(r.report().splitlines()[0])
         total_ticks = sum(r.ticks for r in results)
         total_failover = sum(r.failovers for r in results)
+        pages = sum((r.attribution or {}).get("pages", 0)
+                    for r in results)
+        attributed = sum((r.attribution or {}).get("recall_hits", 0)
+                         for r in results)
         print(f"\nchaos campaign: {len(results)} scenarios, "
               f"{len(failed)} failed, {total_ticks} ticks, "
               f"{total_failover} failovers, "
               f"{time.time() - t0:.1f}s wall")
+        print(f"alert attribution: {pages} pages, {attributed} "
+              f"fault-overlapped pages root-caused, "
+              f"{len(misattributed)} scenario(s) misattributed")
     trades = sum((r.router_stats or {}).get("market_trades", 0)
                  for r in results)
     if not args.as_json:
@@ -104,6 +119,16 @@ def main(argv=None) -> int:
     if args.require_market_trade and trades == 0:
         print("FAIL: --require-market-trade set but no scenario "
               "exercised a capacity-market trade", file=sys.stderr)
+        return 1
+    if misattributed:
+        for r in misattributed:
+            a = r.attribution
+            print(f"FAIL: seed {r.seed} attribution "
+                  f"recall={a['recall']:.2f} "
+                  f"precision={'ok' if a['precision_ok'] else 'violated'}:",
+                  file=sys.stderr)
+            for m in a["misses"]:
+                print(f"  {m}", file=sys.stderr)
         return 1
     return 1 if failed else 0
 

@@ -356,6 +356,10 @@ RESILIENCE_PATH = "k8s_operator_libs_tpu/core/resilience.py"
 # reverse-check treats the union of both modules' tables as the emitted
 # set for that prefix; same absent-module skip rule
 REQTRACE_PATH = "k8s_operator_libs_tpu/obs/reqtrace.py"
+# the cause engine's emitted-family table (CAUSES_COUNTER_FAMILIES) —
+# its counter shares the tpu_operator_alert_ prefix with the alert
+# manager, so it joins the slo/alert closure; same absent-module skip
+SLO_CAUSES_PATH = "k8s_operator_libs_tpu/obs/causes.py"
 
 
 def _help_text_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
@@ -475,6 +479,19 @@ def run_slo(root) -> List[Finding]:
     # registered, or its HELP falls back to underscores-to-spaces
     emitted = {**{f: (SLO_PATH, ln) for f, ln in slo_fams.items()},
                **{f: (ALERTS_PATH, ln) for f, ln in alert_fams.items()}}
+    # the cause engine's counter shares the tpu_operator_alert_ prefix,
+    # so its emitted-family table joins the same closure (skipped when
+    # the checkout carries no causes module)
+    if index.exists(SLO_CAUSES_PATH):
+        causes_fams, causes_line = _string_tuple(
+            index.tree(SLO_CAUSES_PATH), "CAUSES_COUNTER_FAMILIES")
+        if causes_line == 0:
+            findings.append(
+                (SLO_CAUSES_PATH, 1, "OBS003",
+                 "CAUSES_COUNTER_FAMILIES table not found "
+                 "(parse drift?)"))
+        emitted.update({f: (SLO_CAUSES_PATH, ln)
+                        for f, ln in causes_fams.items()})
     for family, (rel, lineno) in sorted(emitted.items()):
         if family not in help_keys:
             findings.append(
@@ -490,8 +507,9 @@ def run_slo(root) -> List[Finding]:
             findings.append(
                 (METRICS_PATH, lineno, "OBS003",
                  f"HELP_TEXTS entry {key!r} matches no emitted family in "
-                 f"SLO_GAUGE_FAMILIES ({SLO_PATH}) or ALERT_GAUGE_FAMILIES "
-                 f"({ALERTS_PATH}) (renamed or removed gauge?)"))
+                 f"SLO_GAUGE_FAMILIES ({SLO_PATH}), ALERT_GAUGE_FAMILIES "
+                 f"({ALERTS_PATH}), or CAUSES_COUNTER_FAMILIES "
+                 f"({SLO_CAUSES_PATH}) (renamed or removed gauge?)"))
 
     # request flight recorder: obs/reqtrace.py's emitted-family tables
     # close over HELP_TEXTS both ways (skipped when the checkout carries
@@ -640,3 +658,149 @@ def run_slo(root) -> List[Finding]:
 
 register(Check(name="obs-slo", codes=SLO_CODES, scope="project",
                run=run_slo, domain=True))
+
+
+# -------------------------------------------- OBS004 (fleet timeline)
+
+TIMELINE_CODES = {
+    "OBS004": "fleet-timeline drift: a record_event() call uses a "
+              "non-literal or uncataloged event kind, an EVENT_KINDS "
+              "entry has no emitter (and no `# obs: allow` hatch), or "
+              "a CAUSE_PRIORS key names no cataloged kind",
+}
+
+TIMELINE_PATH = "k8s_operator_libs_tpu/obs/timeline.py"
+CAUSES_PATH = "k8s_operator_libs_tpu/obs/causes.py"
+# kinds a checkout may legitimately catalog without an in-tree emitter
+# carry `# obs: allow — <why>` on their catalog line
+TIMELINE_HATCH = "# obs: allow"
+
+
+def _cause_prior_keys(tree: ast.Module) -> Tuple[Dict[str, int], int]:
+    """Literal string keys of CAUSE_PRIORS → ({key: lineno}, table
+    lineno; 0 when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "CAUSE_PRIORS"):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}, node.lineno
+        keys: Dict[str, int] = {}
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                keys[key.value] = key.lineno
+        return keys, node.lineno
+    return {}, 0
+
+
+def _record_event_kinds(tree: ast.Module
+                        ) -> Tuple[List[Tuple[str, int]], List[int]]:
+    """Every ``record_event(...)`` call site → ([(literal kind, lineno)],
+    [linenos of calls whose kind= is absent or not a string literal])."""
+    literals: List[Tuple[str, int]] = []
+    bad: List[int] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if name != "record_event":
+            continue
+        kind = next((kw.value for kw in node.keywords
+                     if kw.arg == "kind"), None)
+        if (isinstance(kind, ast.Constant)
+                and isinstance(kind.value, str)):
+            literals.append((kind.value, node.lineno))
+        else:
+            bad.append(node.lineno)
+    return literals, bad
+
+
+def run_timeline(root) -> List[Finding]:
+    index = as_index(root)
+    findings: List[Finding] = []
+    if not index.exists(TIMELINE_PATH):
+        return findings  # no timeline module in this checkout — skip
+
+    catalog, catalog_line = _string_tuple(index.tree(TIMELINE_PATH),
+                                          "EVENT_KINDS")
+    if catalog_line == 0:
+        return [(TIMELINE_PATH, 1, "OBS004",
+                 "EVENT_KINDS catalog not found (parse drift?)")]
+
+    # direction 1: every record_event() call site names a cataloged kind
+    # as a STRING LITERAL — a variable kind defeats the closure (the
+    # store rejects unknown kinds at runtime, but only this pass proves
+    # it can never trip), and a typo'd literal is an event the cause
+    # engine will never see
+    emitters: Dict[str, List[Tuple[str, int]]] = {}
+    for scan_root in SCAN_ROOTS:
+        for rel in index.files_under(scan_root):
+            try:
+                tree = index.tree(rel)
+            except SyntaxError:
+                continue  # the generic pass reports E999
+            literals, bad = _record_event_kinds(tree)
+            for kind, lineno in literals:
+                if rel == TIMELINE_PATH:
+                    continue  # the store's own internals, not an emitter
+                emitters.setdefault(kind, []).append((rel, lineno))
+                if kind not in catalog:
+                    findings.append(
+                        (rel, lineno, "OBS004",
+                         f"record_event() kind {kind!r} is not in the "
+                         f"EVENT_KINDS catalog ({TIMELINE_PATH}) — it "
+                         f"would raise ValueError on the first emit"))
+            for lineno in bad:
+                if rel == TIMELINE_PATH:
+                    continue
+                findings.append(
+                    (rel, lineno, "OBS004",
+                     "record_event() must pass kind= as a string "
+                     "literal at the call site — a computed kind "
+                     "defeats the catalog closure"))
+
+    # direction 2: every cataloged kind has at least one emitter, or
+    # carries the `# obs: allow — <why>` hatch on its catalog line — a
+    # kind nothing emits is dead vocabulary the cause priors and docs
+    # still pretend exists
+    lines = index.lines(TIMELINE_PATH)
+    for kind, lineno in sorted(catalog.items()):
+        if kind in emitters:
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if TIMELINE_HATCH in line:
+            continue
+        findings.append(
+            (TIMELINE_PATH, lineno, "OBS004",
+             f"EVENT_KINDS entry {kind!r} has no record_event() emitter "
+             f"anywhere under {'/'.join(SCAN_ROOTS)} (add the emitter, "
+             f"remove the kind, or hatch the line with "
+             f"`{TIMELINE_HATCH} — <why>`)"))
+
+    # the cause engine's prior table is vocabulary over the same catalog
+    if index.exists(CAUSES_PATH):
+        priors, priors_line = _cause_prior_keys(index.tree(CAUSES_PATH))
+        if priors_line == 0:
+            findings.append(
+                (CAUSES_PATH, 1, "OBS004",
+                 "CAUSE_PRIORS table not found (parse drift?)"))
+        for kind, lineno in sorted(priors.items()):
+            if kind not in catalog:
+                findings.append(
+                    (CAUSES_PATH, lineno, "OBS004",
+                     f"CAUSE_PRIORS key {kind!r} is not in the "
+                     f"EVENT_KINDS catalog ({TIMELINE_PATH}) — a prior "
+                     f"for a kind that can never be recorded"))
+    return findings
+
+
+register(Check(name="obs-timeline", codes=TIMELINE_CODES, scope="project",
+               run=run_timeline, domain=True))
