@@ -13,6 +13,7 @@ DOCKER_TARGETS ?= docker-all docker-native docker-test docker-test-fast \
 
 .PHONY: all native test test-fast test-health test-obs test-obs-workload \
   test-obs-slo test-obs-profile test-obs-request test-obs-causes \
+  test-obs-usage \
   test-delta test-chaos \
   test-router test-migration test-market test-race test-resilience \
   health-sim chaos chaos-market-smoke crash crash-smoke race race-smoke \
@@ -84,6 +85,9 @@ servebench-smoke:  ## budgeted CI gate (like fleetbench-smoke): the same harness
 
 test-obs-causes:  ## fleet black box + root-cause engine: closed event catalog, fixed-memory ring at 10k-node scale, pinned cause-ranking scenarios, chaos ground-truth recall/precision + byte-identical seed replay, /causes + status --incident over real HTTP (docs/observability.md "Incident timeline & root-cause")
 	$(PYTHON) -m pytest tests/test_causes.py -q
+
+test-obs-usage:  ## fleet ledger: conservation-checked utilization accounting + per-tenant billing — priority-sweep classification, exact per-tick conservation, durable rotated ledger with failover resume + standby discipline, byte-identical replay, banner precedence + status --usage rendering, composite-chaos conservation invariant (docs/observability.md "Utilization & cost accounting")
+	$(PYTHON) -m pytest tests/test_usage.py -q
 
 test-delta:  ## PR 14 delta-driven reconcile: dirty-set drain vs snapshot equivalence under randomized mutations (incl. watch-lag + re-list gap), incremental BuildState oracle, no-op patch dedupe call-count pins, shard runner / budget accountant, parallel-vs-serial rollout equivalence, quiet-tick near-zero-calls pin, cached+sharded chaos seed
 	$(PYTHON) -m pytest tests/test_deltacache.py -q

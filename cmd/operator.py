@@ -144,6 +144,45 @@ def load_reconcile(path: str) -> dict:
     }
 
 
+def load_usage(path: str):
+    """Optional top-level ``usage:`` section (docs/observability.md
+    "Utilization & cost accounting"). ON BY DEFAULT — the meter is
+    O(nodes) integer bookkeeping per tick; ``usage: {enabled: false}``
+    opts out. The durable billing ledger needs an explicit path:
+
+        usage:
+          ledger: /var/lib/tpu-operator/usage.jsonl  # rotated JSONL
+          goodputLedger: /ckpt/goodput.jsonl  # training goodput pricing
+          maxWasteBuckets: 32
+
+    Returns the raw dict ({} = defaults), or None when disabled."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    section = cfg.get("usage")
+    if section is not None and section.get("enabled") is False:
+        return None
+    return section or {}
+
+
+def build_usage(section, hub):
+    """``usage:`` section → a wired UsageMeter (billing attached when a
+    ledger path is configured)."""
+    from k8s_operator_libs_tpu.obs.usage import UsageMeter
+    billing = None
+    if section.get("ledger"):
+        from k8s_operator_libs_tpu.obs.billing import (BillingEngine,
+                                                       UsageLedger)
+        from k8s_operator_libs_tpu.serving.router import LANE_WEIGHTS
+        billing = BillingEngine(
+            UsageLedger(section["ledger"]),
+            lane_weights=LANE_WEIGHTS,
+            goodput_path=section.get("goodputLedger"))
+    return UsageMeter(metrics=hub, billing=billing,
+                      max_waste_buckets=int(
+                          section.get("maxWasteBuckets", 32)))
+
+
 def load_market(path: str):
     """Optional top-level ``market:`` section (docs/capacity-market.md):
 
@@ -281,7 +320,7 @@ class MetricsServer:
         self.snapshot = {"text": "", "healthy": False,
                          "slo": None, "alerts": None, "profile": None,
                          "market": None, "resilience": None,
-                         "causes": None}
+                         "causes": None, "usage": None}
         snapshot = self.snapshot
 
         class Handler(BaseHTTPRequestHandler):
@@ -298,7 +337,8 @@ class MetricsServer:
                     ctype = "text/plain"
                     code = 200 if snapshot["healthy"] else 503
                 elif self.path in ("/slo", "/alerts", "/profile",
-                                   "/market", "/resilience", "/causes"):
+                                   "/market", "/resilience", "/causes",
+                                   "/usage"):
                     payload = snapshot[self.path[1:]]
                     if payload is None:
                         body = {
@@ -309,6 +349,8 @@ class MetricsServer:
                                 b'{"error": "resilience disabled"}',
                             "/causes":
                                 b'{"error": "no tick completed yet"}',
+                            "/usage":
+                                b'{"error": "usage accounting disabled"}',
                         }.get(self.path,
                               b'{"error": "slo engine disabled"}')
                         ctype, code = "application/json", 404
@@ -380,6 +422,22 @@ def alerts_payload(operator: TPUOperator) -> str:
                        "data": operator.alert_manager.status()})
 
 
+def usage_payload(operator: TPUOperator, waste_top: int = 5) -> dict:
+    """The /usage data body: the meter's conservation-checked account,
+    with each top waste bucket joined to the fleet-timeline events
+    overlapping its window — every large waste window arrives with its
+    'why' attached (the PR 19 black box)."""
+    data = operator.usage.payload(waste_top=waste_top)
+    for bucket in data["waste"]:
+        events = operator.timeline.events_overlapping(
+            bucket["start"], bucket["end"])
+        bucket["events"] = [
+            {"t": ev.t, "kind": ev.kind, "entity": ev.entity,
+             "detail": ev.detail}
+            for ev in events[-5:]]
+    return data
+
+
 def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
     """``stop`` (an Event), ``on_ready(metrics_server)`` and ``clock``
     (bounds the shutdown joins) are injection points for embedding and
@@ -436,6 +494,7 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         health = load_health(args.config)
         slo = load_slo(args.config)
         market_section = load_market(args.config)
+        usage_section = load_usage(args.config)
         reconcile_opts = load_reconcile(args.config)
         resilience_opts = load_resilience(args.config)
         client, recorder, resilient = build_client(args, components,
@@ -476,13 +535,18 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
         "version": __version__,
         "components": ",".join(c.name for c in components)})
     hub.set_gauge("leader", 0.0 if args.leader_elect else 1.0)
+    usage_meter = (build_usage(usage_section, hub)
+                   if usage_section is not None else None)
     operator = TPUOperator(client, components, recorder=recorder,
                            health=health, tracer=tracer, metrics=hub,
                            slo=slo,
                            shard_workers=reconcile_opts["shard_workers"],
                            verify_incremental=reconcile_opts[
                                "verify_incremental"],
-                           resilience=resilient)
+                           resilience=resilient, usage=usage_meter)
+    if usage_meter is not None and usage_meter.billing is not None:
+        logger.info("usage billing ledger at %s",
+                    usage_meter.billing.ledger.path)
     if reconcile_opts["shard_workers"] > 1:
         logger.info("sharded reconcile on (%d per-slice-group workers)",
                     reconcile_opts["shard_workers"])
@@ -668,6 +732,10 @@ def main(argv=None, stop=None, on_ready=None, clock=None) -> int:
                 if arbiter is not None:
                     server.snapshot["market"] = json.dumps(
                         {"kind": "market", "data": arbiter.payload()})
+                if operator.usage is not None:
+                    server.snapshot["usage"] = json.dumps(
+                        {"kind": "usage",
+                         "data": usage_payload(operator)})
                 if resilient is not None:
                     server.snapshot["resilience"] = json.dumps(
                         {"kind": "resilience", "data": dict(

@@ -453,15 +453,62 @@ def cause_banner(alerts_data, operator_url: str, fetch=fetch_view):
     return None
 
 
+def efficiency_banner(operator_url: str, fetch=fetch_view):
+    """The fleet-ledger banner: one line with the productive fraction,
+    the goodput headline, and the top waste kinds from the operator's
+    /usage envelope. Best-effort like every banner — unreachable
+    endpoint or no attributed tick yet means no banner."""
+    try:
+        data = fetch(operator_url, "/usage").get("data") or {}
+    except Exception:  # exc: allow — the banner is best-effort; unreachable just means no banner
+        return None
+    if not data.get("ticks") or not data.get("capacity_s"):
+        return None
+    kinds = data.get("kinds") or {}
+    waste = sorted(((k, s) for k, s in kinds.items()
+                    if k not in ("serving", "training") and s > 0),
+                   key=lambda kv: -kv[1])
+    top = ", ".join(f"{k} {_fmt_duration(s)}" for k, s in waste[:2])
+    line = f"efficiency {data.get('efficiency', 0):.1%} productive"
+    billing = data.get("billing") or {}
+    if billing.get("fleet_goodput_fraction") is not None:
+        line += (f", fleet goodput "
+                 f"{billing['fleet_goodput_fraction']:.1%}")
+    return line + (f" — top waste: {top}" if top else "")
+
+
+def banner_lines(operator_url: str, fetch=fetch_view, alerts_data=None,
+                 stale_line=None):
+    """Every dashboard banner, composed in ONE place with a fixed
+    precedence order (highest first — a render test pins it):
+
+        DEGRADED > STALE > leading cause > efficiency
+
+    Each banner stays best-effort and independent: one failing fetch
+    drops that line only. ``stale_line`` is passed pre-rendered by the
+    watch loop (only it knows the last good fetch time)."""
+    lines = []
+    degraded = degraded_banner(operator_url, fetch=fetch)
+    if degraded:
+        lines.append(degraded)
+    if stale_line:
+        lines.append(stale_line)
+    cause = cause_banner(alerts_data, operator_url, fetch=fetch)
+    if cause:
+        lines.append(cause)
+    efficiency = efficiency_banner(operator_url, fetch=fetch)
+    if efficiency:
+        lines.append(efficiency)
+    return lines
+
+
 def render_dashboard(slo_data, alerts_data, operator_url: str,
-                     fetch=fetch_view) -> str:
+                     fetch=fetch_view, stale_line=None) -> str:
     stamp = datetime.datetime.now(tz=datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M:%S UTC")
-    banner = degraded_banner(operator_url, fetch=fetch)
-    cause = cause_banner(alerts_data, operator_url, fetch=fetch)
     return "\n".join(
-        ([banner] if banner else [])
-        + ([cause] if cause else [])
+        banner_lines(operator_url, fetch=fetch, alerts_data=alerts_data,
+                     stale_line=stale_line)
         + [
             f"tpu-operator fleet SLOs  ({operator_url}, {stamp})",
             "",
@@ -509,18 +556,20 @@ def run_slo_view(args, fetch=fetch_view, sleep=time.sleep, now=None) -> int:
             stale_since = None
             last_slo_env, last_alerts_env = slo_env, alerts_env
         if args.watch:
-            body = render_dashboard(
-                (slo_env or {}).get("data") or {},
-                (alerts_env or {}).get("data") or [], args.operator_url,
-                fetch=fetch)
+            stale_line = None
             if fetch_error is not None:
                 stamp = datetime.datetime.fromtimestamp(
                     stale_since, tz=datetime.timezone.utc).strftime(
                     "%Y-%m-%d %H:%M:%S UTC")
-                body = (f"STALE since {stamp} — cannot read "
-                        f"{args.operator_url}: {fetch_error} "
-                        f"(retrying every {args.watch_interval:g}s)\n"
-                        + body)
+                stale_line = (f"STALE since {stamp} — cannot read "
+                              f"{args.operator_url}: {fetch_error} "
+                              f"(retrying every {args.watch_interval:g}s)")
+            # banner_lines() owns the precedence: DEGRADED > STALE >
+            # cause > efficiency — the STALE text rides through it
+            body = render_dashboard(
+                (slo_env or {}).get("data") or {},
+                (alerts_env or {}).get("data") or [], args.operator_url,
+                fetch=fetch, stale_line=stale_line)
             # ANSI clear + home: repaint in place like `watch(1)`
             print("\x1b[2J\x1b[H" + body, flush=True)
         elif args.as_json:
@@ -697,6 +746,101 @@ def run_market_view(args, fetch=fetch_view) -> int:
         print(json.dumps(env, indent=2))
     else:
         print(render_market(env.get("data") or {}))
+    return 0
+
+
+def render_usage(data) -> str:
+    """The fleet ledger's view: the efficiency headline, the per-kind
+    attribution table (conservation means the SECONDS column sums to
+    capacity exactly), the per-lane serving split, per-tenant billing,
+    and the top waste windows each joined to the timeline events that
+    overlapped them (docs/observability.md "Utilization & cost
+    accounting")."""
+    if not data or not data.get("ticks"):
+        return ("no usage attributed yet (operator warming up, or "
+                "running with usage accounting disabled?)")
+    capacity = data.get("capacity_s") or 0.0
+    lines = [f"fleet efficiency {data.get('efficiency', 0):.1%} "
+             f"productive over {_fmt_duration(capacity)} capacity "
+             f"({data.get('ticks', 0)} ticks)"]
+    billing = data.get("billing") or {}
+    if billing.get("fleet_goodput_fraction") is not None:
+        lines.append(f"fleet goodput fraction "
+                     f"{billing['fleet_goodput_fraction']:.1%} "
+                     f"(training goodput vs badput folded in)")
+    kinds = data.get("kinds") or {}
+    if kinds:
+        headers = ("KIND", "SECONDS", "%CAP")
+        table = [(kind, _fmt_duration(seconds),
+                  f"{seconds / capacity:.1%}" if capacity else "-")
+                 for kind, seconds in sorted(
+                     kinds.items(), key=lambda kv: -kv[1])
+                 if seconds > 0]
+        widths = [max(len(h), *(len(t[i]) for t in table))
+                  for i, h in enumerate(headers)]
+        lines.append("")
+        lines.append("  ".join(h.ljust(w)
+                               for h, w in zip(headers, widths)))
+        for t in table:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(t, widths)))
+    lanes = data.get("lanes") or {}
+    if lanes:
+        lines.append("")
+        lines.append("serving by lane: " + ", ".join(
+            f"{lane} {_fmt_duration(seconds)}"
+            for lane, seconds in sorted(
+                lanes.items(), key=lambda kv: -kv[1])))
+    tenants = billing.get("tenants") or {}
+    if tenants:
+        headers = ("TENANT", "SECONDS", "COST", "TOKENS")
+        table = []
+        for name, rec in sorted(tenants.items()):
+            table.append((name, _fmt_duration(rec.get("seconds", 0.0)),
+                          f"{rec.get('cost', 0.0):.1f}",
+                          str(int(rec.get("tokens", 0)))
+                          if rec.get("tokens") else "-"))
+        widths = [max(len(h), *(len(t[i]) for t in table))
+                  for i, h in enumerate(headers)]
+        lines.append("")
+        lines.append("  ".join(h.ljust(w)
+                               for h, w in zip(headers, widths)))
+        for t in table:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(t, widths)))
+    waste = data.get("waste") or []
+    if waste:
+        lines.append("")
+        lines.append(f"top {len(waste)} waste window(s):")
+        for bucket in waste:
+            stamp = datetime.datetime.fromtimestamp(
+                bucket["start"], tz=datetime.timezone.utc).strftime(
+                "%Y-%m-%d %H:%M:%S")
+            span = _fmt_duration(bucket["end"] - bucket["start"])
+            lines.append(f"  {stamp} (+{span})  {bucket['waste']:<20} "
+                         f"{_fmt_duration(bucket['node_s'])} node-time")
+            for ev in bucket.get("events") or []:
+                estamp = datetime.datetime.fromtimestamp(
+                    ev["t"], tz=datetime.timezone.utc).strftime(
+                    "%H:%M:%S")
+                lines.append(f"      {estamp}  {ev['kind']:<18} "
+                             f"{ev['entity']}  {ev.get('detail') or '-'}")
+    return "\n".join(lines)
+
+
+def run_usage_view(args, fetch=fetch_view) -> int:
+    """--usage: fetch the operator's /usage envelope (exit 2 when the
+    endpoint is unreachable, like --market)."""
+    try:
+        env = fetch(args.operator_url, "/usage")
+    except Exception as exc:  # exc: allow — an unreachable endpoint of any shape is exit 2 with the message
+        print(f"error: cannot read {args.operator_url}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(env, indent=2))
+    else:
+        print(render_usage(env.get("data") or {}))
     return 0
 
 
@@ -975,6 +1119,12 @@ def main(argv=None, client=None, now=None) -> int:
                    help="render the capacity arbiter's lane depths, "
                         "slice ownership and recent decisions from a "
                         "running operator's /market endpoint")
+    p.add_argument("--usage", action="store_true",
+                   help="render the fleet ledger's utilization "
+                        "accounting (per-kind/per-lane attribution, "
+                        "efficiency, per-tenant billing, top waste "
+                        "windows) from a running operator's /usage "
+                        "endpoint")
     p.add_argument("--incident", default=None, metavar="ALERT",
                    help="render the root-cause engine's newest "
                         "CauseReport for this alert rule or SLO name "
@@ -1005,6 +1155,10 @@ def main(argv=None, client=None, now=None) -> int:
         # the arbiter lives in the operator process; its ledger is the
         # authoritative state, so this is an HTTP view like --profile
         return run_market_view(args)
+    if args.usage:
+        # the usage meter lives in the operator process; its ledger is
+        # the authoritative state, so this is an HTTP view like --market
+        return run_usage_view(args)
     if args.resilience:
         # breaker state + degraded-mode posture: the operator's HTTP
         # view (docs/resilience.md)

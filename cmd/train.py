@@ -190,6 +190,12 @@ def main(argv=None) -> int:
     p.add_argument("--goodput-sync-every", type=int, default=10,
                    help="steps between telemetry syncs with the device "
                         "stream (the ledger never blocks per step)")
+    p.add_argument("--metrics-textfile", default=None, metavar="PATH",
+                   help="write the job's tpu_workload exposition (step/"
+                        "badput families plus the final goodput-summary "
+                        "gauges) to PATH at exit — the node-exporter "
+                        "textfile pattern for batch jobs without a "
+                        "/metrics listener")
     args = p.parse_args(argv)
 
     # under an operator placement, join the multi-host/multislice
@@ -222,11 +228,14 @@ def main(argv=None) -> int:
     optimizer = default_optimizer(args.lr)
     mesh, step_fn, init_fn = build_parallel(cfg, args, optimizer)
     ledger = None
+    hub = None
     if args.goodput_log != "off":
         from k8s_operator_libs_tpu.obs.goodput import GoodputLedger
-        ledger = (GoodputLedger.for_checkpoint_dir(args.ckpt)
+        from k8s_operator_libs_tpu.obs.metrics import MetricsHub
+        hub = MetricsHub()
+        ledger = (GoodputLedger.for_checkpoint_dir(args.ckpt, metrics=hub)
                   if args.goodput_log == "auto"
-                  else GoodputLedger(args.goodput_log))
+                  else GoodputLedger(args.goodput_log, metrics=hub))
     trainer = CheckpointingTrainer(cfg, args.ckpt, mesh=mesh,
                                    optimizer=optimizer,
                                    checkpoint_interval=args.ckpt_interval,
@@ -277,12 +286,19 @@ def main(argv=None) -> int:
     ds.close()
     if ledger is not None:
         ledger.close()
-        from k8s_operator_libs_tpu.obs.goodput import read_ledger, summarize
+        from k8s_operator_libs_tpu.obs.goodput import (
+            publish_summary, read_ledger, summarize)
         s = summarize(read_ledger(ledger.path))
         frac = s["goodput_fraction"]
         print(f"goodput: {s['goodput_s']:.1f}s over {s['steps']} steps "
               f"({frac:.1%} of accounted time)" if frac is not None else
               f"goodput ledger at {ledger.path}")
+        # export the same decomposition as gauges — the fleet billing
+        # engine and dashboards read what this job used to only print
+        publish_summary(s, hub)
+        if args.metrics_textfile:
+            with open(args.metrics_textfile, "w", encoding="utf-8") as fh:
+                fh.write(hub.render(prefix="tpu_workload"))
     if result.preempted:
         print(f"preempted at step {int(result.state.step)}; checkpoint "
               f"{result.last_checkpoint_step} saved — exiting for upgrade")

@@ -23,6 +23,7 @@ scenarios; ``make test-chaos`` replays the pinned ones.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import os
 import tempfile
@@ -41,8 +42,10 @@ from ..health.monitor import HealthOptions
 from ..health.remediation import RemediationPolicy
 from ..market import (SERVING, TRAINING, CapacityArbiter, ManagedSlice,
                       MarketConfig)
+from ..obs.billing import BillingEngine, UsageLedger
 from ..obs.causes import CauseAnalyzer
 from ..obs.goodput import GoodputLedger
+from ..obs.usage import UsageMeter
 from ..obs.metrics import MetricsHub
 from ..obs.profile import TickProfiler, counting_client
 from ..obs.slo import SLOOptions
@@ -127,6 +130,11 @@ class CampaignResult:
     # their top 3) and precision (quiet-period pages must not blame
     # chaos-fault) — tools/chaos_campaign.py gates on this
     attribution: Optional[dict] = None
+    # fleet-ledger summary: settled usage record count and a sha256
+    # over the ledger bytes — the usage-determinism test compares these
+    # across same-seed reruns (byte-identical ledgers)
+    usage_digest: Optional[str] = None
+    usage_records: int = 0
 
     @property
     def failed(self) -> bool:
@@ -185,7 +193,7 @@ def build_fleet(cluster: FakeCluster, fleet) -> List[str]:
 
 def _make_operator(client, recorder, clock, max_unavailable: str,
                    tracer=None, shard_workers: int = 0,
-                   resilience=None) -> TPUOperator:
+                   resilience=None, usage=None) -> TPUOperator:
     return TPUOperator(
         client,
         components=[ManagedComponent(
@@ -218,7 +226,10 @@ def _make_operator(client, recorder, clock, max_unavailable: str,
         # its fail-static degraded mode run in EVERY campaign — an
         # apiserver-blackout window must flip the operator degraded,
         # and ordinary flake windows exercise the read retries
-        resilience=resilience)
+        resilience=resilience,
+        # the fleet usage meter rides the reconcile tick; the
+        # usage-conservation invariant replays its ledger records
+        usage=usage)
 
 
 class SimJob:
@@ -592,6 +603,17 @@ def run_scenario(scenario: Scenario, seed: int,
     identities = ("op-a", "op-b")
     profilers: Dict[str, TickProfiler] = {}
 
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="chaos-campaign-")
+        workdir = tmp.name
+    # every candidate (and every reboot incarnation) meters the SAME
+    # durable usage ledger, exactly like the goodput file: only the
+    # leader settles ticks into it, standbys forget their in-memory
+    # account, and a promotion/reboot resumes from the ledger tail
+    usage_path = os.path.join(workdir, "usage.jsonl")
+    goodput_path = os.path.join(workdir, "goodput.jsonl")
+
     def make_candidate(identity: str):
         client = injector.client(identity)
         tracer = None
@@ -620,9 +642,14 @@ def run_scenario(scenario: Scenario, seed: int,
                                 identity,
                                 lease_duration_s=LEASE_DURATION_S,
                                 retry_period_s=LEASE_RETRY_S, clock=clock)
+        meter = UsageMeter(
+            clock=clock,
+            billing=BillingEngine(UsageLedger(usage_path), clock=clock,
+                                  goodput_path=goodput_path))
         op = _make_operator(client, cluster.recorder, clock,
                             scenario.max_unavailable, tracer=tracer,
-                            shard_workers=shard_workers, resilience=res)
+                            shard_workers=shard_workers, resilience=res,
+                            usage=meter)
         # every candidate's fleet black box sees every injected fault —
         # the labeled ground truth its cause reports are scored against
         # (a reboot gets already-applied faults replayed in, backdated)
@@ -632,16 +659,11 @@ def run_scenario(scenario: Scenario, seed: int,
     candidates: Dict[str, tuple] = {
         identity: make_candidate(identity) for identity in identities}
 
-    tmp = None
-    if workdir is None:
-        tmp = tempfile.TemporaryDirectory(prefix="chaos-campaign-")
-        workdir = tmp.name
     # the training job runs on the LAST host of slice 0; the serving
     # replicas sit on each slice's FIRST host — the capacity market
     # trades the training node between the two without ever putting both
     # workloads on one host
-    job = SimJob(os.path.join(workdir, "goodput.jsonl"),
-                 scenario.fleet.slice_hosts(0)[-1], clock)
+    job = SimJob(goodput_path, scenario.fleet.slice_hosts(0)[-1], clock)
     tier = ServingTier(cluster, clock, injector, scenario.fleet, seed,
                        reqtrace=reqtrace)
     if tier.timeline is not None:
@@ -814,6 +836,14 @@ def run_scenario(scenario: Scenario, seed: int,
                         kill(identity, killed.reason)
                 elif arb is not leader_arbiter:
                     arb.standby()
+                    # the usage account follows the same standby
+                    # discipline: a non-leader forgets its in-memory
+                    # totals and re-resumes from the ledger tail if it
+                    # ever leads again — never re-billing a span the
+                    # real leader already settled
+                    usage = candidates[identity][1].usage
+                    if usage is not None:
+                        usage.standby()
             for hook in hooks or []:
                 hook(cluster=cluster, clock=clock, keys=keys, tick=tick,
                      router=tier.router)
@@ -834,7 +864,8 @@ def run_scenario(scenario: Scenario, seed: int,
                 ledger_path=job.path, workload_node=job.node_name,
                 tick_seconds=scenario.tick_seconds,
                 router=tier.router, market=leader_arbiter,
-                reqtrace=tier.router.reqtrace)
+                reqtrace=tier.router.reqtrace,
+                usage_ledger_path=usage_path)
             for inv in checks:
                 violations.extend(inv.check(view))
             if violations and stop_on_violation:
@@ -859,6 +890,15 @@ def run_scenario(scenario: Scenario, seed: int,
                                         clock.now() - 10_000.0, msg))
     finally:
         job.close()
+        # fleet-ledger digest BEFORE the tempdir goes away: the
+        # usage-determinism test pins same-seed reruns byte-identical
+        try:
+            with open(usage_path, "rb") as fh:
+                payload = fh.read()
+            usage_digest = hashlib.sha256(payload).hexdigest()
+            usage_records = payload.count(b"\n")
+        except OSError:
+            usage_digest, usage_records = None, 0
         if tmp is not None:
             tmp.cleanup()
     cause_reports = {
@@ -891,7 +931,8 @@ def run_scenario(scenario: Scenario, seed: int,
         reqtrace_payload=(tier.router.reqtrace.payload()
                           if tier.router.reqtrace is not None else None),
         cause_reports=cause_reports,
-        attribution=_score_attribution(cause_reports, injector))
+        attribution=_score_attribution(cause_reports, injector),
+        usage_digest=usage_digest, usage_records=usage_records)
 
 
 def _converged(cluster: FakeCluster, keys: KeyFactory,

@@ -62,6 +62,16 @@ The catalog (:data:`INVARIANT_NAMES`):
                       router's own ledger counted a migration (splice
                       transitions == migration successes, fallback
                       transitions == migration fallbacks).
+``usage-conservation``  every tick record the fleet ledger appends
+                      attributes EVERY node to exactly one usage kind:
+                      per record Σ counts == nodes (integers — no float
+                      drift), capacity seconds == nodes × elapsed, every
+                      claimed kind is in the closed ``USAGE_KINDS``
+                      catalog, DEGRADED ticks attribute the whole fleet
+                      as ``degraded-frozen`` (never ``idle`` — a frozen
+                      fleet is not an idle fleet), and cumulative
+                      capacity never regresses across leader failover
+                      (the ledger-tail resume carried the totals over).
 ``market-conservation``  every slice the capacity arbiter manages is
                       owned by exactly one of training / serving /
                       draining / quarantined each tick, owner labels on
@@ -100,6 +110,7 @@ INVARIANT_NAMES = (
     "market-conservation",
     "router-stream-integrity",
     "request-trace-integrity",
+    "usage-conservation",
 )
 
 # fault type -> invariants that fault is designed to stress; CHS001
@@ -117,7 +128,8 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
     "leader-loss": ("single-leader", "journey", "event-dedup"),
     "eviction-storm": ("budget", "journey", "attribution"),
     "spot-reclaim": ("attribution", "event-dedup",
-                     "router-exactly-once", "router-admission"),
+                     "router-exactly-once", "router-admission",
+                     "usage-conservation"),
     "replica-kill": ("router-exactly-once", "router-stream-integrity",
                      "request-trace-integrity"),
     "metrics-flake": ("router-admission", "router-exactly-once"),
@@ -128,16 +140,19 @@ FAULT_COVERAGE: Dict[str, Tuple[str, ...]] = {
                           "router-exactly-once",
                           "request-trace-integrity"),
     "flash-crowd": ("market-conservation", "router-exactly-once",
-                    "router-admission"),
+                    "router-admission", "usage-conservation"),
     # fail-static: during the blackout the operator must take NOTHING
     # new out of service (budget), never corrupt a journey off stale
-    # state, keep the serving tier whole, and keep event delivery exact
+    # state, keep the serving tier whole, keep event delivery exact —
+    # and bill the frozen fleet as degraded-frozen, never idle
     "apiserver-blackout": ("budget", "journey", "event-dedup",
-                           "router-exactly-once"),
+                           "router-exactly-once", "usage-conservation"),
     # crash-restart: a fresh process resuming from durable labels alone
-    # must keep journeys continuous, never double-lead, and never
-    # re-take budget it cannot remember holding
-    "operator-crash": ("journey", "single-leader", "budget"),
+    # must keep journeys continuous, never double-lead, never re-take
+    # budget it cannot remember holding — and resume the usage ledger
+    # from its tail so no capacity second is dropped or double-counted
+    "operator-crash": ("journey", "single-leader", "budget",
+                       "usage-conservation"),
 }
 
 # Legal pipeline edges (upgrade_state.py processing order + the failure
@@ -217,6 +232,10 @@ class CampaignView:
     # serving tier or tracing is off); the request-trace-integrity
     # invariant replays its closed + open timelines
     reqtrace: Optional[object] = None
+    # the shared fleet usage ledger (workdir/usage.jsonl — every
+    # candidate appends to the same path, like the goodput ledger); the
+    # usage-conservation invariant replays each new tick record
+    usage_ledger_path: Optional[str] = None
 
 
 class Invariant:
@@ -760,6 +779,96 @@ class RequestTraceIntegrityInvariant(Invariant):
         return out
 
 
+class UsageConservationInvariant(Invariant):
+    """The fleet ledger's conservation law, replayed record by record:
+
+    - Σ attributed node counts == the record's node count, EXACTLY
+      (integer equality — attribution is a partition, so nothing is
+      dropped and nothing is double-claimed);
+    - capacity seconds == nodes × elapsed seconds (attribution happens
+      in integer node counts; seconds are derived once, so the sum
+      law survives in seconds too, with no float drift);
+    - every claimed kind is in the closed ``USAGE_KINDS`` catalog;
+    - a DEGRADED tick attributes the whole fleet as ``degraded-frozen``
+      and claims zero ``idle`` — fail-static capacity is lost to the
+      degradation, and billing it as idle would hide the outage cost;
+    - cumulative capacity seconds never regress between consecutive
+      records — a promoted standby must resume from the ledger tail,
+      not restart the totals (failover continuity).
+
+    Stateful: records already replayed are never re-checked, so each
+    violation is reported once, at the tick its record appeared."""
+
+    name = "usage-conservation"
+
+    def __init__(self):
+        self._seen = 0
+        self._prev_cum_capacity = 0.0
+
+    def check(self, view: CampaignView) -> List[Violation]:
+        path = view.usage_ledger_path
+        if not path:
+            return []
+        from ..obs.billing import UsageLedger
+        from ..obs.usage import USAGE_KINDS
+        try:
+            records = UsageLedger(path).read()
+        except FileNotFoundError:
+            return []
+        out: List[Violation] = []
+        for rec in records[self._seen:]:
+            if rec.get("kind") != "usage":
+                continue
+            tick = rec.get("tick")
+            counts = rec.get("counts") or {}
+            nodes = int(rec.get("nodes", 0))
+            claimed = sum(int(n) for lanes in counts.values()
+                          for n in lanes.values())
+            if claimed != nodes:
+                out.append(self._v(
+                    view, f"usage record tick={tick} attributes "
+                    f"{claimed} node(s) but the fleet had {nodes} — "
+                    f"conservation broken ({counts})"))
+            unknown = sorted(k for k in counts if k not in USAGE_KINDS)
+            if unknown:
+                out.append(self._v(
+                    view, f"usage record tick={tick} claims unknown "
+                    f"kind(s) {unknown} (catalog: "
+                    f"{', '.join(USAGE_KINDS)})"))
+            want_capacity = nodes * float(rec.get("elapsed_s", 0.0))
+            if abs(float(rec.get("capacity_s", 0.0))
+                   - want_capacity) > 1e-6:
+                out.append(self._v(
+                    view, f"usage record tick={tick} capacity "
+                    f"{rec.get('capacity_s')}s != nodes × elapsed "
+                    f"({want_capacity}s)"))
+            if rec.get("degraded"):
+                frozen = sum(int(n) for n in
+                             (counts.get("degraded-frozen")
+                              or {}).values())
+                if frozen != nodes or any(
+                        kind != "degraded-frozen" and any(
+                            lanes.values())
+                        for kind, lanes in counts.items()):
+                    out.append(self._v(
+                        view, f"DEGRADED usage record tick={tick} must "
+                        f"attribute all {nodes} node(s) as "
+                        f"degraded-frozen, got {counts} (a frozen "
+                        f"fleet is never idle)"))
+            cum_capacity = float(
+                (rec.get("cum") or {}).get("capacity_s", 0.0))
+            if cum_capacity + 1e-6 < self._prev_cum_capacity:
+                out.append(self._v(
+                    view, f"usage record tick={tick} cumulative "
+                    f"capacity regressed {self._prev_cum_capacity}s -> "
+                    f"{cum_capacity}s (ledger-tail resume lost across "
+                    f"failover)"))
+            self._prev_cum_capacity = max(self._prev_cum_capacity,
+                                          cum_capacity)
+        self._seen = len(records)
+        return out
+
+
 def default_invariants() -> List[Invariant]:
     alerts = AlertTransitionInvariant()
     return [
@@ -774,4 +883,5 @@ def default_invariants() -> List[Invariant]:
         MarketConservationInvariant(),
         RouterStreamIntegrityInvariant(),
         RequestTraceIntegrityInvariant(),
+        UsageConservationInvariant(),
     ]

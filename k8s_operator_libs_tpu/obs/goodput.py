@@ -331,3 +331,26 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                           if rec.get("kind") in ("step", "run_end",
                                                  "run_start")), default=0),
     }
+
+
+def publish_summary(summary: Dict[str, Any], metrics) -> None:
+    """Export a :func:`summarize` result as workload gauges — the
+    efficiency decomposition ``cmd/train.py`` used to only print, now on
+    ``/metrics`` and in the tsdb for the fleet billing engine to read:
+
+    - ``goodput_fraction`` / ``goodput_seconds`` gauges,
+    - ``badput_phase_seconds{phase=...}`` per badput cause (idle_gap
+      included — the evicted window is badput like any other).
+
+    Rendered under the ``tpu_workload`` prefix like every other gauge on
+    the trainer's hub (HELP_TEXTS carries the full names)."""
+    if metrics is None or not summary:
+        return
+    fraction = summary.get("goodput_fraction")
+    if fraction is not None:
+        metrics.set_gauge("goodput_fraction", float(fraction))
+    metrics.set_gauge("goodput_seconds",
+                      float(summary.get("goodput_s", 0.0)))
+    for phase, seconds in (summary.get("badput_s") or {}).items():
+        metrics.set_gauge("badput_phase_seconds", float(seconds),
+                          labels={"phase": phase})

@@ -384,6 +384,35 @@ HELP_TEXTS: Dict[str, str] = {
         "Traded slices returned to training since arbiter start",
     "tpu_market_slices_lent":
         "Managed slices currently owned by serving (lent or mid-trade)",
+    # fleet usage-accounting families (obs/usage.py — OBS005 closes
+    # these over the USAGE_*_FAMILIES tables both ways)
+    "tpu_operator_usage_seconds_total":
+        "Capacity seconds attributed per usage kind and serving lane; "
+        "per tick the attributed seconds sum EXACTLY to nodes x tick "
+        "seconds (the conservation law, docs/observability.md "
+        "\"Utilization & cost accounting\")",
+    "tpu_operator_usage_efficiency":
+        "Cumulative productive fraction of fleet capacity: serving + "
+        "training seconds over all attributed seconds",
+    "tpu_operator_usage_capacity_nodes":
+        "Nodes whose capacity the usage meter attributed last tick",
+    "tpu_operator_usage_fleet_goodput_fraction":
+        "Fleet goodput headline: serving seconds plus training seconds "
+        "discounted by the trainer's goodput fraction, over capacity "
+        "seconds",
+    # workload goodput-summary gauges (obs/goodput.py publish_summary —
+    # the trainer's own efficiency account, exported so /metrics and the
+    # tsdb see what cmd/train.py used to only print)
+    "tpu_workload_goodput_fraction":
+        "Productive fraction of this workload's wall time, from the "
+        "goodput ledger summary (1.0 = every second was train steps)",
+    "tpu_workload_goodput_seconds":
+        "Seconds of productive train-step time in the goodput ledger "
+        "summary window",
+    "tpu_workload_badput_phase_seconds":
+        "Badput seconds by cause phase (compile / rewarmup / ckpt_save "
+        "/ drain_save / ckpt_restore / degraded / idle_gap) from the "
+        "goodput ledger summary",
 }
 
 # ratio-valued histograms (occupancy, utilization) need sub-1.0 buckets —

@@ -26,10 +26,11 @@ from ..api.v1alpha1 import DriverUpgradePolicySpec
 from ..core.client import ApiError, Client, EventRecorder
 from ..core.resilience import BreakerOpenError, ResilientClient
 from ..upgrade.consts import UpgradeState
-from ..wire import (PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
+from ..wire import (LANE_LABEL, MARKET_OWNER_LABEL,
+                    PRE_QUARANTINE_CORDON_ANNOTATION, QUARANTINE_LABEL,
                     QUARANTINE_LIFT_ANNOTATION,
                     QUARANTINE_REASON_ANNOTATION, QUARANTINE_TAINT_KEY,
-                    REPAIR_ANNOTATION)
+                    REPAIR_ANNOTATION, REPLICA_ID_LABEL)
 from ..health import metrics as health_metrics
 from ..health.consts import HealthVerdict
 from ..health.monitor import (FleetHealthMonitor, HealthOptions,
@@ -41,6 +42,7 @@ from ..obs.metrics import API_LATENCY_BUCKETS
 from ..obs.slo import SLOEngine, SLOOptions
 from ..obs.timeline import FleetTimeline
 from ..obs.tsdb import TimeSeriesStore
+from ..obs.usage import MAINTENANCE_STATES, NodeSignals, UsageMeter
 from ..upgrade import metrics as upgrade_metrics
 from ..upgrade.groups import GroupPolicy
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
@@ -77,7 +79,8 @@ class TPUOperator:
                  shard_workers: int = 0, shard_parallel: bool = True,
                  verify_incremental: bool = False,
                  resilience: Optional[ResilientClient] = None,
-                 timeline: Optional[FleetTimeline] = None):
+                 timeline: Optional[FleetTimeline] = None,
+                 usage: Optional[UsageMeter] = None):
         self.client = client
         self.components = components
         self.clock = clock or RealClock()
@@ -94,6 +97,10 @@ class TPUOperator:
         # on (like the journey annotations) — it is fixed-memory and
         # lock-free, so a library consumer pays one bounded ring.
         self.timeline = timeline or FleetTimeline(clock=self.clock)
+        # fleet ledger (obs/usage.py): every node-second this tick joined
+        # lands in exactly one usage bucket — conservation-checked
+        # utilization accounting, optionally billed to a durable ledger
+        self.usage = usage
         self.scheduler = SliceScheduler(client, metrics=metrics,
                                         clock=self.clock)
         self._pending: List[TPUWorkload] = []
@@ -328,6 +335,26 @@ class TPUOperator:
                                     placement.slice_id)
                         self.placements.append(placement)
             self._pending = still_pending
+            # fleet ledger: attribute this tick's capacity off the nodes
+            # the tick already joined (no extra LISTs) — BEFORE the SLO
+            # scrape so the usage gauges land in this tick's tsdb sample
+            if self.usage is not None:
+                with self._span("usage-tick"):
+                    # a tick where NO component state built (apiserver
+                    # dying, breaker not yet open) saw nothing — the
+                    # fleet didn't shrink to zero, we were blind. Skip
+                    # the observation and leave the span open: the next
+                    # real (or degraded) tick attributes it, so the
+                    # capacity seconds never silently vanish
+                    blind = (bool(self.components)
+                             and all(s is None for s in states.values()))
+                    try:
+                        if not blind:
+                            self.usage.observe(
+                                self._usage_signals(states))
+                    except Exception:  # exc: allow — usage accounting is observability; a meter bug must not stop the tick
+                        logger.exception("usage tick failed; reconcile "
+                                         "result unaffected")
         self._last_fresh = self.clock.now()
         if self.metrics is not None:
             self.metrics.set_gauge("degraded", 0.0)
@@ -450,6 +477,14 @@ class TPUOperator:
                 return True
             if self.health_monitor is not None:
                 self.last_health = self.health_monitor.masked_report()
+            if self.usage is not None:
+                # the frozen fleet is still capacity: every last-known
+                # node bills as degraded-frozen, never idle — fail-static
+                # waste must be visible in the account
+                try:
+                    self.usage.observe_degraded()
+                except Exception:  # exc: allow — usage accounting is observability, also while degraded
+                    logger.exception("degraded usage tick failed")
         # observability keeps working through the outage: the tsdb
         # scrape is in-memory and alert Events ride the exempt
         # create_event path, so a burn that started before the blackout
@@ -584,6 +619,48 @@ class TPUOperator:
         self.alert_manager.evaluate(self.slo_engine.alert_conditions(
             self.last_slo, page_for_s=opts.page_for_s,
             ticket_for_s=opts.ticket_for_s))
+
+    def _usage_signals(self, states: Dict[str, Optional[object]]
+                       ) -> List[NodeSignals]:
+        """Join the usage meter's per-node signals off the nodes this
+        tick's BuildState already holds — no extra apiserver LISTs, and
+        the obs layer never sees a label key (ARC001): quarantine /
+        upgrade-state / market-owner / serving-lane label VALUES plus
+        the operator's own placements, one :class:`NodeSignals` per
+        unique node."""
+        placed: set = set()
+        for placement in self.placements:
+            placed.update(placement.node_names)
+        state_labels = [keys.state_label
+                        for keys in self._all_keys.values()]
+        signals: Dict[str, NodeSignals] = {}
+        for comp in self.components:
+            state = states.get(comp.name)
+            if state is None:
+                continue
+            for bucket in state.node_states.values():
+                for ns in bucket:
+                    node = ns.node
+                    name = node.metadata.name
+                    sig = signals.get(name)
+                    if sig is None:
+                        sig = signals[name] = NodeSignals(
+                            node=name, training=name in placed)
+                    labels = node.metadata.labels
+                    if QUARANTINE_LABEL in labels:
+                        sig.quarantined = True
+                    for state_label in state_labels:
+                        value = labels.get(state_label, "")
+                        if value in MAINTENANCE_STATES:
+                            # any component mid-maintenance claims the
+                            # node; idle/done values never overwrite it
+                            sig.upgrade_state = value
+                    sig.market_owner = labels.get(MARKET_OWNER_LABEL,
+                                                  sig.market_owner)
+                    sig.lane = labels.get(LANE_LABEL, sig.lane)
+                    if REPLICA_ID_LABEL in labels:
+                        sig.replica = True
+        return list(signals.values())
 
     def _check_stuck_nodes(self, states: Dict[str, Optional[object]]) -> None:
         """Run each component's stuck detector over the nodes this tick's

@@ -17,7 +17,9 @@ from k8s_operator_libs_tpu.chaos.campaign import (run_scenario,
 from k8s_operator_libs_tpu.chaos.faults import FaultEvent
 from k8s_operator_libs_tpu.core.client import ConflictError, ServerError
 from k8s_operator_libs_tpu.core.fakecluster import FakeCluster
+from k8s_operator_libs_tpu.obs.billing import UsageLedger
 from k8s_operator_libs_tpu.obs.goodput import read_ledger, split_runs
+from k8s_operator_libs_tpu.obs.usage import USAGE_KINDS
 from k8s_operator_libs_tpu.upgrade.util import KeyFactory
 from k8s_operator_libs_tpu.utils.clock import FakeClock
 
@@ -691,3 +693,135 @@ def test_router_admission_invariant_catches_cordoned_placement():
              for n in cluster.client.direct().list_nodes()}
     out = inv.check(_campaign_view_for(router, nodes))
     assert len(out) == 1 and "CORDONED" in out[0].detail
+
+
+# ------------------------------------------------- fleet usage ledger
+
+# the ISSUE 20 composite acceptance scenario: a flash crowd DURING a
+# rolling upgrade DURING a spot reclaim DURING an apiserver blackout —
+# four correlated faults, and still every slice-second of capacity lands
+# in exactly one usage bucket, with the blackout's frozen ticks billed
+# as degraded-frozen, never laundered into idle
+USAGE_CHAOS = {
+    "name": "usage-conservation-composite",
+    "max_ticks": 500,
+    "fleet": {"slices": 2, "hosts_per_slice": 4, "solo_nodes": 0},
+    "upgrade_at": 30.0,
+    "faults": [
+        {"type": "flash-crowd", "at": 45.0, "duration": 400.0,
+         "requestsPerTick": 25},
+        {"type": "spot-reclaim", "at": 90.0, "duration": 120.0,
+         "deadlineSeconds": 60.0, "slices": [1]},
+        {"type": "apiserver-blackout", "at": 150.0, "duration": 90.0},
+    ],
+}
+
+
+def test_campaign_composite_usage_conservation(tmp_path):
+    """ACCEPTANCE (ISSUE 20): the composite scenario converges with the
+    usage-conservation invariant (and every older one) green, the
+    shared ledger accounts capacity through the blackout's fail-static
+    freeze, and the frozen ticks are attributed as degraded-frozen."""
+    res = run_scenario(parse_scenario(USAGE_CHAOS), seed=29,
+                       workdir=str(tmp_path))
+    assert res.violations == [], "\n".join(map(str, res.violations))
+    assert res.converged, res.report()
+    assert res.usage_records > 0 and res.usage_digest
+    records = [r for r in UsageLedger(
+        str(tmp_path / "usage.jsonl")).read() if r.get("kind") == "usage"]
+    assert len(records) == res.usage_records
+    # conservation, re-checked here record by record (the invariant
+    # already replayed these during the run — this is the belt)
+    for rec in records:
+        claimed = sum(int(n) for lanes in rec["counts"].values()
+                      for n in lanes.values())
+        assert claimed == rec["nodes"], rec
+        assert set(rec["counts"]) <= set(USAGE_KINDS)
+    # the blackout froze the operator: its ticks bill as degraded-frozen
+    degraded = [r for r in records if r["degraded"]]
+    assert degraded, "the blackout never produced a degraded tick"
+    for rec in degraded:
+        assert set(rec["counts"]) == {"degraded-frozen"}, rec
+    # cumulative capacity is monotone across the failovers the blackout
+    # induced — the ledger-tail resume held
+    cums = [r["cum"]["capacity_s"] for r in records]
+    assert cums == sorted(cums)
+    assert cums[-1] > 0
+    # the account saw productive AND waste kinds (the upgrade and the
+    # reclaim both ran), so the efficiency headline means something
+    kinds_seen = set()
+    for rec in records:
+        kinds_seen.update(k for k, lanes in rec["counts"].items()
+                          if any(lanes.values()))
+    assert "serving" in kinds_seen or "training" in kinds_seen
+    assert "upgrade-maintenance" in kinds_seen
+
+
+def test_campaign_usage_ledger_replay_is_byte_identical():
+    """Same seed, same scenario → byte-identical usage ledgers (the
+    acceptance digest check: billing is deterministic end to end)."""
+    sc = parse_scenario(USAGE_CHAOS)
+    r1 = run_scenario(sc, seed=31)
+    r2 = run_scenario(sc, seed=31)
+    assert r1.usage_digest is not None
+    assert r1.usage_digest == r2.usage_digest
+    assert r1.usage_records == r2.usage_records
+
+
+def test_usage_conservation_invariant_fires(tmp_path):
+    """Hand-written rogue ledgers: every violation class the checker
+    promises to catch, caught at the record it appears — and only
+    once (the stateful replay cursor)."""
+    from k8s_operator_libs_tpu.chaos.invariants import (
+        CampaignView, UsageConservationInvariant)
+    from k8s_operator_libs_tpu.obs.billing import UsageLedger as Ledger
+    path = str(tmp_path / "usage.jsonl")
+
+    def view():
+        return CampaignView(tick=1, t=15.0, nodes={}, keys=KEYS,
+                            budget=10, fault_notready=set(),
+                            leaders=["op-a"], recorder_events=[],
+                            alert_status={}, usage_ledger_path=path)
+
+    ledger = Ledger(path)
+    inv = UsageConservationInvariant()
+    assert inv.check(view()) == []          # empty ledger: green
+    ledger.append({"kind": "usage", "tick": 1, "t": 10.0,
+                   "elapsed_s": 1.0, "nodes": 4, "capacity_s": 4.0,
+                   "degraded": False, "counts": {"idle": {"-": 4}},
+                   "cum": {"capacity_s": 4.0, "ticks": 1}})
+    assert inv.check(view()) == []          # a clean record: green
+    # under-claim: 4 nodes, 3 attributed
+    ledger.append({"kind": "usage", "tick": 2, "t": 11.0,
+                   "elapsed_s": 1.0, "nodes": 4, "capacity_s": 4.0,
+                   "degraded": False, "counts": {"idle": {"-": 3}},
+                   "cum": {"capacity_s": 8.0, "ticks": 2}})
+    out = inv.check(view())
+    assert len(out) == 1 and "conservation broken" in out[0].detail
+    assert inv.check(view()) == []          # replayed once, not twice
+    # unknown kind + capacity != nodes x elapsed
+    ledger.append({"kind": "usage", "tick": 3, "t": 12.0,
+                   "elapsed_s": 1.0, "nodes": 2, "capacity_s": 9.0,
+                   "degraded": False, "counts": {"napping": {"-": 2}},
+                   "cum": {"capacity_s": 17.0, "ticks": 3}})
+    out = inv.check(view())
+    details = " | ".join(v.detail for v in out)
+    assert "unknown kind(s) ['napping']" in details
+    assert "!= nodes × elapsed" in details
+    # a DEGRADED tick that launders frozen capacity into idle
+    ledger.append({"kind": "usage", "tick": 4, "t": 13.0,
+                   "elapsed_s": 1.0, "nodes": 4, "capacity_s": 4.0,
+                   "degraded": True,
+                   "counts": {"degraded-frozen": {"-": 2},
+                              "idle": {"-": 2}},
+                   "cum": {"capacity_s": 21.0, "ticks": 4}})
+    out = inv.check(view())
+    assert len(out) == 1 and "never idle" in out[0].detail
+    # cumulative capacity regression: the resume-from-tail was lost
+    ledger.append({"kind": "usage", "tick": 5, "t": 14.0,
+                   "elapsed_s": 1.0, "nodes": 4, "capacity_s": 4.0,
+                   "degraded": False, "counts": {"idle": {"-": 4}},
+                   "cum": {"capacity_s": 4.0, "ticks": 1}})
+    out = inv.check(view())
+    assert len(out) == 1 and "regressed" in out[0].detail
+    assert "resume lost across" in out[0].detail

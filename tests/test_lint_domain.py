@@ -43,7 +43,8 @@ def test_registry_has_all_passes():
     names = {c.name for c in REGISTRY}
     assert {"generic", "jax-hygiene", "lock-discipline", "lock-order",
             "determinism", "state-machine", "obs-journey",
-            "obs-attribution", "obs-slo", "obs-timeline", "chaos-closure",
+            "obs-attribution", "obs-slo", "obs-timeline", "obs-usage",
+            "chaos-closure",
             "crash-closure", "wire-closure",
             "sync-hygiene", "thread-discipline", "import-layering",
             "exc-contracts", "exc-swallow", "exc-kill",
@@ -51,7 +52,8 @@ def test_registry_has_all_passes():
     all_codes = lint.all_codes()
     assert {"JAX001", "JAX002", "JAX003", "JAX004", "LCK001", "LCK002",
             "LCK003", "LCK004", "DET001", "DET002", "STM001", "OBS001",
-            "OBS002", "OBS003", "OBS004", "CHS001", "CRS001", "WIRE001",
+            "OBS002", "OBS003", "OBS004", "OBS005", "CHS001", "CRS001",
+            "WIRE001",
             "SYN001",
             "THR001", "GRD001", "ARC001", "EXC001", "EXC002", "EXC003",
             "STL001"} <= set(all_codes)
@@ -884,8 +886,8 @@ def test_chs001_orphan_invariant_fails(tmp_path):
     """An invariant no fault stresses is a checker that rots silently."""
     root = _chs_root(tmp_path, mutate={
         chaos_check.INVARIANTS_PATH: lambda s: s.replace(
-            '    "request-trace-integrity",\n)',
-            '    "request-trace-integrity",\n    "entropy",\n)')})
+            '    "usage-conservation",\n)',
+            '    "usage-conservation",\n    "entropy",\n)')})
     findings = chaos_check.run_project(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "entropy" in msgs and "stressed by no fault" in msgs
@@ -1809,6 +1811,158 @@ def test_obs003_reqtrace_table_gutted_fails(tmp_path):
     findings = obs_check.run_slo(root)
     msgs = " | ".join(m for (_, _, _, m) in findings)
     assert "REQTRACE_GAUGE_FAMILIES" in msgs
+
+
+# ------------------------------------------------ OBS005 (scratch roots)
+
+OBS5_FILES = [obs_check.USAGE_PATH, obs_check.METRICS_PATH]
+
+
+def _obs5_root(tmp_path, mutate=None, skip=()):
+    root = tmp_path / "repo5"
+    for rel in OBS5_FILES:
+        if rel in skip:
+            continue
+        src = (REPO / rel).read_text()
+        if mutate and rel in mutate:
+            src = mutate[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def test_obs005_real_repo_files_pass(tmp_path):
+    assert obs_check.run_usage(_obs5_root(tmp_path)) == []
+
+
+def test_obs005_real_repo_passes():
+    assert obs_check.run_usage(REPO) == []
+
+
+def test_obs005_catalog_kind_without_rank_fails(tmp_path):
+    """A cataloged kind with no KIND_PRIORITY rank makes the first
+    _bid() claim raise at runtime — and, having no claim site, it also
+    fires as dead vocabulary."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            '    "idle",',
+            '    "ghost-kind",\n    "idle",')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert "'ghost-kind'" in msgs and "no KIND_PRIORITY rank" in msgs
+    assert "never claimed by any _bid() site" in msgs
+
+
+def test_obs005_renamed_priority_key_fails_both_ways(tmp_path):
+    """Renaming a KIND_PRIORITY key away from its catalog entry fires
+    from both directions: a rank nothing can claim AND a kind whose
+    claim would raise."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            '    "degraded-frozen": 6,',
+            '    "degraded-f": 6,')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert "'degraded-f'" in msgs and "not in the USAGE_KINDS" in msgs
+    assert ("'degraded-frozen' has no KIND_PRIORITY rank" in msgs
+            or "'degraded-frozen'" in msgs)
+
+
+def test_obs005_uncataloged_bid_kind_fails(tmp_path):
+    """A typo'd _bid() literal would raise ValueError on the first
+    claim — the pass fails naming the kind, and 'idle' simultaneously
+    loses its only claim site."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            'bids = [_bid("idle")]',
+            'bids = [_bid("idlez")]')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert "'idlez'" in msgs and "would raise ValueError" in msgs
+    assert "'idle'" in msgs and "never claimed by any _bid() site" in msgs
+
+
+def test_obs005_non_literal_bid_kind_fails(tmp_path):
+    """A computed kind at a _bid() site defeats the catalog closure even
+    when it happens to be valid at runtime."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            '        bids.append(_bid("health-quarantine"))',
+            '        bids.append(_bid(str("health-" + "quarantine")))')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert "string literal" in msgs
+    assert ("'health-quarantine'" in msgs
+            and "never claimed by any _bid() site" in msgs)
+
+
+def test_obs005_hatched_catalog_kind_stays_silent(tmp_path):
+    """`# obs: allow — <why>` on the catalog line is the escape hatch
+    for kinds reserved ahead of their attribution site (the kind still
+    needs a rank, or closure 1 fires)."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            '    "idle",',
+            '    "ghost-kind",  # obs: allow — reserved for plugins\n'
+            '    "idle",').replace(
+            '    "idle": 0,',
+            '    "ghost-kind": 0,\n    "idle": 0,')})
+    assert obs_check.run_usage(root) == []
+
+
+def test_obs005_emitted_family_without_help_fails(tmp_path):
+    """A family the meter emits with no HELP_TEXTS entry is an
+    unregistered metric (the OBS003 discipline, scoped to the usage
+    prefix)."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            'USAGE_GAUGE_FAMILIES = ("usage_efficiency", '
+            '"usage_capacity_nodes",',
+            'USAGE_GAUGE_FAMILIES = ("usage_efficiency", '
+            '"usage_phantom", "usage_capacity_nodes",')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert ("'tpu_operator_usage_phantom'" in msgs
+            and "no HELP_TEXTS entry" in msgs)
+
+
+def test_obs005_stale_usage_help_entry_fails(tmp_path):
+    """A tpu_operator_usage_* HELP entry matching no emitted family is
+    a stale registration (renamed or removed usage metric)."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.METRICS_PATH: lambda s: s.replace(
+            '    "tpu_operator_usage_seconds_total":',
+            '    "tpu_operator_usage_ghost":\n'
+            '        "stale help text for a family nothing emits",\n'
+            '    "tpu_operator_usage_seconds_total":')})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert findings and all(c == "OBS005" for (_, _, c, _) in findings)
+    assert ("'tpu_operator_usage_ghost'" in msgs
+            and "matches no emitted family" in msgs)
+
+
+def test_obs005_no_usage_module_skips(tmp_path):
+    """A checkout without obs/usage.py must not fire at all — the
+    closure needs the catalog side present."""
+    root = _obs5_root(tmp_path, skip={obs_check.USAGE_PATH})
+    assert obs_check.run_usage(root) == []
+
+
+def test_obs005_catalog_gutted_is_parse_drift(tmp_path):
+    """Renaming USAGE_KINDS away is parse drift, not a silent pass."""
+    root = _obs5_root(tmp_path, mutate={
+        obs_check.USAGE_PATH: lambda s: s.replace(
+            "USAGE_KINDS = (", "USAGE_KINDZ = (")})
+    findings = obs_check.run_usage(root)
+    msgs = " | ".join(m for (_, _, _, m) in findings)
+    assert "USAGE_KINDS catalog not found" in msgs
 
 
 # ------------------------------------------------ CRS001 (scratch roots)

@@ -1,0 +1,521 @@
+"""Fleet ledger: conservation-checked utilization accounting
+(obs/usage.py), per-tenant cost attribution (obs/billing.py), the
+durable rotated usage ledger with failover resume + standby discipline,
+and the status-surface renderers (`status --usage`, the consolidated
+banner helper with its pinned precedence order).
+"""
+
+import importlib.util
+import json
+import os
+import types
+
+import pytest
+
+from k8s_operator_libs_tpu.obs.billing import (DEFAULT_LANE_WEIGHTS,
+                                               OVERHEAD_TENANT,
+                                               BillingEngine, UsageLedger)
+from k8s_operator_libs_tpu.obs.goodput import publish_summary
+from k8s_operator_libs_tpu.obs.usage import (KIND_PRIORITY, LANE_NONE,
+                                             PRODUCTIVE_KINDS, USAGE_KINDS,
+                                             WASTE_KINDS, NodeSignals,
+                                             UsageMeter, _bid, classify)
+from k8s_operator_libs_tpu.utils.clock import FakeClock
+
+
+def _load_status():
+    spec = importlib.util.spec_from_file_location(
+        "status_cli_usage",
+        os.path.join(os.path.dirname(__file__), "..", "cmd", "status.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class SpyHub:
+    """Minimal MetricsHub stand-in recording emissions."""
+
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+
+    def inc(self, name, by=1.0, labels=None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        self.counters[key] = self.counters.get(key, 0.0) + by
+
+    def set_gauge(self, name, value, labels=None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        self.gauges[key] = value
+
+
+# ------------------------------------------------------- classification
+
+
+def test_catalog_priority_and_partition_agree():
+    """Runtime mirror of OBS005 closure 1: catalog == sweep keys, ranks
+    unique (the winner is deterministic), and the productive/waste
+    partition covers the catalog exactly."""
+    assert set(USAGE_KINDS) == set(KIND_PRIORITY)
+    ranks = list(KIND_PRIORITY.values())
+    assert len(ranks) == len(set(ranks))
+    assert set(PRODUCTIVE_KINDS) | set(WASTE_KINDS) == set(USAGE_KINDS)
+    assert not set(PRODUCTIVE_KINDS) & set(WASTE_KINDS)
+
+
+def test_bid_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown usage kind"):
+        _bid("coffee-break")
+
+
+def test_classify_priority_sweep():
+    """Every double-claim resolves by the documented order:
+    degraded-frozen > health-quarantine > upgrade-maintenance >
+    market-transition > serving > training > idle."""
+    assert classify(NodeSignals("n")) == ("idle", LANE_NONE)
+    assert classify(NodeSignals("n", training=True)) == \
+        ("training", LANE_NONE)
+    assert classify(NodeSignals("n", market_owner="training")) == \
+        ("training", LANE_NONE)
+    # serving beats training; lane rides along
+    assert classify(NodeSignals("n", training=True, replica=True,
+                                lane="interactive")) == \
+        ("serving", "interactive")
+    # market-owned serving capacity with no registered replica yet
+    assert classify(NodeSignals("n", market_owner="serving")) == \
+        ("serving", LANE_NONE)
+    # a draining slice is in transition even while replicas linger
+    assert classify(NodeSignals("n", market_owner="draining",
+                                replica=True, lane="batch")) == \
+        ("market-transition", LANE_NONE)
+    # maintenance window beats the market hand-off
+    assert classify(NodeSignals("n", market_owner="draining",
+                                upgrade_state="drain-required")) == \
+        ("upgrade-maintenance", LANE_NONE)
+    # the failed terminal also holds the node out of service
+    assert classify(NodeSignals("n", upgrade_state="upgrade-failed")) == \
+        ("upgrade-maintenance", LANE_NONE)
+    # quarantine beats everything the subsystems can claim...
+    assert classify(NodeSignals("n", quarantined=True, replica=True,
+                                upgrade_state="cordon-required")) == \
+        ("health-quarantine", LANE_NONE)
+    # ...except the fail-static freeze, which overrides the whole sweep
+    assert classify(NodeSignals("n", quarantined=True, replica=True),
+                    degraded=True) == ("degraded-frozen", LANE_NONE)
+
+
+# ---------------------------------------------------------- conservation
+
+
+def test_meter_conserves_capacity_exactly():
+    clock = FakeClock(10_000.0)
+    meter = UsageMeter(clock=clock)
+    fleet = ([NodeSignals(f"s{i}", replica=True, lane="interactive")
+              for i in range(4)]
+             + [NodeSignals(f"t{i}", training=True) for i in range(3)]
+             + [NodeSignals("q0", quarantined=True),
+                NodeSignals("u0", upgrade_state="drain-required"),
+                NodeSignals("i0")])
+    meter.observe(fleet)          # first tick: no span yet, elapsed 0
+    clock.advance(5.0)
+    rec = meter.observe(fleet)
+    assert rec["elapsed_s"] == 5.0 and rec["nodes"] == 10
+    assert rec["capacity_s"] == 50.0
+    # integer conservation per tick
+    counts = rec["counts"]
+    assert sum(n for lanes in counts.values()
+               for n in lanes.values()) == 10
+    assert counts["serving"]["interactive"] == 4
+    assert counts["training"][LANE_NONE] == 3
+    # cumulative seconds partition capacity with no drift
+    assert meter.capacity_s == 50.0
+    assert sum(meter.kind_seconds().values()) == meter.capacity_s
+    assert meter.efficiency() == pytest.approx(35.0 / 50.0)
+    assert meter.lane_seconds() == {"interactive": 20.0}
+
+
+def test_degraded_tick_freezes_last_known_fleet():
+    """DEGRADED attribution uses the node list from the last healthy
+    tick and books it all as degraded-frozen — never idle."""
+    clock = FakeClock(1_000.0)
+    meter = UsageMeter(clock=clock)
+    meter.observe([NodeSignals("a"), NodeSignals("b", replica=True)])
+    clock.advance(10.0)
+    rec = meter.observe_degraded()
+    assert rec["degraded"] is True and rec["nodes"] == 2
+    assert rec["counts"] == {"degraded-frozen": {LANE_NONE: 2}}
+    assert meter.kind_seconds()["idle"] == 0.0
+    assert meter.kind_seconds()["degraded-frozen"] == 20.0
+    # the frozen list survives repeated degraded ticks
+    clock.advance(10.0)
+    assert meter.observe_degraded()["nodes"] == 2
+
+
+def test_meter_emits_exactly_the_registered_families():
+    """The families the meter emits are the USAGE_*_FAMILIES tables —
+    the runtime half of OBS005 closure 3."""
+    clock = FakeClock(0.0)
+    hub = SpyHub()
+    ledger = None
+    meter = UsageMeter(clock=clock, metrics=hub)
+    meter.observe([NodeSignals("a", training=True)])
+    clock.advance(2.0)
+    meter.observe([NodeSignals("a", training=True)])
+    counter_families = {name for (name, _labels) in hub.counters}
+    gauge_families = {name for (name, _labels) in hub.gauges}
+    assert counter_families == {"usage_seconds_total"}
+    # no billing attached -> no goodput-fraction gauge
+    assert gauge_families == {"usage_efficiency", "usage_capacity_nodes"}
+    assert hub.counters[("usage_seconds_total",
+                         (("kind", "training"),
+                          ("lane", LANE_NONE)))] == 2.0
+    assert ledger is None  # keep flake8 honest about the fixture shape
+
+
+def test_waste_buckets_are_bounded_and_ranked():
+    clock = FakeClock(0.0)
+    meter = UsageMeter(clock=clock, max_waste_buckets=2)
+    meter.observe([NodeSignals("a")])
+    for i in range(6):
+        clock.advance(1.0)
+        # alternate waste kinds so windows open and close
+        sig = (NodeSignals("a", quarantined=True) if i % 2
+               else NodeSignals("a"))
+        meter.observe([sig])
+    buckets = meter.waste_buckets(top=10)
+    assert 0 < len(buckets) <= 3  # <= max closed + open
+    assert all(b["waste"] in WASTE_KINDS for b in buckets)
+    assert [b["node_s"] for b in buckets] == \
+        sorted((b["node_s"] for b in buckets), reverse=True)
+
+
+# ------------------------------------------------------ billing + ledger
+
+
+def test_billing_prices_lanes_training_and_overhead(tmp_path):
+    clock = FakeClock(100.0)
+    ledger = UsageLedger(str(tmp_path / "usage.jsonl"))
+    engine = BillingEngine(ledger, clock=clock,
+                           goodput_path=str(tmp_path / "gp.jsonl"))
+    # a goodput summary cached as if read from the trainer's ledger
+    engine._goodput_summary = {"total_s": 100.0,
+                               "goodput_fraction": 0.75}
+    meter = UsageMeter(clock=clock, billing=engine)
+    fleet = [NodeSignals("s0", replica=True, lane="interactive"),
+             NodeSignals("s1", replica=True, lane="best-effort"),
+             NodeSignals("t0", training=True),
+             NodeSignals("q0", quarantined=True)]
+    meter.observe(fleet)
+    clock.advance(10.0)
+    rec = meter.observe(fleet, lane_tokens={"interactive": 1000})
+    tenants = rec["tenants"]
+    assert tenants["serving/interactive"]["seconds"] == 10.0
+    assert tenants["serving/interactive"]["cost"] == \
+        DEFAULT_LANE_WEIGHTS["interactive"] * 10.0
+    assert tenants["serving/interactive"]["tokens"] == 1000
+    assert tenants["serving/interactive"]["token_cost"] == 4000.0
+    assert tenants["serving/best-effort"]["cost"] == 10.0
+    assert tenants["training"]["goodput_s"] == pytest.approx(7.5)
+    assert tenants["training"]["badput_s"] == pytest.approx(2.5)
+    assert tenants["training"]["cost"] == pytest.approx(7.5)
+    # waste has an owner too
+    assert tenants[OVERHEAD_TENANT]["seconds"] == 10.0
+    # headline: (serving 20 + training 7.5) / 40 billed seconds
+    assert rec["fleet_goodput_fraction"] == pytest.approx(27.5 / 40.0)
+    assert meter.payload()["billing"]["tenants"].keys() == tenants.keys()
+
+
+def test_training_prices_at_parity_without_goodput_ledger(tmp_path):
+    clock = FakeClock(0.0)
+    engine = BillingEngine(UsageLedger(str(tmp_path / "u.jsonl")),
+                           clock=clock)
+    assert engine.training_goodput_fraction() == 1.0
+    meter = UsageMeter(clock=clock, billing=engine)
+    meter.observe([NodeSignals("t", training=True)])
+    clock.advance(4.0)
+    rec = meter.observe([NodeSignals("t", training=True)])
+    assert rec["tenants"]["training"]["badput_s"] == 0.0
+    assert rec["fleet_goodput_fraction"] == 1.0
+
+
+def test_ledger_rotates_and_tail_looks_through_generations(tmp_path):
+    path = str(tmp_path / "usage.jsonl")
+    ledger = UsageLedger(path, max_bytes=200)
+    for i in range(20):
+        ledger.append({"tick": i, "pad": "x" * 40})
+    assert os.path.exists(path + ".1")
+    assert ledger.tail()["tick"] == 19
+    records = ledger.read()
+    assert [r["tick"] for r in records] == \
+        sorted(r["tick"] for r in records)  # rotated generation first
+    # a garbled live tail falls back to a fresh account, not a crash
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    os.unlink(path + ".1")
+    assert ledger.tail() is None
+
+
+def test_meter_resumes_account_from_ledger_tail(tmp_path):
+    """Restart/failover: a fresh meter on the same ledger continues the
+    cumulative account and bills the gap since the old leader's last
+    record exactly once."""
+    path = str(tmp_path / "usage.jsonl")
+    clock = FakeClock(1_000.0)
+    fleet = [NodeSignals("a", training=True), NodeSignals("b")]
+
+    def make_meter():
+        return UsageMeter(clock=clock, billing=BillingEngine(
+            UsageLedger(path), clock=clock))
+
+    old = make_meter()
+    old.observe(fleet)
+    clock.advance(5.0)
+    old.observe(fleet)
+    assert old.capacity_s == 10.0
+    # crash; a new incarnation takes over 7s later
+    clock.advance(7.0)
+    new = make_meter()
+    rec = new.observe(fleet)
+    assert rec["elapsed_s"] == 7.0       # the failover gap, not zero
+    assert rec["tick"] == 3              # continues the tick count
+    assert rec["cum"]["capacity_s"] == 24.0
+    assert new.ticks == 3 and new.capacity_s == 24.0
+    assert rec["tenants"]["training"]["seconds"] == 12.0
+
+
+def test_standby_discipline_prevents_double_billing(tmp_path):
+    """A meter that lost leadership must forget its in-memory span: its
+    stale _last_t would re-charge capacity the new leader already
+    settled. standby() + re-resume from the tail keeps cumulative
+    capacity exact across the lose/re-win cycle."""
+    path = str(tmp_path / "usage.jsonl")
+    clock = FakeClock(0.0)
+    fleet = [NodeSignals("a")]
+    a = UsageMeter(clock=clock, billing=BillingEngine(
+        UsageLedger(path), clock=clock))
+    b = UsageMeter(clock=clock, billing=BillingEngine(
+        UsageLedger(path), clock=clock))
+    a.observe(fleet)
+    clock.advance(10.0)
+    a.observe(fleet)                      # a settled through t=10
+    a.standby()                           # a loses the lease
+    assert a.totals == {} and a.ticks == 0
+    clock.advance(10.0)
+    b.observe(fleet)                      # b led and settled t=10..20
+    b.standby()
+    clock.advance(10.0)
+    rec = a.observe(fleet)                # a re-leads at t=30
+    assert rec["elapsed_s"] == 10.0       # 20 -> 30, NOT 10 -> 30
+    assert rec["cum"]["capacity_s"] == 30.0
+    # every ledger record's cumulative capacity is monotone — the
+    # usage-conservation invariant's failover check
+    cums = [r["cum"]["capacity_s"] for r in UsageLedger(path).read()]
+    assert cums == sorted(cums)
+
+
+def test_same_sequence_replays_byte_identical(tmp_path):
+    """sort_keys compact dumps + FakeClock: the determinism contract the
+    chaos campaign's usage_digest check rides on."""
+
+    def run(path):
+        clock = FakeClock(500.0)
+        meter = UsageMeter(clock=clock, billing=BillingEngine(
+            UsageLedger(path), clock=clock))
+        fleet = [NodeSignals("a", replica=True, lane="batch"),
+                 NodeSignals("b", quarantined=True)]
+        for _ in range(5):
+            meter.observe(fleet, lane_tokens={"batch": 17})
+            clock.advance(3.0)
+        return open(path, "rb").read()
+
+    assert run(str(tmp_path / "one.jsonl")) == \
+        run(str(tmp_path / "two.jsonl"))
+
+
+# ------------------------------------------------- workload goodput gauges
+
+
+def test_publish_summary_exports_workload_gauges():
+    hub = SpyHub()
+    publish_summary({"goodput_fraction": 0.8, "goodput_s": 40.0,
+                     "badput_s": {"restart": 6.0, "idle_gap": 4.0}}, hub)
+    assert hub.gauges[("goodput_fraction", ())] == 0.8
+    assert hub.gauges[("goodput_seconds", ())] == 40.0
+    assert hub.gauges[("badput_phase_seconds",
+                       (("phase", "restart"),))] == 6.0
+    assert hub.gauges[("badput_phase_seconds",
+                       (("phase", "idle_gap"),))] == 4.0
+    publish_summary({}, hub)      # empty summary is a no-op
+    publish_summary({"goodput_s": 1.0}, None)  # and so is no hub
+
+
+# --------------------------------------------------------- status surface
+
+
+USAGE_DATA = {
+    "ticks": 12, "capacity_s": 1200.0, "efficiency": 0.75,
+    "kinds": {"serving": 700.0, "training": 200.0, "idle": 180.0,
+              "upgrade-maintenance": 120.0, "degraded-frozen": 0.0},
+    "lanes": {"interactive": 500.0, "batch": 200.0},
+    "waste": [{"waste": "upgrade-maintenance", "start": 1_700_000_000.0,
+               "end": 1_700_000_120.0, "node_s": 120.0,
+               "events": [{"t": 1_700_000_010.0, "kind": "upgrade-step",
+                           "entity": "node/n3",
+                           "detail": "drain-required"}]}],
+    "billing": {"fleet_goodput_fraction": 0.72,
+                "tenants": {
+                    "serving/interactive": {"seconds": 500.0,
+                                            "cost": 2000.0,
+                                            "tokens": 9000.0,
+                                            "token_cost": 36000.0},
+                    "training": {"seconds": 200.0, "cost": 150.0,
+                                 "goodput_s": 150.0, "badput_s": 50.0},
+                    OVERHEAD_TENANT: {"seconds": 300.0, "cost": 300.0}}},
+}
+
+
+def test_render_usage_tables_and_waste_events():
+    status = _load_status()
+    text = status.render_usage(USAGE_DATA)
+    assert "fleet efficiency 75.0% productive" in text
+    assert "12 ticks" in text
+    assert "fleet goodput fraction 72.0%" in text
+    # per-kind table: sorted by seconds desc, zero-second kinds dropped
+    lines = text.splitlines()
+    header = next(i for i, ln in enumerate(lines)
+                  if ln.startswith("KIND"))
+    table = []
+    for ln in lines[header + 1:]:
+        if not ln.strip():
+            break
+        table.append(ln.split()[0])
+    assert table == ["serving", "training", "idle",
+                     "upgrade-maintenance"]
+    assert "degraded-frozen" not in text
+    assert "serving by lane: interactive" in text
+    assert "serving/interactive" in text and "fleet-overhead" in text
+    assert "9000" in text       # tokens column
+    assert "top 1 waste window(s):" in text
+    assert "upgrade-maintenance" in text
+    assert "upgrade-step" in text and "node/n3" in text  # joined events
+    # warming-up operator renders a hint, not a traceback
+    assert "no usage attributed yet" in status.render_usage({})
+    assert "no usage attributed yet" in status.render_usage(
+        {"ticks": 0, "capacity_s": 0.0})
+
+
+def test_run_usage_view_exit_codes(capsys):
+    status = _load_status()
+    env = {"kind": "usage", "data": USAGE_DATA}
+    args = types.SimpleNamespace(operator_url="http://x", as_json=False)
+    assert status.run_usage_view(args, fetch=lambda u, p: env) == 0
+    assert "fleet efficiency" in capsys.readouterr().out
+    args.as_json = True
+    assert status.run_usage_view(args, fetch=lambda u, p: env) == 0
+    assert json.loads(capsys.readouterr().out)["kind"] == "usage"
+
+    def broken(url, path):
+        raise OSError("no route")
+
+    args.as_json = False
+    assert status.run_usage_view(args, fetch=broken) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_efficiency_banner_contents_and_best_effort():
+    status = _load_status()
+
+    def fetch(url, path):
+        assert path == "/usage"
+        return {"kind": "usage", "data": USAGE_DATA}
+
+    banner = status.efficiency_banner("http://x", fetch=fetch)
+    assert banner.startswith("efficiency 75.0% productive")
+    assert "fleet goodput 72.0%" in banner
+    # top-2 waste kinds by seconds, productive kinds never listed
+    assert "top waste: idle" in banner
+    assert "upgrade-maintenance" in banner
+    assert "serving" not in banner and "training" not in banner
+    assert banner.index("idle") < banner.index("upgrade-maintenance")
+
+    def empty(url, path):
+        return {"kind": "usage", "data": {"ticks": 0, "capacity_s": 0.0}}
+
+    assert status.efficiency_banner("http://x", fetch=empty) is None
+
+    def broken(url, path):
+        raise OSError("down")
+
+    assert status.efficiency_banner("http://x", fetch=broken) is None
+
+
+def test_banner_lines_precedence_is_pinned():
+    """The consolidated banner helper owns the order: DEGRADED > STALE >
+    leading cause > efficiency. This test is the pin the docstring
+    promises."""
+    status = _load_status()
+    causes_env = {"kind": "causes", "data": {"reports": [{
+        "rule": "serving-ttft-p99:burn:page", "slo": "serving-ttft-p99",
+        "severity": "page",
+        "causes": [{"kind": "chaos-fault", "entity": "node/n1",
+                    "detail": "spot reclaim"}]}]}}
+
+    def fetch(url, path):
+        if path == "/resilience":
+            return {"kind": "resilience",
+                    "data": {"degraded": True, "staleness_s": 9.0}}
+        if path == "/causes":
+            return causes_env
+        if path == "/usage":
+            return {"kind": "usage", "data": USAGE_DATA}
+        raise AssertionError(path)
+
+    alerts = [{"rule": "serving-ttft-p99:burn:page", "severity": "page",
+               "state": "firing", "firing_since": 1.0, "message": "m"}]
+    stale = "STALE since 2026-01-01 — cannot read http://x: boom"
+    lines = status.banner_lines("http://x", fetch=fetch,
+                                alerts_data=alerts, stale_line=stale)
+    assert len(lines) == 4
+    assert "DEGRADED" in lines[0]
+    assert lines[1] is stale
+    assert "serving-ttft-p99" in lines[2] and "chaos-fault" in lines[2]
+    assert lines[3].startswith("efficiency ")
+    # each banner is independent and best-effort: /usage going away
+    # drops only its own line, order intact
+    def fetch_no_usage(url, path):
+        if path == "/usage":
+            raise OSError("down")
+        return fetch(url, path)
+
+    lines = status.banner_lines("http://x", fetch=fetch_no_usage,
+                                alerts_data=alerts, stale_line=None)
+    assert len(lines) == 2
+    assert "DEGRADED" in lines[0] and "serving-ttft-p99" in lines[1]
+    # quiet fleet, reachable operator: nothing to say
+    def fetch_quiet(url, path):
+        if path == "/resilience":
+            return {"kind": "resilience", "data": {"degraded": False}}
+        if path == "/usage":
+            return {"kind": "usage", "data": {"ticks": 0}}
+        return {"kind": "causes", "data": {"reports": []}}
+
+    assert status.banner_lines("http://x", fetch=fetch_quiet,
+                               alerts_data=[]) == []
+
+
+def test_dashboard_frame_leads_with_banners():
+    status = _load_status()
+
+    def fetch(url, path):
+        if path == "/resilience":
+            return {"kind": "resilience",
+                    "data": {"degraded": True, "staleness_s": 3.0}}
+        if path == "/usage":
+            return {"kind": "usage", "data": USAGE_DATA}
+        raise OSError("down")
+
+    body = status.render_dashboard({"slos": [], "history": {}}, [],
+                                   "http://x", fetch=fetch)
+    lines = body.splitlines()
+    assert "DEGRADED" in lines[0]
+    assert lines[1].startswith("efficiency ")
+    assert "fleet SLOs" in lines[2]

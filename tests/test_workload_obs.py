@@ -302,6 +302,10 @@ def test_status_watch_survives_transient_endpoint_failures(capsys):
             # an operator without resilience wired answers the
             # disabled-envelope shape (no banner)
             return {"error": "resilience disabled"}
+        if path == "/usage":
+            # likewise the efficiency banner's poll: usage accounting
+            # off answers the disabled envelope (no banner)
+            return {"error": "usage accounting disabled"}
         frame = next(frames)
         if isinstance(frame, Exception):
             raise frame

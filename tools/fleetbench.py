@@ -52,6 +52,8 @@ from k8s_operator_libs_tpu.obs.metrics import MetricsHub  # noqa: E402
 from k8s_operator_libs_tpu.obs.profile import (TickProfiler,  # noqa: E402
                                                counting_client)
 from k8s_operator_libs_tpu.obs.slo import SLOOptions  # noqa: E402
+from k8s_operator_libs_tpu.obs.usage import (USAGE_KINDS,  # noqa: E402
+                                             UsageMeter)
 from k8s_operator_libs_tpu.obs.trace import Tracer  # noqa: E402
 from k8s_operator_libs_tpu.tpu.operator import (ManagedComponent,  # noqa: E402
                                                 TPUOperator)
@@ -230,6 +232,11 @@ def main(argv=None) -> int:
     else:
         client = CachedClient(api, namespaces=[NS], pumped=True,
                               clock=clock).start()
+    # the fleet ledger rides every tick (no billing engine in the bench —
+    # the ledger write path is one JSONL line, measured elsewhere); the
+    # assertions below pin its overhead sub-tick and its memory fixed at
+    # fleet scale
+    usage_meter = UsageMeter(clock=clock, metrics=hub)
     operator = TPUOperator(
         client,
         components=[ManagedComponent(
@@ -250,7 +257,8 @@ def main(argv=None) -> int:
                 max_unavailable=args.max_unavailable)),
         slo=SLOOptions.from_dict({}),
         shard_workers=0 if args.uncached else args.shards,
-        verify_incremental=args.verify_incremental)
+        verify_incremental=args.verify_incremental,
+        usage=usage_meter)
 
     tick_wall = []
     tick_calls = []
@@ -318,6 +326,21 @@ def main(argv=None) -> int:
         n for name, n in health_calls.items()
         if name.split(" ")[0] in ("list", "get"))
     health_api_s = health_entry["api_s"] if health_entry else 0.0
+    # the fleet ledger (observability.md "Utilization & cost
+    # accounting"): the usage-tick span must stay well under the tick
+    # itself, and the meter's memory must be fixed — the closed kind
+    # catalog × observed lanes plus the capped waste ring, never
+    # O(fleet) or O(ticks)
+    usage_entry = next(
+        (e for e in profile.get("entries", [])
+         if e["handler"] == "usage-tick"), None)
+    usage_tick_s = ((usage_entry["self_s"] + usage_entry["api_s"])
+                    if usage_entry else 0.0)
+    usage_last = usage_meter.last or {}
+    usage_last_counted = sum(
+        int(n) for lanes in usage_last.get("counts", {}).values()
+        for n in lanes.values())
+    usage_lanes = {lane for (_kind, lane) in usage_meter.totals}
     tsdb = operator.tsdb
     state_counts = {}
     for node in cluster.client.direct().list_nodes():
@@ -377,6 +400,22 @@ def main(argv=None) -> int:
         "profile_decomposes_within_5pct": (
             tick_sample > 0
             and abs(decomposed - tick_sample) <= 0.05 * tick_sample),
+        # the meter classified every node of the last tick into exactly
+        # one bucket, and cumulatively Σ attributed seconds == capacity
+        # seconds — conservation at fleet scale, not just in units
+        "usage_conserves_capacity": (
+            usage_last.get("nodes") == len(nodes)
+            and usage_last_counted == len(nodes)
+            and abs(sum(usage_meter.totals.values())
+                    - usage_meter.capacity_s)
+            <= 1e-6 * max(1.0, usage_meter.capacity_s)),
+        "usage_tick_sub_tick": (
+            usage_entry is not None
+            and usage_tick_s < max(1e-9, percentile(tick_wall, 0.5))),
+        "usage_memory_fixed": (
+            len(usage_meter.totals)
+            <= len(USAGE_KINDS) * max(1, len(usage_lanes))
+            and len(usage_meter._closed_waste) <= usage_meter._max_waste),
     }
     artifact = {
         "bench": "control-plane fleetbench (docs/observability.md)",
@@ -447,6 +486,15 @@ def main(argv=None) -> int:
                  "api_s": round(e["api_s"], 3),
                  "calls": sum(e["api_calls"].values())}
                 for e in profile.get("entries", [])[:6]],
+        },
+        "usage": {
+            "capacity_s": round(usage_meter.capacity_s, 3),
+            "efficiency": (round(usage_meter.efficiency(), 4)
+                           if usage_meter.efficiency() is not None
+                           else None),
+            "kind_seconds": {k: round(s, 3) for k, s in
+                             sorted(usage_meter.kind_seconds().items())},
+            "usage_tick_s_last": round(usage_tick_s, 4),
         },
         "fleet_states_after_run": dict(
             sorted(state_counts.items(), key=lambda kv: -kv[1])),

@@ -804,3 +804,183 @@ def run_timeline(root) -> List[Finding]:
 
 register(Check(name="obs-timeline", codes=TIMELINE_CODES, scope="project",
                run=run_timeline, domain=True))
+
+
+# -------------------------------------------- OBS005 (fleet usage ledger)
+
+USAGE_CODES = {
+    "OBS005": "fleet-ledger drift: USAGE_KINDS and KIND_PRIORITY "
+              "disagree, a _bid() attribution site claims a non-literal "
+              "or uncataloged kind, a cataloged kind is never claimed "
+              "anywhere (and has no `# obs: allow` hatch), or the "
+              "USAGE_*_FAMILIES tables and the tpu_operator_usage_* "
+              "HELP_TEXTS entries disagree",
+}
+
+USAGE_PATH = "k8s_operator_libs_tpu/obs/usage.py"
+# HELP entries under this prefix must correspond to families the usage
+# meter actually emits (and vice versa) — the OBS003 discipline, scoped
+# to the fleet ledger's own prefix
+USAGE_HELP_PREFIX = "tpu_operator_usage_"
+# family tables carry unprefixed names; render() prepends the operator
+# prefix, so the closure compares against prefix + family
+USAGE_METRIC_PREFIX = "tpu_operator_"
+USAGE_HATCH = "# obs: allow"
+
+
+def _dict_literal_keys(tree: ast.Module, name: str
+                       ) -> Tuple[Dict[str, int], int]:
+    """Literal string keys of a module-level dict assignment →
+    ({key: lineno}, assignment lineno; 0 when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == name):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}, node.lineno
+        keys: Dict[str, int] = {}
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                keys[key.value] = key.lineno
+        return keys, node.lineno
+    return {}, 0
+
+
+def _bid_kinds(tree: ast.Module
+               ) -> Tuple[List[Tuple[str, int]], List[int]]:
+    """Every ``_bid(...)`` attribution site → ([(literal kind, lineno)],
+    [linenos of calls whose kind is absent or not a string literal])."""
+    literals: List[Tuple[str, int]] = []
+    bad: List[int] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if name != "_bid":
+            continue
+        kind = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "kind"), None)
+        if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+            literals.append((kind.value, node.lineno))
+        else:
+            bad.append(node.lineno)
+    return literals, bad
+
+
+def run_usage(root) -> List[Finding]:
+    index = as_index(root)
+    findings: List[Finding] = []
+    if not index.exists(USAGE_PATH):
+        return findings  # no fleet ledger in this checkout — skip
+
+    usage_tree = index.tree(USAGE_PATH)
+    catalog, catalog_line = _string_tuple(usage_tree, "USAGE_KINDS")
+    if catalog_line == 0:
+        return [(USAGE_PATH, 1, "OBS005",
+                 "USAGE_KINDS catalog not found (parse drift?)")]
+    priority, priority_line = _dict_literal_keys(usage_tree,
+                                                 "KIND_PRIORITY")
+    if priority_line == 0:
+        return [(USAGE_PATH, 1, "OBS005",
+                 "KIND_PRIORITY table not found (parse drift?)")]
+
+    # closure 1: the catalog and the priority sweep agree both ways — a
+    # kind without a rank makes _bid() raise at runtime; a rank without
+    # a kind is a sweep entry nothing can ever claim
+    for kind, lineno in sorted(catalog.items()):
+        if kind not in priority:
+            findings.append(
+                (USAGE_PATH, lineno, "OBS005",
+                 f"USAGE_KINDS entry {kind!r} has no KIND_PRIORITY rank "
+                 f"— _bid({kind!r}) would raise on the first claim"))
+    for kind, lineno in sorted(priority.items()):
+        if kind not in catalog:
+            findings.append(
+                (USAGE_PATH, lineno, "OBS005",
+                 f"KIND_PRIORITY key {kind!r} is not in the USAGE_KINDS "
+                 f"catalog (renamed or removed kind?)"))
+
+    # closure 2: every _bid() site claims a cataloged kind as a STRING
+    # LITERAL (the record_event discipline — a computed kind defeats the
+    # closure), and every cataloged kind is claimed somewhere, or
+    # carries the `# obs: allow — <why>` hatch on its catalog line
+    claimed: Dict[str, List[Tuple[str, int]]] = {}
+    for scan_root in SCAN_ROOTS:
+        for rel in index.files_under(scan_root):
+            try:
+                tree = index.tree(rel)
+            except SyntaxError:
+                continue  # the generic pass reports E999
+            literals, bad = _bid_kinds(tree)
+            for kind, lineno in literals:
+                claimed.setdefault(kind, []).append((rel, lineno))
+                if kind not in catalog:
+                    findings.append(
+                        (rel, lineno, "OBS005",
+                         f"_bid() kind {kind!r} is not in the "
+                         f"USAGE_KINDS catalog ({USAGE_PATH}) — it "
+                         f"would raise ValueError on the first claim"))
+            for lineno in bad:
+                findings.append(
+                    (rel, lineno, "OBS005",
+                     "_bid() must pass the kind as a string literal at "
+                     "the call site — a computed kind defeats the "
+                     "catalog closure"))
+    lines = index.lines(USAGE_PATH)
+    for kind, lineno in sorted(catalog.items()):
+        if kind in claimed:
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if USAGE_HATCH in line:
+            continue
+        findings.append(
+            (USAGE_PATH, lineno, "OBS005",
+             f"USAGE_KINDS entry {kind!r} is never claimed by any "
+             f"_bid() site under {'/'.join(SCAN_ROOTS)} — capacity can "
+             f"never be attributed to it (add the claim, remove the "
+             f"kind, or hatch the line with `{USAGE_HATCH} — <why>`)"))
+
+    # closure 3: the meter's emitted-family tables and the
+    # tpu_operator_usage_* HELP entries agree both ways (OBS003's
+    # discipline, scoped to the fleet ledger's prefix)
+    help_keys, help_line = _help_text_keys(index.tree(METRICS_PATH))
+    if help_line == 0:
+        findings.append((METRICS_PATH, 1, "OBS005",
+                         "HELP_TEXTS table not found (parse drift?)"))
+        return findings
+    emitted: Dict[str, int] = {}
+    for table in ("USAGE_COUNTER_FAMILIES", "USAGE_GAUGE_FAMILIES"):
+        fams, fams_line = _string_tuple(usage_tree, table)
+        if fams_line == 0:
+            findings.append(
+                (USAGE_PATH, 1, "OBS005",
+                 f"{table} table not found (parse drift?)"))
+            continue
+        emitted.update(fams)
+    full = {USAGE_METRIC_PREFIX + family: lineno
+            for family, lineno in emitted.items()}
+    for family, lineno in sorted(full.items()):
+        if family not in help_keys:
+            findings.append(
+                (USAGE_PATH, lineno, "OBS005",
+                 f"emitted usage family {family!r} has no HELP_TEXTS "
+                 f"entry ({METRICS_PATH})"))
+    for key, lineno in sorted(help_keys.items()):
+        if key.startswith(USAGE_HELP_PREFIX) and key not in full:
+            findings.append(
+                (METRICS_PATH, lineno, "OBS005",
+                 f"HELP_TEXTS entry {key!r} matches no emitted family "
+                 f"in the USAGE_*_FAMILIES tables ({USAGE_PATH}) "
+                 f"(renamed or removed usage metric?)"))
+    return findings
+
+
+register(Check(name="obs-usage", codes=USAGE_CODES, scope="project",
+               run=run_usage, domain=True))
